@@ -1,0 +1,76 @@
+"""deepspeed_tpu — a TPU-native large-model training framework with the
+capability surface of DeepSpeed v0.5.2 (reference: deepspeed/__init__.py),
+built on JAX/XLA/pjit/Pallas.
+
+Public entry points mirror the reference:
+  - initialize(...)        (reference: deepspeed/__init__.py:61)
+  - init_inference(...)    (reference: deepspeed/__init__.py:232)
+  - add_config_arguments() (reference: deepspeed/__init__.py:216)
+"""
+
+from .version import __version__
+from .config import DeepSpeedConfig, DeepSpeedConfigError
+from .parallel import (MeshContext, get_mesh_context, initialize_mesh,
+                       reset_mesh_context)
+from .parallel import groups
+from .utils import logger, log_dist
+
+
+def initialize(args=None, model=None, config=None, config_params=None,
+               optimizer=None, model_parameters=None, lr_scheduler=None,
+               mesh=None, dist_init_required=None, collate_fn=None,
+               training_data=None, mpu=None, rng=None):
+    """Create a TPU-backed training engine (reference: deepspeed/__init__.py:61).
+
+    Returns (engine, optimizer, dataloader, lr_scheduler) like the reference.
+    `model` is a flax module or an apply-style callable; see
+    deepspeed_tpu.runtime.engine for details.
+    """
+    from .runtime.engine import DeepSpeedEngine
+    from .runtime.pipe.module import PipelineModule
+
+    cfg = config if config is not None else config_params
+    if cfg is None and args is not None:
+        cfg = getattr(args, "deepspeed_config", None)
+    if cfg is None:
+        raise DeepSpeedConfigError("DeepSpeed requires a config (dict or path)")
+
+    if isinstance(model, PipelineModule):
+        from .runtime.pipe.engine import PipelineEngine
+        engine = PipelineEngine(model=model, config=cfg, optimizer=optimizer,
+                                lr_scheduler=lr_scheduler, mesh=mesh, mpu=mpu,
+                                training_data=training_data,
+                                collate_fn=collate_fn, rng=rng)
+    else:
+        engine = DeepSpeedEngine(model=model, config=cfg, optimizer=optimizer,
+                                 model_parameters=model_parameters,
+                                 lr_scheduler=lr_scheduler, mesh=mesh, mpu=mpu,
+                                 training_data=training_data,
+                                 collate_fn=collate_fn, rng=rng)
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def init_inference(model, mp_size=1, mesh=None, checkpoint=None, dtype=None,
+                   injection_policy=None, replace_method="auto",
+                   quantization_setting=None, **kwargs):
+    """Create an inference engine (reference: deepspeed/__init__.py:232)."""
+    from .inference.engine import InferenceEngine
+    return InferenceEngine(model, mp_size=mp_size, mesh=mesh,
+                           checkpoint=checkpoint, dtype=dtype,
+                           injection_policy=injection_policy,
+                           replace_method=replace_method,
+                           quantization_setting=quantization_setting, **kwargs)
+
+
+def add_config_arguments(parser):
+    """Add --deepspeed / --deepspeed_config args (reference: __init__.py:216)."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed (helper flag to ease transition)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="DeepSpeed json configuration file.")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help="Deprecated enable flag (kept for parity)")
+    group.add_argument("--deepscale_config", default=None, type=str,
+                       help="Deprecated config path (kept for parity)")
+    return parser

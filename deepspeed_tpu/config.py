@@ -1,0 +1,706 @@
+"""DeepSpeed-compatible JSON config → typed config objects.
+
+Reference: deepspeed/runtime/config.py:682 (DeepSpeedConfig), including the
+train-batch triple inference (config.py:869-924) and duplicate-key rejection
+(config.py:688-691).  The schema is the reference's; the backing runtime is
+TPU-native (JAX meshes instead of NCCL process groups).
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from . import constants as C
+from .config_utils import get_scalar_param, load_config_dict
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+@dataclass
+class FP16Config:
+    enabled: bool = C.FP16_ENABLED_DEFAULT
+    loss_scale: float = C.FP16_LOSS_SCALE_DEFAULT
+    initial_scale_power: int = C.FP16_INITIAL_SCALE_POWER_DEFAULT
+    loss_scale_window: int = C.FP16_LOSS_SCALE_WINDOW_DEFAULT
+    hysteresis: int = C.FP16_HYSTERESIS_DEFAULT
+    min_loss_scale: float = C.FP16_MIN_LOSS_SCALE_DEFAULT
+    fp16_master_weights_and_grads: bool = C.FP16_MASTER_WEIGHTS_AND_GRADS_DEFAULT
+
+    @property
+    def dynamic_loss_scale(self) -> bool:
+        return self.loss_scale == 0
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "FP16Config":
+        d = d or {}
+        return FP16Config(
+            enabled=get_scalar_param(d, C.FP16_ENABLED, C.FP16_ENABLED_DEFAULT),
+            loss_scale=get_scalar_param(d, C.FP16_LOSS_SCALE,
+                                        C.FP16_LOSS_SCALE_DEFAULT),
+            initial_scale_power=get_scalar_param(
+                d, C.FP16_INITIAL_SCALE_POWER, C.FP16_INITIAL_SCALE_POWER_DEFAULT),
+            loss_scale_window=get_scalar_param(d, C.FP16_LOSS_SCALE_WINDOW,
+                                               C.FP16_LOSS_SCALE_WINDOW_DEFAULT),
+            hysteresis=get_scalar_param(d, C.FP16_HYSTERESIS,
+                                        C.FP16_HYSTERESIS_DEFAULT),
+            min_loss_scale=get_scalar_param(d, C.FP16_MIN_LOSS_SCALE,
+                                            C.FP16_MIN_LOSS_SCALE_DEFAULT),
+            fp16_master_weights_and_grads=get_scalar_param(
+                d, C.FP16_MASTER_WEIGHTS_AND_GRADS,
+                C.FP16_MASTER_WEIGHTS_AND_GRADS_DEFAULT),
+        )
+
+
+@dataclass
+class BF16Config:
+    """TPU-native: bf16 is the preferred training dtype on TPU (MXU-native,
+    no loss scaling required)."""
+    enabled: bool = C.BF16_ENABLED_DEFAULT
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "BF16Config":
+        d = d or {}
+        return BF16Config(enabled=get_scalar_param(d, C.BF16_ENABLED,
+                                                   C.BF16_ENABLED_DEFAULT))
+
+
+@dataclass
+class OffloadParamConfig:
+    device: str = C.OFFLOAD_PARAM_DEVICE_DEFAULT
+    nvme_path: Optional[str] = C.OFFLOAD_PARAM_NVME_PATH_DEFAULT
+    buffer_count: int = C.OFFLOAD_PARAM_BUFFER_COUNT_DEFAULT
+    buffer_size: int = C.OFFLOAD_PARAM_BUFFER_SIZE_DEFAULT
+    max_in_cpu: int = C.OFFLOAD_PARAM_MAX_IN_CPU_DEFAULT
+    pin_memory: bool = C.OFFLOAD_PARAM_PIN_MEMORY_DEFAULT
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> Optional["OffloadParamConfig"]:
+        if d is None:
+            return None
+        return OffloadParamConfig(
+            device=get_scalar_param(d, C.OFFLOAD_PARAM_DEVICE,
+                                    C.OFFLOAD_PARAM_DEVICE_DEFAULT),
+            nvme_path=get_scalar_param(d, C.OFFLOAD_PARAM_NVME_PATH,
+                                       C.OFFLOAD_PARAM_NVME_PATH_DEFAULT),
+            buffer_count=int(get_scalar_param(d, C.OFFLOAD_PARAM_BUFFER_COUNT,
+                                              C.OFFLOAD_PARAM_BUFFER_COUNT_DEFAULT)),
+            buffer_size=int(get_scalar_param(d, C.OFFLOAD_PARAM_BUFFER_SIZE,
+                                             C.OFFLOAD_PARAM_BUFFER_SIZE_DEFAULT)),
+            max_in_cpu=int(get_scalar_param(d, C.OFFLOAD_PARAM_MAX_IN_CPU,
+                                            C.OFFLOAD_PARAM_MAX_IN_CPU_DEFAULT)),
+            pin_memory=get_scalar_param(d, C.OFFLOAD_PARAM_PIN_MEMORY,
+                                        C.OFFLOAD_PARAM_PIN_MEMORY_DEFAULT),
+        )
+
+
+@dataclass
+class OffloadOptimizerConfig:
+    device: str = C.OFFLOAD_OPTIMIZER_DEVICE_DEFAULT
+    nvme_path: Optional[str] = C.OFFLOAD_OPTIMIZER_NVME_PATH_DEFAULT
+    buffer_count: int = C.OFFLOAD_OPTIMIZER_BUFFER_COUNT_DEFAULT
+    pin_memory: bool = C.OFFLOAD_OPTIMIZER_PIN_MEMORY_DEFAULT
+    pipeline_read: bool = C.OFFLOAD_OPTIMIZER_PIPELINE_READ_DEFAULT
+    pipeline_write: bool = C.OFFLOAD_OPTIMIZER_PIPELINE_WRITE_DEFAULT
+    fast_init: bool = C.OFFLOAD_OPTIMIZER_FAST_INIT_DEFAULT
+
+    @property
+    def pipeline(self) -> bool:
+        return self.pipeline_read or self.pipeline_write
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> Optional["OffloadOptimizerConfig"]:
+        if d is None:
+            return None
+        return OffloadOptimizerConfig(
+            device=get_scalar_param(d, C.OFFLOAD_OPTIMIZER_DEVICE,
+                                    C.OFFLOAD_OPTIMIZER_DEVICE_DEFAULT),
+            nvme_path=get_scalar_param(d, C.OFFLOAD_OPTIMIZER_NVME_PATH,
+                                       C.OFFLOAD_OPTIMIZER_NVME_PATH_DEFAULT),
+            buffer_count=int(get_scalar_param(
+                d, C.OFFLOAD_OPTIMIZER_BUFFER_COUNT,
+                C.OFFLOAD_OPTIMIZER_BUFFER_COUNT_DEFAULT)),
+            pin_memory=get_scalar_param(d, C.OFFLOAD_OPTIMIZER_PIN_MEMORY,
+                                        C.OFFLOAD_OPTIMIZER_PIN_MEMORY_DEFAULT),
+            pipeline_read=get_scalar_param(
+                d, C.OFFLOAD_OPTIMIZER_PIPELINE_READ,
+                C.OFFLOAD_OPTIMIZER_PIPELINE_READ_DEFAULT),
+            pipeline_write=get_scalar_param(
+                d, C.OFFLOAD_OPTIMIZER_PIPELINE_WRITE,
+                C.OFFLOAD_OPTIMIZER_PIPELINE_WRITE_DEFAULT),
+            fast_init=get_scalar_param(d, C.OFFLOAD_OPTIMIZER_FAST_INIT,
+                                       C.OFFLOAD_OPTIMIZER_FAST_INIT_DEFAULT),
+        )
+
+
+@dataclass
+class ZeroConfig:
+    """Reference: deepspeed/runtime/zero/config.py:18 (DeepSpeedZeroConfig)."""
+    stage: int = C.ZERO_OPTIMIZATION_STAGE_DEFAULT
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = C.ZERO_OPTIMIZATION_REDUCE_SCATTER_DEFAULT
+    reduce_bucket_size: int = C.ZERO_OPTIMIZATION_REDUCE_BUCKET_SIZE_DEFAULT
+    allgather_partitions: bool = C.ZERO_OPTIMIZATION_ALLGATHER_PARTITIONS_DEFAULT
+    allgather_bucket_size: int = C.ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEFAULT
+    overlap_comm: bool = False
+    offload_param: Optional[OffloadParamConfig] = None
+    offload_optimizer: Optional[OffloadOptimizerConfig] = None
+    sub_group_size: int = C.ZERO_OPTIMIZATION_SUB_GROUP_SIZE_DEFAULT
+    max_live_parameters: int = C.ZERO_OPTIMIZATION_MAX_LIVE_PARAMETERS_DEFAULT
+    max_reuse_distance: int = C.ZERO_OPTIMIZATION_MAX_REUSE_DISTANCE_DEFAULT
+    prefetch_bucket_size: int = C.ZERO_OPTIMIZATION_PREFETCH_BUCKET_SIZE_DEFAULT
+    param_persistence_threshold: int = (
+        C.ZERO_OPTIMIZATION_PARAM_PERSISTENCE_THRESHOLD_DEFAULT)
+    gather_fp16_weights_on_model_save: bool = (
+        C.ZERO_OPTIMIZATION_GATHER_FP16_WEIGHTS_ON_MODEL_SAVE_DEFAULT)
+    ignore_unused_parameters: bool = (
+        C.ZERO_OPTIMIZATION_IGNORE_UNUSED_PARAMETERS_DEFAULT)
+    legacy_stage1: bool = C.ZERO_OPTIMIZATION_LEGACY_STAGE1_DEFAULT
+    elastic_checkpoint: bool = C.ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT
+    cpu_offload: bool = C.ZERO_OPTIMIZATION_CPU_OFFLOAD_DEFAULT
+    cpu_offload_params: bool = C.ZERO_OPTIMIZATION_CPU_OFFLOAD_PARAMS_DEFAULT
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "ZeroConfig":
+        if d is None:
+            d = {}
+        if isinstance(d, bool):  # "zero_optimization": true → stage 1
+            d = {C.ZERO_OPTIMIZATION_STAGE: 1 if d else 0}
+        stage = get_scalar_param(d, C.ZERO_OPTIMIZATION_STAGE,
+                                 C.ZERO_OPTIMIZATION_STAGE_DEFAULT)
+        # Legacy cpu_offload flags map onto the offload_* sub-dicts
+        # (reference: zero/config.py offload back-compat).
+        cpu_offload = get_scalar_param(d, C.ZERO_OPTIMIZATION_CPU_OFFLOAD,
+                                       C.ZERO_OPTIMIZATION_CPU_OFFLOAD_DEFAULT)
+        cpu_offload_params = get_scalar_param(
+            d, C.ZERO_OPTIMIZATION_CPU_OFFLOAD_PARAMS,
+            C.ZERO_OPTIMIZATION_CPU_OFFLOAD_PARAMS_DEFAULT)
+        cpu_offload_pin = get_scalar_param(
+            d, C.ZERO_OPTIMIZATION_CPU_OFFLOAD_USE_PIN_MEMORY,
+            C.ZERO_OPTIMIZATION_CPU_OFFLOAD_USE_PIN_MEMORY_DEFAULT)
+        offload_param = OffloadParamConfig.from_dict(
+            d.get(C.ZERO_OPTIMIZATION_OFFLOAD_PARAM))
+        offload_optimizer = OffloadOptimizerConfig.from_dict(
+            d.get(C.ZERO_OPTIMIZATION_OFFLOAD_OPTIMIZER))
+        if cpu_offload and offload_optimizer is None:
+            offload_optimizer = OffloadOptimizerConfig(
+                device=C.OFFLOAD_CPU_DEVICE, pin_memory=cpu_offload_pin)
+        if cpu_offload_params and offload_param is None:
+            offload_param = OffloadParamConfig(
+                device=C.OFFLOAD_CPU_DEVICE, pin_memory=cpu_offload_pin)
+        overlap_default = stage == C.ZERO_OPTIMIZATION_WEIGHTS
+        contiguous_default = True
+        return ZeroConfig(
+            stage=stage,
+            contiguous_gradients=get_scalar_param(
+                d, C.ZERO_OPTIMIZATION_CONTIGUOUS_GRADIENTS, contiguous_default),
+            reduce_scatter=get_scalar_param(
+                d, C.ZERO_OPTIMIZATION_REDUCE_SCATTER,
+                C.ZERO_OPTIMIZATION_REDUCE_SCATTER_DEFAULT),
+            reduce_bucket_size=int(get_scalar_param(
+                d, C.ZERO_OPTIMIZATION_REDUCE_BUCKET_SIZE,
+                C.ZERO_OPTIMIZATION_REDUCE_BUCKET_SIZE_DEFAULT)),
+            allgather_partitions=get_scalar_param(
+                d, C.ZERO_OPTIMIZATION_ALLGATHER_PARTITIONS,
+                C.ZERO_OPTIMIZATION_ALLGATHER_PARTITIONS_DEFAULT),
+            allgather_bucket_size=int(get_scalar_param(
+                d, C.ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE,
+                C.ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEFAULT)),
+            overlap_comm=get_scalar_param(d, C.ZERO_OPTIMIZATION_OVERLAP_COMM,
+                                          overlap_default),
+            offload_param=offload_param,
+            offload_optimizer=offload_optimizer,
+            sub_group_size=int(get_scalar_param(
+                d, C.ZERO_OPTIMIZATION_SUB_GROUP_SIZE,
+                C.ZERO_OPTIMIZATION_SUB_GROUP_SIZE_DEFAULT)),
+            max_live_parameters=int(get_scalar_param(
+                d, C.ZERO_OPTIMIZATION_MAX_LIVE_PARAMETERS,
+                C.ZERO_OPTIMIZATION_MAX_LIVE_PARAMETERS_DEFAULT)),
+            max_reuse_distance=int(get_scalar_param(
+                d, C.ZERO_OPTIMIZATION_MAX_REUSE_DISTANCE,
+                C.ZERO_OPTIMIZATION_MAX_REUSE_DISTANCE_DEFAULT)),
+            prefetch_bucket_size=int(get_scalar_param(
+                d, C.ZERO_OPTIMIZATION_PREFETCH_BUCKET_SIZE,
+                C.ZERO_OPTIMIZATION_PREFETCH_BUCKET_SIZE_DEFAULT)),
+            param_persistence_threshold=int(get_scalar_param(
+                d, C.ZERO_OPTIMIZATION_PARAM_PERSISTENCE_THRESHOLD,
+                C.ZERO_OPTIMIZATION_PARAM_PERSISTENCE_THRESHOLD_DEFAULT)),
+            gather_fp16_weights_on_model_save=get_scalar_param(
+                d, C.ZERO_OPTIMIZATION_GATHER_FP16_WEIGHTS_ON_MODEL_SAVE,
+                C.ZERO_OPTIMIZATION_GATHER_FP16_WEIGHTS_ON_MODEL_SAVE_DEFAULT),
+            ignore_unused_parameters=get_scalar_param(
+                d, C.ZERO_OPTIMIZATION_IGNORE_UNUSED_PARAMETERS,
+                C.ZERO_OPTIMIZATION_IGNORE_UNUSED_PARAMETERS_DEFAULT),
+            legacy_stage1=get_scalar_param(
+                d, C.ZERO_OPTIMIZATION_LEGACY_STAGE1,
+                C.ZERO_OPTIMIZATION_LEGACY_STAGE1_DEFAULT),
+            elastic_checkpoint=get_scalar_param(
+                d, C.ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT,
+                C.ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT),
+            cpu_offload=cpu_offload,
+            cpu_offload_params=cpu_offload_params,
+        )
+
+
+@dataclass
+class AioConfig:
+    """Reference: deepspeed/runtime/swap_tensor/aio_config.py:18."""
+    block_size: int = C.AIO_BLOCK_SIZE_DEFAULT
+    queue_depth: int = C.AIO_QUEUE_DEPTH_DEFAULT
+    thread_count: int = C.AIO_THREAD_COUNT_DEFAULT
+    single_submit: bool = C.AIO_SINGLE_SUBMIT_DEFAULT
+    overlap_events: bool = C.AIO_OVERLAP_EVENTS_DEFAULT
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "AioConfig":
+        d = d or {}
+        return AioConfig(
+            block_size=int(get_scalar_param(d, C.AIO_BLOCK_SIZE,
+                                            C.AIO_BLOCK_SIZE_DEFAULT)),
+            queue_depth=int(get_scalar_param(d, C.AIO_QUEUE_DEPTH,
+                                             C.AIO_QUEUE_DEPTH_DEFAULT)),
+            thread_count=int(get_scalar_param(d, C.AIO_THREAD_COUNT,
+                                              C.AIO_THREAD_COUNT_DEFAULT)),
+            single_submit=get_scalar_param(d, C.AIO_SINGLE_SUBMIT,
+                                           C.AIO_SINGLE_SUBMIT_DEFAULT),
+            overlap_events=get_scalar_param(d, C.AIO_OVERLAP_EVENTS,
+                                            C.AIO_OVERLAP_EVENTS_DEFAULT),
+        )
+
+
+@dataclass
+class ActivationCheckpointingConfig:
+    """Reference: runtime/activation_checkpointing/config.py:103."""
+    partition_activations: bool = C.ACT_CHKPT_PARTITION_ACTIVATIONS_DEFAULT
+    contiguous_memory_optimization: bool = (
+        C.ACT_CHKPT_CONTIGUOUS_MEMORY_OPTIMIZATION_DEFAULT)
+    cpu_checkpointing: bool = C.ACT_CHKPT_CPU_CHECKPOINTING_DEFAULT
+    number_checkpoints: Optional[int] = C.ACT_CHKPT_NUMBER_CHECKPOINTS_DEFAULT
+    synchronize_checkpoint_boundary: bool = (
+        C.ACT_CHKPT_SYNCHRONIZE_CHECKPOINT_BOUNDARY_DEFAULT)
+    profile: bool = C.ACT_CHKPT_PROFILE_DEFAULT
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "ActivationCheckpointingConfig":
+        d = d or {}
+        return ActivationCheckpointingConfig(
+            partition_activations=get_scalar_param(
+                d, C.ACT_CHKPT_PARTITION_ACTIVATIONS,
+                C.ACT_CHKPT_PARTITION_ACTIVATIONS_DEFAULT),
+            contiguous_memory_optimization=get_scalar_param(
+                d, C.ACT_CHKPT_CONTIGUOUS_MEMORY_OPTIMIZATION,
+                C.ACT_CHKPT_CONTIGUOUS_MEMORY_OPTIMIZATION_DEFAULT),
+            cpu_checkpointing=get_scalar_param(
+                d, C.ACT_CHKPT_CPU_CHECKPOINTING,
+                C.ACT_CHKPT_CPU_CHECKPOINTING_DEFAULT),
+            number_checkpoints=get_scalar_param(
+                d, C.ACT_CHKPT_NUMBER_CHECKPOINTS,
+                C.ACT_CHKPT_NUMBER_CHECKPOINTS_DEFAULT),
+            synchronize_checkpoint_boundary=get_scalar_param(
+                d, C.ACT_CHKPT_SYNCHRONIZE_CHECKPOINT_BOUNDARY,
+                C.ACT_CHKPT_SYNCHRONIZE_CHECKPOINT_BOUNDARY_DEFAULT),
+            profile=get_scalar_param(d, C.ACT_CHKPT_PROFILE,
+                                     C.ACT_CHKPT_PROFILE_DEFAULT),
+        )
+
+
+@dataclass
+class FlopsProfilerConfig:
+    """Reference: deepspeed/profiling/config.py:49."""
+    enabled: bool = C.FLOPS_PROFILER_ENABLED_DEFAULT
+    profile_step: int = C.FLOPS_PROFILER_PROFILE_STEP_DEFAULT
+    module_depth: int = C.FLOPS_PROFILER_MODULE_DEPTH_DEFAULT
+    top_modules: int = C.FLOPS_PROFILER_TOP_MODULES_DEFAULT
+    detailed: bool = C.FLOPS_PROFILER_DETAILED_DEFAULT
+    output_file: Optional[str] = C.FLOPS_PROFILER_OUTPUT_FILE_DEFAULT
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "FlopsProfilerConfig":
+        d = d or {}
+        return FlopsProfilerConfig(
+            enabled=get_scalar_param(d, C.FLOPS_PROFILER_ENABLED,
+                                     C.FLOPS_PROFILER_ENABLED_DEFAULT),
+            profile_step=get_scalar_param(d, C.FLOPS_PROFILER_PROFILE_STEP,
+                                          C.FLOPS_PROFILER_PROFILE_STEP_DEFAULT),
+            module_depth=get_scalar_param(d, C.FLOPS_PROFILER_MODULE_DEPTH,
+                                          C.FLOPS_PROFILER_MODULE_DEPTH_DEFAULT),
+            top_modules=get_scalar_param(d, C.FLOPS_PROFILER_TOP_MODULES,
+                                         C.FLOPS_PROFILER_TOP_MODULES_DEFAULT),
+            detailed=get_scalar_param(d, C.FLOPS_PROFILER_DETAILED,
+                                      C.FLOPS_PROFILER_DETAILED_DEFAULT),
+            output_file=get_scalar_param(d, C.FLOPS_PROFILER_OUTPUT_FILE,
+                                         C.FLOPS_PROFILER_OUTPUT_FILE_DEFAULT),
+        )
+
+
+@dataclass
+class TensorboardConfig:
+    enabled: bool = C.TENSORBOARD_ENABLED_DEFAULT
+    output_path: str = C.TENSORBOARD_OUTPUT_PATH_DEFAULT
+    job_name: str = C.TENSORBOARD_JOB_NAME_DEFAULT
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "TensorboardConfig":
+        d = d or {}
+        return TensorboardConfig(
+            enabled=get_scalar_param(d, C.TENSORBOARD_ENABLED,
+                                     C.TENSORBOARD_ENABLED_DEFAULT),
+            output_path=get_scalar_param(d, C.TENSORBOARD_OUTPUT_PATH,
+                                         C.TENSORBOARD_OUTPUT_PATH_DEFAULT),
+            job_name=get_scalar_param(d, C.TENSORBOARD_JOB_NAME,
+                                      C.TENSORBOARD_JOB_NAME_DEFAULT),
+        )
+
+
+@dataclass
+class EigenvalueConfig:
+    enabled: bool = C.EIGENVALUE_ENABLED_DEFAULT
+    verbose: bool = C.EIGENVALUE_VERBOSE_DEFAULT
+    max_iter: int = C.EIGENVALUE_MAX_ITER_DEFAULT
+    tol: float = C.EIGENVALUE_TOL_DEFAULT
+    stability: float = C.EIGENVALUE_STABILITY_DEFAULT
+    gas_boundary_resolution: int = C.EIGENVALUE_GAS_BOUNDARY_RESOLUTION_DEFAULT
+    layer_name: str = C.EIGENVALUE_LAYER_NAME_DEFAULT
+    layer_num: int = C.EIGENVALUE_LAYER_NUM_DEFAULT
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "EigenvalueConfig":
+        d = d or {}
+        return EigenvalueConfig(
+            enabled=get_scalar_param(d, C.EIGENVALUE_ENABLED,
+                                     C.EIGENVALUE_ENABLED_DEFAULT),
+            verbose=get_scalar_param(d, C.EIGENVALUE_VERBOSE,
+                                     C.EIGENVALUE_VERBOSE_DEFAULT),
+            max_iter=get_scalar_param(d, C.EIGENVALUE_MAX_ITER,
+                                      C.EIGENVALUE_MAX_ITER_DEFAULT),
+            tol=get_scalar_param(d, C.EIGENVALUE_TOL, C.EIGENVALUE_TOL_DEFAULT),
+            stability=get_scalar_param(d, C.EIGENVALUE_STABILITY,
+                                       C.EIGENVALUE_STABILITY_DEFAULT),
+            gas_boundary_resolution=get_scalar_param(
+                d, C.EIGENVALUE_GAS_BOUNDARY_RESOLUTION,
+                C.EIGENVALUE_GAS_BOUNDARY_RESOLUTION_DEFAULT),
+            layer_name=get_scalar_param(d, C.EIGENVALUE_LAYER_NAME,
+                                        C.EIGENVALUE_LAYER_NAME_DEFAULT),
+            layer_num=get_scalar_param(d, C.EIGENVALUE_LAYER_NUM,
+                                       C.EIGENVALUE_LAYER_NUM_DEFAULT),
+        )
+
+
+@dataclass
+class PLDConfig:
+    enabled: bool = C.PLD_ENABLED_DEFAULT
+    theta: float = C.PLD_THETA_DEFAULT
+    gamma: float = C.PLD_GAMMA_DEFAULT
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "PLDConfig":
+        d = d or {}
+        return PLDConfig(
+            enabled=get_scalar_param(d, C.PLD_ENABLED, C.PLD_ENABLED_DEFAULT),
+            theta=get_scalar_param(d, C.PLD_THETA, C.PLD_THETA_DEFAULT),
+            gamma=get_scalar_param(d, C.PLD_GAMMA, C.PLD_GAMMA_DEFAULT),
+        )
+
+
+@dataclass
+class CurriculumConfig:
+    enabled: bool = C.CURRICULUM_ENABLED_DEFAULT
+    params: Dict[str, Any] = field(default_factory=dict)
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "CurriculumConfig":
+        d = d or {}
+        return CurriculumConfig(
+            enabled=get_scalar_param(d, C.CURRICULUM_ENABLED,
+                                     C.CURRICULUM_ENABLED_DEFAULT),
+            params=dict(d),
+        )
+
+
+@dataclass
+class QuantizeTrainingConfig:
+    """MoQ — reference: runtime/config.py get_quantize_enabled + quantize keys."""
+    enabled: bool = C.QUANTIZE_TRAINING_ENABLED_DEFAULT
+    quantize_verbose: bool = C.QUANTIZE_VERBOSE_DEFAULT
+    quantizer_kernel: bool = C.QUANTIZER_KERNEL_DEFAULT
+    start_bits: int = C.QUANTIZE_START_BITS_DEFAULT
+    target_bits: int = C.QUANTIZE_TARGET_BITS_DEFAULT
+    quantize_period: int = C.QUANTIZE_PERIOD_DEFAULT
+    schedule_offset: int = C.QUANTIZE_OFFSET_DEFAULT
+    quantize_groups: int = C.QUANTIZE_GROUPS_DEFAULT
+    quantize_type: int = C.QUANTIZE_TYPE_DEFAULT  # 0 symmetric / 1 asymmetric
+    rounding: int = C.QUANTIZE_ROUNDING_DEFAULT  # 0 nearest / 1 stochastic
+    fp16_mixed_quantize: bool = C.FP16_MIXED_QUANTIZE_ENABLED_DEFAULT
+    quantize_change_ratio: float = C.QUANTIZE_CHANGE_RATIO_DEFAULT
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "QuantizeTrainingConfig":
+        d = d or {}
+        bits = d.get(C.QUANTIZE_BITS, {})
+        schedule = d.get(C.QUANTIZE_SCHEDULE, {})
+        algo = d.get(C.QUANTIZE_ALGO, {})
+        mixed = d.get(C.FP16_MIXED_QUANTIZE, {})
+        qtype = algo.get(C.QUANTIZE_TYPE, C.QUANTIZE_SYMMETRIC)
+        rounding = algo.get(C.QUANTIZE_ROUNDING, C.NEAREST_ROUNDING)
+        return QuantizeTrainingConfig(
+            enabled=get_scalar_param(d, C.QUANTIZE_TRAINING_ENABLED,
+                                     C.QUANTIZE_TRAINING_ENABLED_DEFAULT),
+            quantize_verbose=get_scalar_param(d, C.QUANTIZE_VERBOSE,
+                                              C.QUANTIZE_VERBOSE_DEFAULT),
+            quantizer_kernel=get_scalar_param(d, C.QUANTIZER_KERNEL,
+                                              C.QUANTIZER_KERNEL_DEFAULT),
+            start_bits=bits.get(C.START_BITS, C.QUANTIZE_START_BITS_DEFAULT),
+            target_bits=bits.get(C.TARGET_BITS, C.QUANTIZE_TARGET_BITS_DEFAULT),
+            quantize_period=schedule.get(C.QUANTIZE_PERIOD,
+                                         C.QUANTIZE_PERIOD_DEFAULT),
+            schedule_offset=schedule.get(C.SCHEDULE_OFFSET,
+                                         C.QUANTIZE_OFFSET_DEFAULT),
+            quantize_groups=get_scalar_param(d, C.QUANTIZE_GROUPS,
+                                             C.QUANTIZE_GROUPS_DEFAULT),
+            quantize_type=(0 if qtype == C.QUANTIZE_SYMMETRIC else 1),
+            rounding=(1 if rounding == C.STOCHASTIC_ROUNDING else 0),
+            fp16_mixed_quantize=mixed.get(C.FP16_MIXED_QUANTIZE_ENABLED,
+                                          C.FP16_MIXED_QUANTIZE_ENABLED_DEFAULT),
+            quantize_change_ratio=mixed.get(C.QUANTIZE_CHANGE_RATIO,
+                                            C.QUANTIZE_CHANGE_RATIO_DEFAULT),
+        )
+
+
+@dataclass
+class CheckpointConfig:
+    tag_validation: str = C.CHECKPOINT_TAG_VALIDATION_DEFAULT
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "CheckpointConfig":
+        d = d or {}
+        mode = get_scalar_param(d, C.CHECKPOINT_TAG_VALIDATION,
+                                C.CHECKPOINT_TAG_VALIDATION_DEFAULT).upper()
+        if mode not in C.CHECKPOINT_TAG_VALIDATION_MODES:
+            raise DeepSpeedConfigError(
+                "Checkpoint config {} only supports {}".format(
+                    C.CHECKPOINT_TAG_VALIDATION, C.CHECKPOINT_TAG_VALIDATION_MODES))
+        return CheckpointConfig(tag_validation=mode)
+
+
+@dataclass
+class MeshConfig:
+    """TPU-native: named-axis device mesh shape.  -1 means "fill with the
+    remaining devices" (like a reshape wildcard); exactly one axis may be -1.
+    Axis order is ICI-aware: data outermost, model innermost so tensor-parallel
+    collectives ride the fastest links."""
+    data: int = -1
+    model: int = 1
+    pipe: int = 1
+    expert: int = 1
+    seq: int = 1
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "MeshConfig":
+        d = d or {}
+        return MeshConfig(
+            data=int(d.get(C.MESH_DATA_AXIS, -1)),
+            model=int(d.get(C.MESH_MODEL_AXIS, 1)),
+            pipe=int(d.get(C.MESH_PIPE_AXIS, 1)),
+            expert=int(d.get(C.MESH_EXPERT_AXIS, 1)),
+            seq=int(d.get(C.MESH_SEQ_AXIS, 1)),
+        )
+
+
+@dataclass
+class SequenceParallelConfig:
+    """TPU-native long-context layer (ring attention / Ulysses)."""
+    mode: str = C.SEQUENCE_PARALLEL_MODE_DEFAULT
+    size: int = C.SEQUENCE_PARALLEL_SIZE_DEFAULT
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "SequenceParallelConfig":
+        d = d or {}
+        return SequenceParallelConfig(
+            mode=get_scalar_param(d, C.SEQUENCE_PARALLEL_MODE,
+                                  C.SEQUENCE_PARALLEL_MODE_DEFAULT),
+            size=int(get_scalar_param(d, C.SEQUENCE_PARALLEL_SIZE,
+                                      C.SEQUENCE_PARALLEL_SIZE_DEFAULT)),
+        )
+
+
+class DeepSpeedConfig:
+    """Parse a DeepSpeed-style JSON config (path or dict) into typed configs.
+
+    Reference semantics: deepspeed/runtime/config.py:682.  `world_size` here is
+    the data-parallel world size used in the batch triple inference
+    (reference: config.py:869 train_batch = micro_batch × gas × dp_world).
+    """
+
+    def __init__(self, config, world_size: int = 1, elastic_resolver=None):
+        self._param_dict = load_config_dict(config)
+        self.world_size = world_size
+
+        # Elasticity may rewrite the batch keys before inference
+        # (reference: runtime/config.py:707-757).
+        self.elasticity_enabled = False
+        elastic_dict = self._param_dict.get(C.ELASTICITY)
+        if elastic_dict and get_scalar_param(elastic_dict, C.ENABLED,
+                                             C.ENABLED_DEFAULT):
+            self.elasticity_enabled = True
+            from .elasticity import apply_elasticity
+            apply_elasticity(self._param_dict, world_size)
+
+        self._initialize_params(self._param_dict)
+        self._batch_assertion()
+
+    # ------------------------------------------------------------------ #
+    def _initialize_params(self, pd: Dict[str, Any]) -> None:
+        self.train_batch_size = get_scalar_param(pd, C.TRAIN_BATCH_SIZE,
+                                                 C.TRAIN_BATCH_SIZE_DEFAULT)
+        self.train_micro_batch_size_per_gpu = get_scalar_param(
+            pd, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+            C.TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT)
+        self.gradient_accumulation_steps = get_scalar_param(
+            pd, C.GRADIENT_ACCUMULATION_STEPS,
+            C.GRADIENT_ACCUMULATION_STEPS_DEFAULT)
+        self._infer_batch_params()
+
+        self.steps_per_print = get_scalar_param(pd, C.STEPS_PER_PRINT,
+                                                C.STEPS_PER_PRINT_DEFAULT)
+        self.dump_state = get_scalar_param(pd, C.DUMP_STATE,
+                                           C.DUMP_STATE_DEFAULT)
+        self.gradient_clipping = get_scalar_param(pd, C.GRADIENT_CLIPPING,
+                                                  C.GRADIENT_CLIPPING_DEFAULT)
+        self.sparse_gradients_enabled = get_scalar_param(
+            pd, C.SPARSE_GRADIENTS, C.SPARSE_GRADIENTS_DEFAULT)
+        self.prescale_gradients = get_scalar_param(pd, C.PRESCALE_GRADIENTS,
+                                                   C.PRESCALE_GRADIENTS_DEFAULT)
+        self.gradient_predivide_factor = get_scalar_param(
+            pd, C.GRADIENT_PREDIVIDE_FACTOR, C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
+        self.fp32_allreduce = get_scalar_param(pd, C.FP32_ALLREDUCE,
+                                               C.FP32_ALLREDUCE_DEFAULT)
+        self.disable_allgather = get_scalar_param(pd, C.DISABLE_ALLGATHER,
+                                                  C.DISABLE_ALLGATHER_DEFAULT)
+        self.wall_clock_breakdown = get_scalar_param(
+            pd, C.WALL_CLOCK_BREAKDOWN, C.WALL_CLOCK_BREAKDOWN_DEFAULT)
+        self.memory_breakdown = get_scalar_param(pd, C.MEMORY_BREAKDOWN,
+                                                 C.MEMORY_BREAKDOWN_DEFAULT)
+        self.zero_allow_untested_optimizer = get_scalar_param(
+            pd, C.ZERO_ALLOW_UNTESTED_OPTIMIZER,
+            C.ZERO_ALLOW_UNTESTED_OPTIMIZER_DEFAULT)
+
+        opt = pd.get(C.OPTIMIZER)
+        self.optimizer_name = (opt.get(C.TYPE).lower()
+                               if opt and opt.get(C.TYPE) else None)
+        self.optimizer_params = opt.get(C.OPTIMIZER_PARAMS, {}) if opt else {}
+        self.optimizer_legacy_fusion = (opt.get(C.LEGACY_FUSION,
+                                                C.LEGACY_FUSION_DEFAULT)
+                                        if opt else C.LEGACY_FUSION_DEFAULT)
+
+        sched = pd.get(C.SCHEDULER)
+        self.scheduler_name = sched.get(C.TYPE) if sched else None
+        self.scheduler_params = sched.get(C.SCHEDULER_PARAMS, {}) if sched else {}
+
+        self.fp16 = FP16Config.from_dict(pd.get(C.FP16))
+        self.bf16 = BF16Config.from_dict(pd.get(C.BF16))
+        self.amp = pd.get(C.AMP, {})
+        self.amp_enabled = self.amp.get(C.AMP_ENABLED, C.AMP_ENABLED_DEFAULT)
+
+        self.zero_config = ZeroConfig.from_dict(pd.get(C.ZERO_OPTIMIZATION))
+        self.aio_config = AioConfig.from_dict(pd.get(C.AIO))
+        self.activation_checkpointing_config = (
+            ActivationCheckpointingConfig.from_dict(
+                pd.get(C.ACTIVATION_CHECKPOINTING)))
+        self.flops_profiler_config = FlopsProfilerConfig.from_dict(
+            pd.get(C.FLOPS_PROFILER))
+        self.tensorboard_config = TensorboardConfig.from_dict(
+            pd.get(C.TENSORBOARD))
+        self.eigenvalue_config = EigenvalueConfig.from_dict(pd.get(C.EIGENVALUE))
+        self.pld_config = PLDConfig.from_dict(pd.get(C.PROGRESSIVE_LAYER_DROP))
+        self.curriculum_config = CurriculumConfig.from_dict(
+            pd.get(C.CURRICULUM_LEARNING))
+        self.quantize_training_config = QuantizeTrainingConfig.from_dict(
+            pd.get(C.QUANTIZE_TRAINING))
+        self.checkpoint_config = CheckpointConfig.from_dict(pd.get(C.CHECKPOINT))
+        self.sparse_attention = pd.get(C.SPARSE_ATTENTION)
+        self.mesh_config = MeshConfig.from_dict(pd.get(C.MESH))
+        self.sequence_parallel_config = SequenceParallelConfig.from_dict(
+            pd.get(C.SEQUENCE_PARALLEL))
+        self.pipeline = pd.get(C.PIPELINE, {})
+        self.vocabulary_size = get_scalar_param(pd, C.VOCABULARY_SIZE,
+                                                C.VOCABULARY_SIZE_DEFAULT)
+
+    # ------------------------------------------------------------------ #
+    @property
+    def zero_enabled(self) -> bool:
+        return self.zero_config.stage > 0
+
+    @property
+    def zero_optimization_stage(self) -> int:
+        return self.zero_config.stage
+
+    @property
+    def quantize_training_enabled(self) -> bool:
+        return self.quantize_training_config.enabled
+
+    @property
+    def pld_enabled(self) -> bool:
+        return self.pld_config.enabled
+
+    @property
+    def curriculum_enabled(self) -> bool:
+        return self.curriculum_config.enabled
+
+    # ------------------------------------------------------------------ #
+    def _infer_batch_params(self) -> None:
+        """Resolve (train_batch, micro_batch, gas) given any subset
+        (reference: config.py:874-924)."""
+        train = self.train_batch_size
+        micro = self.train_micro_batch_size_per_gpu
+        gas = self.gradient_accumulation_steps
+        ws = self.world_size
+
+        if train is not None and micro is not None and gas is not None:
+            pass
+        elif train is not None and micro is not None:
+            gas = train // (micro * ws)
+        elif train is not None and gas is not None:
+            micro = train // (ws * gas)
+        elif micro is not None and gas is not None:
+            train = micro * gas * ws
+        elif train is not None:
+            gas = 1
+            micro = train // ws
+        elif micro is not None:
+            train = micro * ws
+            gas = 1
+        else:
+            raise DeepSpeedConfigError(
+                "Either train_batch_size or train_micro_batch_size_per_gpu "
+                "needs to be provided")
+
+        self.train_batch_size = train
+        self.train_micro_batch_size_per_gpu = micro
+        self.gradient_accumulation_steps = gas
+
+    def _batch_assertion(self) -> None:
+        train = self.train_batch_size
+        micro = self.train_micro_batch_size_per_gpu
+        gas = self.gradient_accumulation_steps
+        ws = self.world_size
+        if train <= 0:
+            raise DeepSpeedConfigError(
+                f"Train batch size: {train} has to be greater than 0")
+        if micro <= 0:
+            raise DeepSpeedConfigError(
+                f"Micro batch size per gpu: {micro} has to be greater than 0")
+        if gas <= 0:
+            raise DeepSpeedConfigError(
+                f"Gradient accumulation steps: {gas} has to be greater than 0")
+        if train != micro * gas * ws:
+            raise DeepSpeedConfigError(
+                f"Check batch related parameters. train_batch_size is not equal"
+                f" to micro_batch_per_gpu * gradient_acc_step * world_size "
+                f"{train} != {micro} * {gas} * {ws}")
+
+    def print_config(self, logger_fn=print) -> None:
+        logger_fn("DeepSpeedConfig:")
+        for k, v in sorted(self.__dict__.items()):
+            if k == "_param_dict":
+                continue
+            logger_fn("  {:40s} {}".format(k, v))

@@ -1,0 +1,134 @@
+"""Parallel-group registry with the reference's groups API shape.
+
+Reference: deepspeed/utils/groups.py — initialize():71 with scenarios
+D / E+D / M / E+D+M (:23-49) and the get_* accessors (:262-399).  On TPU a
+"group" is a tuple of mesh axis names: collectives take axis names, not
+communicator handles, so the accessors return the axis names to reduce over
+plus sizes/ranks derived from the mesh.
+"""
+
+from typing import Optional, Tuple
+
+from . import mesh as mesh_mod
+from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
+                   MeshContext)
+from ..utils.logging import log_dist
+
+
+def initialize(ep_size: int = 1, mpu=None, model_parallel_size: int = 1,
+               pipe_parallel_size: int = 1, seq_parallel_size: int = 1,
+               devices=None) -> MeshContext:
+    """Create the global mesh covering the reference's four scenarios:
+
+    - D:      data parallel only                        (ep=mp=pp=1)
+    - E+D:    expert + data parallel                    (ep>1)
+    - M:      model parallel                            (mp>1)
+    - E+D+M:  expert + data + model parallel
+
+    `mpu` parity: if a caller passes an object exposing
+    get_model_parallel_world_size(), honor it (reference: groups.py:87-113).
+    """
+    if mpu is not None and hasattr(mpu, "get_model_parallel_world_size"):
+        model_parallel_size = mpu.get_model_parallel_world_size()
+    ctx = mesh_mod.initialize_mesh(pipe=pipe_parallel_size, data=-1,
+                                   expert=ep_size, seq=seq_parallel_size,
+                                   model=model_parallel_size, devices=devices)
+    log_dist(f"initialized mesh {dict(ctx.mesh.shape)}", ranks=[0])
+    return ctx
+
+
+def is_initialized() -> bool:
+    return mesh_mod.get_mesh_context(required=False) is not None
+
+
+def _ctx() -> MeshContext:
+    return mesh_mod.get_mesh_context()
+
+
+# --- group accessors: return the mesh axis names a collective reduces over ---
+def get_data_parallel_group() -> Tuple[str, ...]:
+    """Dense (non-expert) params reduce over data AND expert axes —
+    the reference's data-parallel group spans the full DP world."""
+    return (DATA_AXIS, EXPERT_AXIS)
+
+
+def get_expert_parallel_group() -> Tuple[str, ...]:
+    return (EXPERT_AXIS,)
+
+
+def get_expert_data_parallel_group() -> Tuple[str, ...]:
+    """Expert params replicate over the leftover data axis only."""
+    return (DATA_AXIS,)
+
+
+def get_model_parallel_group() -> Tuple[str, ...]:
+    return (MODEL_AXIS,)
+
+
+def get_pipe_parallel_group() -> Tuple[str, ...]:
+    return (PIPE_AXIS,)
+
+
+def get_sequence_parallel_group() -> Tuple[str, ...]:
+    return (SEQ_AXIS,)
+
+
+# --- world sizes ---
+def get_data_parallel_world_size() -> int:
+    return _ctx().data_parallel_world_size
+
+
+def get_expert_parallel_world_size() -> int:
+    return _ctx().expert_parallel_world_size
+
+
+def get_expert_data_parallel_world_size() -> int:
+    return _ctx().expert_data_parallel_world_size
+
+
+def get_model_parallel_world_size() -> int:
+    return _ctx().model_parallel_world_size
+
+
+def get_pipe_parallel_world_size() -> int:
+    return _ctx().pipe_parallel_world_size
+
+
+def get_sequence_parallel_world_size() -> int:
+    return _ctx().seq_parallel_world_size
+
+
+def get_world_size() -> int:
+    return _ctx().world_size
+
+
+# --- ranks: meaningful under multi-process (one process per host); in a
+# single-process SPMD program the "rank" of the calling process is the index of
+# its first addressable device along the axis. ---
+def _axis_rank(axis: str) -> int:
+    import jax
+    ctx = _ctx()
+    dev = jax.local_devices()[0]
+    coords = {}
+    import numpy as np
+    idx = np.argwhere(ctx.mesh.devices == dev)
+    if idx.size == 0:
+        return 0
+    for name, i in zip(ctx.mesh.axis_names, idx[0]):
+        coords[name] = int(i)
+    return coords.get(axis, 0)
+
+
+def get_data_parallel_rank() -> int:
+    # The dense DP group spans data×expert, so the rank folds both coords
+    # (expert innermost, matching the mesh axis order).
+    return _axis_rank(DATA_AXIS) * _ctx().expert_parallel_world_size + _axis_rank(
+        EXPERT_AXIS)
+
+
+def get_model_parallel_rank() -> int:
+    return _axis_rank(MODEL_AXIS)
+
+
+def get_expert_parallel_rank() -> int:
+    return _axis_rank(EXPERT_AXIS)

@@ -1,0 +1,180 @@
+"""Named-axis device mesh — the TPU-native communication substrate.
+
+Plays the role of the reference's process-group bookkeeping
+(deepspeed/utils/groups.py:71 initialize, deepspeed/runtime/pipe/topology.py)
+— but instead of NCCL communicators, parallelism axes are named axes of a
+`jax.sharding.Mesh`, and collectives are XLA collectives (psum / all_gather /
+psum_scatter / all_to_all / ppermute) over those axes, riding ICI within a
+slice and DCN across slices.
+
+Axis layout (outer → inner): ``pipe, data, expert, seq, model``.
+- ``model`` innermost: tensor-parallel collectives are per-layer and
+  latency-bound, so they get the closest neighbors on ICI.
+- ``pipe`` outermost: stage p2p is bandwidth-light (one activation per
+  microbatch boundary).
+- ``expert`` subdivides what would otherwise be data-parallel replicas, exactly
+  like the reference's expert-parallel groups carved out of the DP world
+  (deepspeed/utils/groups.py:23-49 scenarios).
+"""
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+MESH_AXES = ("pipe", "data", "expert", "seq", "model")
+
+PIPE_AXIS = "pipe"
+DATA_AXIS = "data"
+EXPERT_AXIS = "expert"
+SEQ_AXIS = "seq"
+MODEL_AXIS = "model"
+
+# ZeRO shards over every axis that carries (expert-)data parallelism.
+ZERO_AXES = (DATA_AXIS, EXPERT_AXIS)
+
+
+@dataclass(frozen=True)
+class MeshShape:
+    pipe: int = 1
+    data: int = 1
+    expert: int = 1
+    seq: int = 1
+    model: int = 1
+
+    @property
+    def total(self) -> int:
+        return self.pipe * self.data * self.expert * self.seq * self.model
+
+    def as_tuple(self):
+        return (self.pipe, self.data, self.expert, self.seq, self.model)
+
+
+def resolve_mesh_shape(n_devices: int, pipe: int = 1, data: int = -1,
+                       expert: int = 1, seq: int = 1, model: int = 1) -> MeshShape:
+    """Resolve a mesh spec where exactly one axis may be -1 (= fill)."""
+    sizes = {"pipe": pipe, "data": data, "expert": expert, "seq": seq,
+             "model": model}
+    wild = [k for k, v in sizes.items() if v == -1]
+    if len(wild) > 1:
+        raise ValueError(f"Only one mesh axis may be -1, got {wild}")
+    fixed = int(np.prod([v for v in sizes.values() if v != -1]))
+    if wild:
+        if n_devices % fixed != 0:
+            raise ValueError(
+                f"{n_devices} devices not divisible by fixed mesh axes {sizes}")
+        sizes[wild[0]] = n_devices // fixed
+    shape = MeshShape(**sizes)
+    if shape.total != n_devices:
+        raise ValueError(
+            f"Mesh shape {shape} needs {shape.total} devices, have {n_devices}")
+    return shape
+
+
+class MeshContext:
+    """Owns the device mesh and answers the questions the reference answers via
+    groups.get_*_parallel_{rank,world_size,group} (utils/groups.py:262-399)."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    # -- factory ------------------------------------------------------- #
+    @staticmethod
+    def create(pipe: int = 1, data: int = -1, expert: int = 1, seq: int = 1,
+               model: int = 1,
+               devices: Optional[Sequence[jax.Device]] = None) -> "MeshContext":
+        devices = list(devices if devices is not None else jax.devices())
+        shape = resolve_mesh_shape(len(devices), pipe, data, expert, seq, model)
+        dev_array = np.asarray(devices).reshape(shape.as_tuple())
+        return MeshContext(Mesh(dev_array, MESH_AXES))
+
+    @staticmethod
+    def from_config(mesh_config, devices=None) -> "MeshContext":
+        return MeshContext.create(
+            pipe=mesh_config.pipe, data=mesh_config.data,
+            expert=mesh_config.expert, seq=mesh_config.seq,
+            model=mesh_config.model, devices=devices)
+
+    # -- sizes --------------------------------------------------------- #
+    def axis_size(self, axis: str) -> int:
+        return self.mesh.shape[axis]
+
+    @property
+    def data_parallel_world_size(self) -> int:
+        # Expert axis carves its replicas out of the DP world, so plain-dense
+        # data parallelism spans data×expert (reference scenario E+D).
+        return self.axis_size(DATA_AXIS) * self.axis_size(EXPERT_AXIS)
+
+    @property
+    def expert_parallel_world_size(self) -> int:
+        return self.axis_size(EXPERT_AXIS)
+
+    @property
+    def expert_data_parallel_world_size(self) -> int:
+        return self.axis_size(DATA_AXIS)
+
+    @property
+    def model_parallel_world_size(self) -> int:
+        return self.axis_size(MODEL_AXIS)
+
+    @property
+    def pipe_parallel_world_size(self) -> int:
+        return self.axis_size(PIPE_AXIS)
+
+    @property
+    def seq_parallel_world_size(self) -> int:
+        return self.axis_size(SEQ_AXIS)
+
+    @property
+    def world_size(self) -> int:
+        return self.mesh.size
+
+    # -- shardings ----------------------------------------------------- #
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def data_sharding(self, *trailing) -> NamedSharding:
+        """Batch-dim sharding over every data-carrying axis."""
+        return self.sharding((DATA_AXIS, EXPERT_AXIS), *trailing)
+
+    def __repr__(self):
+        return f"MeshContext({dict(self.mesh.shape)})"
+
+
+# ---------------------------------------------------------------------- #
+# Global mesh registry — the analog of deepspeed.utils.groups' module-level
+# group singletons (utils/groups.py:51-68).
+# ---------------------------------------------------------------------- #
+_MESH_CTX: Optional[MeshContext] = None
+
+
+def initialize_mesh(pipe: int = 1, data: int = -1, expert: int = 1, seq: int = 1,
+                    model: int = 1, devices=None) -> MeshContext:
+    global _MESH_CTX
+    _MESH_CTX = MeshContext.create(pipe=pipe, data=data, expert=expert, seq=seq,
+                                   model=model, devices=devices)
+    return _MESH_CTX
+
+
+def set_mesh_context(ctx: MeshContext) -> None:
+    global _MESH_CTX
+    _MESH_CTX = ctx
+
+
+def get_mesh_context(required: bool = True) -> Optional[MeshContext]:
+    if _MESH_CTX is None and required:
+        raise RuntimeError(
+            "Mesh is not initialized — call deepspeed_tpu.initialize(...) or "
+            "deepspeed_tpu.initialize_mesh(...) first")
+    return _MESH_CTX
+
+
+def reset_mesh_context() -> None:
+    global _MESH_CTX
+    _MESH_CTX = None

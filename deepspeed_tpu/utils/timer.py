@@ -1,0 +1,167 @@
+"""Wall-clock and throughput timers (reference: deepspeed/utils/timer.py:19).
+
+Where the reference synchronizes CUDA streams, we synchronize XLA's async
+dispatch queue: `_device_sync` runs a trivial computation and blocks on it,
+which (by in-order execution per device) drains previously dispatched work.
+"""
+
+import time
+from typing import Dict, List, Optional
+
+from .logging import log_dist
+
+
+def _device_sync():
+    try:
+        import jax
+        import jax.numpy as jnp
+        jnp.zeros(()).block_until_ready()
+        # effects_barrier waits for any outstanding host callbacks too.
+        try:
+            jax.effects_barrier()
+        except Exception:
+            pass
+    except Exception:
+        pass
+
+
+class SynchronizedWallClockTimer:
+    """Named timer group; `elapsed` drains the device queue before reading."""
+
+    class Timer:
+        def __init__(self, name: str):
+            self.name_ = name
+            self.elapsed_ = 0.0
+            self.started_ = False
+            self.start_time = time.time()
+
+        def start(self):
+            assert not self.started_, f"timer {self.name_} has already been started"
+            _device_sync()
+            self.start_time = time.time()
+            self.started_ = True
+
+        def stop(self, reset=False):
+            assert self.started_, "timer is not started"
+            _device_sync()
+            if reset:
+                self.elapsed_ = time.time() - self.start_time
+            else:
+                self.elapsed_ += time.time() - self.start_time
+            self.started_ = False
+
+        def reset(self):
+            self.elapsed_ = 0.0
+            self.started_ = False
+
+        def elapsed(self, reset=True):
+            started_ = self.started_
+            if started_:
+                self.stop()
+            elapsed_ = self.elapsed_
+            if reset:
+                self.reset()
+            if started_:
+                self.start()
+            return elapsed_
+
+        def mean(self):
+            return self.elapsed(reset=False)
+
+    def __init__(self):
+        self.timers: Dict[str, "SynchronizedWallClockTimer.Timer"] = {}
+
+    def __call__(self, name: str):
+        if name not in self.timers:
+            self.timers[name] = self.Timer(name)
+        return self.timers[name]
+
+    @staticmethod
+    def memory_usage():
+        try:
+            import jax
+            stats = jax.local_devices()[0].memory_stats() or {}
+            in_use = stats.get("bytes_in_use", 0)
+            peak = stats.get("peak_bytes_in_use", 0)
+            return (f"MemAllocated={in_use / 2**30:.2f} GB "
+                    f"MaxMemAllocated={peak / 2**30:.2f} GB")
+        except Exception:
+            return "MemAllocated=? MaxMemAllocated=?"
+
+    def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True,
+            memory_breakdown: bool = False, ranks: Optional[List[int]] = None):
+        assert normalizer > 0.0
+        string = "time (ms)"
+        for name in names:
+            if name in self.timers:
+                elapsed_time = self.timers[name].elapsed(
+                    reset=reset) * 1000.0 / normalizer
+                string += " | {}: {:.2f}".format(name, elapsed_time)
+        log_dist(string, ranks=ranks or [0])
+
+
+class ThroughputTimer:
+    """Samples/sec tracking (reference: deepspeed/utils/timer.py ThroughputTimer)."""
+
+    def __init__(self, batch_size, num_workers, start_step=2,
+                 steps_per_output=50, monitor_memory=False, logging_fn=None):
+        self.start_time = 0.0
+        self.end_time = 0.0
+        self.started = False
+        self.batch_size = max(1, batch_size)
+        self.num_workers = num_workers
+        self.start_step = start_step
+        self.epoch_count = 0
+        self.micro_step_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn or log_dist
+        self.initialized = False
+
+    def update_epoch_count(self):
+        self.epoch_count += 1
+        self.micro_step_count = 0
+
+    def _init_timer(self):
+        self.initialized = True
+
+    def start(self):
+        self._init_timer()
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            _device_sync()
+            self.start_time = time.time()
+
+    def stop(self, global_step=False, report_speed=True):
+        if not self.started:
+            return
+        self.started = False
+        self.micro_step_count += 1
+        if global_step:
+            self.global_step_count += 1
+        if self.start_time > 0:
+            _device_sync()
+            self.end_time = time.time()
+            duration = self.end_time - self.start_time
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            if global_step and report_speed and (
+                    self.global_step_count % self.steps_per_output == 0):
+                self.logging(
+                    "epoch={}/micro_step={}/global_step={}, "
+                    "RunningAvgSamplesPerSec={:.6g}, CurrSamplesPerSec={:.6g}".format(
+                        self.epoch_count, self.micro_step_count,
+                        self.global_step_count, self.avg_samples_per_sec(),
+                        self.batch_size / self.step_elapsed_time))
+                self.step_elapsed_time = 0.0
+
+    def avg_samples_per_sec(self):
+        if self.global_step_count > self.start_step:
+            samples_per_step = self.batch_size * self.num_workers
+            total_step_offset = self.global_step_count - self.start_step
+            avg_time_per_step = self.total_elapsed_time / max(1, total_step_offset)
+            return samples_per_step / avg_time_per_step
+        return float("-inf")

@@ -1,0 +1,27 @@
+"""Test harness: simulate an 8-device mesh on CPU so every collective path is
+testable without TPU hardware (improves on the reference, which has no fake
+backend — SURVEY.md §4)."""
+
+import os
+
+# Must be set before jax initializes its backends.  Note: the environment may
+# pre-import jax via sitecustomize, so the platform override must go through
+# jax.config (still honored pre-backend-init) rather than JAX_PLATFORMS.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_mesh():
+    yield
+    from deepspeed_tpu.parallel import reset_mesh_context
+    reset_mesh_context()

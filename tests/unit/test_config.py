@@ -1,0 +1,164 @@
+"""Config parsing tests (modeled on reference tests/unit/test_config.py)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.config import DeepSpeedConfig, DeepSpeedConfigError
+
+
+def base_config():
+    return {
+        "train_batch_size": 16,
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.001}},
+        "fp16": {"enabled": True},
+    }
+
+
+def test_batch_triple_all_given():
+    cfg = DeepSpeedConfig(base_config(), world_size=4)
+    assert cfg.train_batch_size == 16
+    assert cfg.train_micro_batch_size_per_gpu == 2
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_infer_gas():
+    d = base_config()
+    del d["gradient_accumulation_steps"]
+    cfg = DeepSpeedConfig(d, world_size=4)
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_infer_micro():
+    d = base_config()
+    del d["train_micro_batch_size_per_gpu"]
+    cfg = DeepSpeedConfig(d, world_size=4)
+    assert cfg.train_micro_batch_size_per_gpu == 2
+
+
+def test_batch_infer_train():
+    d = base_config()
+    del d["train_batch_size"]
+    cfg = DeepSpeedConfig(d, world_size=4)
+    assert cfg.train_batch_size == 16
+
+
+def test_batch_only_train_given():
+    cfg = DeepSpeedConfig({"train_batch_size": 8}, world_size=4)
+    assert cfg.train_micro_batch_size_per_gpu == 2
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_only_micro_given():
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 3}, world_size=4)
+    assert cfg.train_batch_size == 12
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_none_given():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({}, world_size=1)
+
+
+def test_batch_inconsistent():
+    d = base_config()
+    d["train_batch_size"] = 17
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig(d, world_size=4)
+
+
+def test_config_from_file(tmp_path):
+    p = tmp_path / "ds.json"
+    p.write_text(json.dumps(base_config()))
+    cfg = DeepSpeedConfig(str(p), world_size=4)
+    assert cfg.optimizer_name == "adam"
+    assert cfg.optimizer_params["lr"] == 0.001
+
+
+def test_config_duplicate_keys(tmp_path):
+    p = tmp_path / "dup.json"
+    p.write_text('{"train_batch_size": 8, "train_batch_size": 4}')
+    with pytest.raises(ValueError):
+        DeepSpeedConfig(str(p), world_size=1)
+
+
+def test_fp16_defaults():
+    cfg = DeepSpeedConfig(base_config(), world_size=4)
+    assert cfg.fp16.enabled
+    assert cfg.fp16.dynamic_loss_scale
+    assert cfg.fp16.initial_scale_power == 32
+    assert cfg.fp16.loss_scale_window == 1000
+
+
+def test_zero_config_stages():
+    for stage in (0, 1, 2, 3):
+        d = base_config()
+        d["zero_optimization"] = {"stage": stage}
+        cfg = DeepSpeedConfig(d, world_size=4)
+        assert cfg.zero_optimization_stage == stage
+        assert cfg.zero_enabled == (stage > 0)
+
+
+def test_zero_overlap_comm_stage_default():
+    d = base_config()
+    d["zero_optimization"] = {"stage": 3}
+    assert DeepSpeedConfig(d, world_size=4).zero_config.overlap_comm
+    d["zero_optimization"] = {"stage": 2}
+    assert not DeepSpeedConfig(d, world_size=4).zero_config.overlap_comm
+
+
+def test_zero_offload_legacy_flag():
+    d = base_config()
+    d["zero_optimization"] = {"stage": 2, "cpu_offload": True}
+    cfg = DeepSpeedConfig(d, world_size=4)
+    assert cfg.zero_config.offload_optimizer is not None
+    assert cfg.zero_config.offload_optimizer.device == "cpu"
+
+
+def test_zero_offload_dicts():
+    d = base_config()
+    d["zero_optimization"] = {
+        "stage": 3,
+        "offload_param": {"device": "nvme", "nvme_path": "/tmp/nvme",
+                          "buffer_count": 7},
+        "offload_optimizer": {"device": "nvme", "pipeline_read": True},
+    }
+    cfg = DeepSpeedConfig(d, world_size=4)
+    assert cfg.zero_config.offload_param.device == "nvme"
+    assert cfg.zero_config.offload_param.buffer_count == 7
+    assert cfg.zero_config.offload_optimizer.pipeline
+
+
+def test_scheduler_config():
+    d = base_config()
+    d["scheduler"] = {"type": "WarmupLR",
+                      "params": {"warmup_num_steps": 10}}
+    cfg = DeepSpeedConfig(d, world_size=4)
+    assert cfg.scheduler_name == "WarmupLR"
+    assert cfg.scheduler_params["warmup_num_steps"] == 10
+
+
+def test_bf16_config():
+    d = base_config()
+    del d["fp16"]
+    d["bf16"] = {"enabled": True}
+    cfg = DeepSpeedConfig(d, world_size=4)
+    assert cfg.bf16.enabled and not cfg.fp16.enabled
+
+
+def test_aio_defaults():
+    cfg = DeepSpeedConfig(base_config(), world_size=4)
+    assert cfg.aio_config.block_size == 1048576
+    assert cfg.aio_config.queue_depth == 8
+    assert cfg.aio_config.overlap_events
+
+
+def test_mesh_config():
+    d = base_config()
+    d["mesh"] = {"model": 2, "pipe": 2}
+    cfg = DeepSpeedConfig(d, world_size=4)
+    assert cfg.mesh_config.model == 2
+    assert cfg.mesh_config.pipe == 2
+    assert cfg.mesh_config.data == -1

@@ -1,0 +1,80 @@
+"""Elasticity solver tests (modeled on reference tests/unit/test_elastic.py)."""
+
+import pytest
+
+import deepspeed_tpu.elasticity as el
+from deepspeed_tpu.config import DeepSpeedConfig
+
+
+def base_ds_config():
+    return {
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 10000,
+            "micro_batch_sizes": [8, 12, 16, 17],
+            "min_gpus": 32,
+            "max_gpus": 1500,
+            "min_time": 20,
+            "version": 0.1,
+        }
+    }
+
+
+def test_basic_10k():
+    final_batch, valid_gpus = el.compute_elastic_config(base_ds_config())
+    for gpus in valid_gpus:
+        assert final_batch % gpus == 0, f"{final_batch} not divisible by {gpus}"
+        micros = base_ds_config()["elasticity"]["micro_batch_sizes"]
+        assert any((final_batch // gpus) % mb == 0 for mb in micros)
+    assert 32 <= min(valid_gpus)
+    assert max(valid_gpus) <= 1500
+
+
+def test_target_world_size_valid():
+    _, valid_gpus = el.compute_elastic_config(base_ds_config())
+    ws = valid_gpus[len(valid_gpus) // 2]
+    final_batch, valid_gpus2, micro = el.compute_elastic_config(
+        base_ds_config(), world_size=ws)
+    assert ws in valid_gpus2
+    assert final_batch % ws == 0
+    assert (final_batch // ws) % micro == 0
+
+
+def test_invalid_world_size():
+    _, valid_gpus = el.compute_elastic_config(base_ds_config())
+    bad = max(valid_gpus) + 1
+    while bad in valid_gpus:
+        bad += 1
+    with pytest.raises(el.ElasticityIncompatibleWorldSize):
+        el.compute_elastic_config(base_ds_config(), world_size=bad)
+
+
+def test_future_version_rejected():
+    d = base_ds_config()
+    d["elasticity"]["version"] = 0.2
+    with pytest.raises(el.ElasticityConfigError):
+        el.compute_elastic_config(d)
+
+
+def test_missing_fields():
+    with pytest.raises(el.ElasticityConfigError):
+        el.compute_elastic_config({"elasticity": {"enabled": True}})
+
+
+def test_non_elastic_batch_info_rejected():
+    d = base_ds_config()
+    d["train_batch_size"] = 4
+    d["elasticity"]["min_gpus"] = 1
+    d["elasticity"]["max_gpus"] = 4
+    with pytest.raises(el.ElasticityConfigError):
+        DeepSpeedConfig(d, world_size=2)
+
+
+def test_config_rewrites_batch_keys():
+    d = base_ds_config()
+    d["elasticity"]["min_gpus"] = 1
+    d["elasticity"]["max_gpus"] = 4
+    cfg = DeepSpeedConfig(d, world_size=2)
+    assert cfg.elasticity_enabled
+    assert cfg.train_batch_size == (cfg.train_micro_batch_size_per_gpu *
+                                    cfg.gradient_accumulation_steps * 2)
