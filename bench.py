@@ -1,0 +1,84 @@
+"""Benchmark entry point: prints ONE JSON line
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Current benchmark: engine train-step throughput on the real chip (placeholder
+until the GPT-2 flagship bench lands).  Baseline anchor: reference BERT-large
+seq128 on 1×V100 = 272 samples/s (BASELINE.md).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+
+    hidden = 1024
+    layers = 8
+    batch = 64
+
+    rng = np.random.RandomState(0)
+    params = {}
+    for i in range(layers):
+        params[f"layer_{i}"] = {
+            "w": jnp.asarray(rng.normal(0, 0.02, (hidden, hidden)),
+                             jnp.float32),
+            "b": jnp.zeros((hidden,), jnp.float32),
+        }
+    params["head"] = {"w": jnp.asarray(rng.normal(0, 0.02, (hidden, 1)),
+                                       jnp.float32),
+                      "b": jnp.zeros((1,), jnp.float32)}
+
+    def apply_fn(p, rng_, x, y):
+        h = x
+        for i in range(layers):
+            h = jax.nn.relu(h @ p[f"layer_{i}"]["w"] + p[f"layer_{i}"]["b"])
+        pred = h @ p["head"]["w"] + p["head"]["b"]
+        return jnp.mean((pred.squeeze(-1) - y) ** 2)
+
+    config = {
+        "train_micro_batch_size_per_gpu": batch,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = ds.initialize(model=apply_fn, config=config,
+                                    model_parameters=params)
+    x = np.asarray(rng.normal(0, 1, (batch, hidden)), np.float32)
+    y = np.asarray(rng.normal(0, 1, (batch,)), np.float32)
+
+    def step():
+        loss = engine.forward(x, y)
+        engine.backward(loss)
+        engine.step()
+        return loss
+
+    # warmup / compile
+    for _ in range(3):
+        step()
+    jnp.zeros(()).block_until_ready()
+
+    n = 50
+    t0 = time.time()
+    for _ in range(n):
+        step()
+    jnp.zeros(()).block_until_ready()
+    dt = time.time() - t0
+    samples_per_sec = n * batch / dt
+
+    print(json.dumps({
+        "metric": "mlp_train_samples_per_sec_1chip",
+        "value": round(samples_per_sec, 2),
+        "unit": "samples/s",
+        "vs_baseline": round(samples_per_sec / 272.0, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from .engine import DeepSpeedEngine
+from .lr_schedules import (LRRangeTest, OneCycle, WarmupDecayLR, WarmupLR,
+                           get_lr_schedule)

@@ -1,0 +1,173 @@
+"""Sharded checkpoint save/load with a `latest` tag.
+
+Reference layout (deepspeed/runtime/engine.py:1821-1878, 2129-2430):
+  <dir>/<tag>/mp_rank_XX_model_states.*          — module weights + engine meta
+  <dir>/<tag>/zero_pp_rank_D_mp_rank_XX_optim_states.*  — optimizer shards
+  <dir>/latest                                   — text file naming the tag
+
+TPU-native storage: pytrees are flattened to {path-string: array} and written
+as .npz (bf16 arrays round-trip via ml_dtypes).  `np.asarray` on a sharded
+jax.Array gathers it, so a single-process save is already consolidated — the
+`zero_to_fp32` offline tool (utils/zero_to_fp32.py:281 in the reference)
+reduces to a dtype cast here, provided as `consolidate_to_fp32`.  Restore maps
+arrays back onto a template pytree and re-applies its shardings, which also
+gives resharding-on-load (dp/mp resize) for free: the template carries the
+*new* topology's shardings.
+"""
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+
+LATEST_FILE = "latest"
+
+
+def _flatten(tree: Any) -> Dict[str, np.ndarray]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = jax.tree_util.keystr(path)
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+def _unflatten_into(template: Any, flat: Dict[str, np.ndarray],
+                    strict: bool = True) -> Any:
+    paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+    new_leaves = []
+    missing = []
+    for path, leaf in paths_and_leaves:
+        key = jax.tree_util.keystr(path)
+        if key in flat:
+            arr = flat[key]
+            dtype = getattr(leaf, "dtype", arr.dtype)
+            new_leaves.append(np.asarray(arr).astype(dtype))
+        elif strict:
+            missing.append(key)
+        else:
+            new_leaves.append(leaf)
+    if missing:
+        raise KeyError(f"Checkpoint missing {len(missing)} keys, e.g. "
+                       f"{missing[:5]}")
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def _resharded(template: Any, restored: Any) -> Any:
+    """device_put each restored leaf with the template leaf's sharding."""
+    def one(tmpl, arr):
+        sharding = getattr(tmpl, "sharding", None)
+        if sharding is not None:
+            return jax.device_put(arr, sharding)
+        return arr
+    return jax.tree.map(one, template, restored)
+
+
+def save_checkpoint_state(save_dir: str, tag: str, module_state: Any,
+                          optimizer_state: Any = None,
+                          client_state: Optional[Dict] = None,
+                          mp_rank: int = 0, dp_rank: int = 0) -> str:
+    """Write one checkpoint under <save_dir>/<tag>/ and update `latest`."""
+    ckpt_dir = os.path.join(save_dir, str(tag))
+    os.makedirs(ckpt_dir, exist_ok=True)
+
+    model_file = os.path.join(ckpt_dir,
+                              f"mp_rank_{mp_rank:02d}_model_states.npz")
+    np.savez(model_file, **_flatten(module_state))
+
+    if optimizer_state is not None:
+        optim_file = os.path.join(
+            ckpt_dir,
+            f"zero_pp_rank_{dp_rank}_mp_rank_{mp_rank:02d}_optim_states.npz")
+        np.savez(optim_file, **_flatten(optimizer_state))
+
+    meta = {"client_state": _jsonable(client_state or {})}
+    with open(os.path.join(ckpt_dir, "ds_meta.json"), "w") as f:
+        json.dump(meta, f)
+
+    with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+        f.write(str(tag))
+    return ckpt_dir
+
+
+def read_latest_tag(load_dir: str) -> Optional[str]:
+    latest_path = os.path.join(load_dir, LATEST_FILE)
+    if os.path.isfile(latest_path):
+        with open(latest_path) as f:
+            return f.read().strip()
+    return None
+
+
+def load_checkpoint_state(load_dir: str, tag: Optional[str],
+                          module_template: Any,
+                          optimizer_template: Any = None,
+                          mp_rank: int = 0, dp_rank: int = 0,
+                          strict: bool = True
+                          ) -> Tuple[Any, Any, Dict]:
+    """Load <load_dir>/<tag>/ back onto the provided templates (returns
+    (module_state, optimizer_state, client_state))."""
+    if tag is None:
+        tag = read_latest_tag(load_dir)
+        if tag is None:
+            raise FileNotFoundError(
+                f"Unable to find '{LATEST_FILE}' file at {load_dir}")
+    ckpt_dir = os.path.join(load_dir, str(tag))
+
+    model_file = os.path.join(ckpt_dir,
+                              f"mp_rank_{mp_rank:02d}_model_states.npz")
+    with np.load(model_file, allow_pickle=False) as data:
+        flat = {k: data[k] for k in data.files}
+    module_state = _resharded(
+        module_template, _unflatten_into(module_template, flat, strict=strict))
+
+    optimizer_state = None
+    if optimizer_template is not None:
+        optim_file = os.path.join(
+            ckpt_dir,
+            f"zero_pp_rank_{dp_rank}_mp_rank_{mp_rank:02d}_optim_states.npz")
+        if os.path.isfile(optim_file):
+            with np.load(optim_file, allow_pickle=False) as data:
+                flat_o = {k: data[k] for k in data.files}
+            optimizer_state = _resharded(
+                optimizer_template,
+                _unflatten_into(optimizer_template, flat_o, strict=strict))
+
+    client_state = {}
+    meta_file = os.path.join(ckpt_dir, "ds_meta.json")
+    if os.path.isfile(meta_file):
+        with open(meta_file) as f:
+            client_state = json.load(f).get("client_state", {})
+    return module_state, optimizer_state, client_state
+
+
+def consolidate_to_fp32(ckpt_dir: str, tag: Optional[str] = None,
+                        output_file: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """zero_to_fp32 analog (reference: deepspeed/utils/zero_to_fp32.py:281):
+    produce a single fp32 weight dict from a checkpoint."""
+    if tag is None:
+        tag = read_latest_tag(ckpt_dir)
+    model_file = os.path.join(ckpt_dir, str(tag), "mp_rank_00_model_states.npz")
+    with np.load(model_file, allow_pickle=False) as data:
+        weights = {k: np.asarray(data[k], dtype=np.float32)
+                   for k in data.files}
+    if output_file:
+        np.savez(output_file, **weights)
+    return weights
+
+
+def _jsonable(obj):
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if hasattr(obj, "item") and getattr(obj, "ndim", 1) == 0:
+        return obj.item()
+    return obj

@@ -1,0 +1,27 @@
+"""1-bit (communication-compressed) optimizers — placeholder wiring.
+
+Reference: deepspeed/runtime/fp16/onebit/adam.py:14 (OnebitAdam),
+onebit/lamb.py:471 (OnebitLamb), runtime/comm/nccl.py:47
+(compressed_allreduce = sign compression + error feedback).
+
+The full TPU implementation (sign-compressed psum with error feedback inside
+shard_map over the data axis) lands with the comm subsystem; until then the
+optimizer math falls back to uncompressed Adam/LAMB so configs referencing
+OneBitAdam still train correctly (warmup behavior == full-precision stage).
+"""
+
+from ...utils.logging import logger
+
+
+def build_onebit_optimizer(name, cfg, lr):
+    import optax
+    logger.warning(
+        f"{name}: compressed-communication stage not yet wired; running the "
+        f"full-precision (warmup-equivalent) path")
+    betas = cfg.get("betas", (0.9, 0.999))
+    if "lamb" in name:
+        from ..optimizers import _lamb
+        return _lamb(lr, b1=betas[0], b2=betas[1],
+                     eps=cfg.get("eps", 1e-6),
+                     weight_decay=cfg.get("weight_decay", 0.0))
+    return optax.adam(lr, b1=betas[0], b2=betas[1], eps=cfg.get("eps", 1e-8))

@@ -1,0 +1,653 @@
+"""DeepSpeedEngine — the TPU-native training engine.
+
+Reference: deepspeed/runtime/engine.py:101 (class DeepSpeedEngine) with the
+forward (:1224) / backward (:1303) / step (:1462) API, config accessors,
+gradient-accumulation loss scaling (:1204), checkpoint save/load (:1880-2430).
+
+TPU-native architecture: instead of an nn.Module wrapper with autograd hooks,
+the engine owns
+  - fp32 master parameters as a sharded pytree (ZeRO stage decides sharding),
+  - an optax optimizer whose state is sharded per stage,
+  - three compiled programs:
+      _grad_fn   — value_and_grad of the (loss-scaled) model loss; XLA turns
+                   the data-parallel gradient reduction into an all-reduce
+                   (stage ≤1) or reduce-scatter (stage ≥2) from the output
+                   shardings alone (the hand-written IPG bucketing of
+                   stage2.py:781 is the compiler's job here),
+      _acc_fn    — gradient accumulation add (micro-batching),
+      _apply_fn  — unscale → overflow check → optax update → loss-scale
+                   update, all under lax.cond so an overflow skips the step
+                   on-device exactly like stage2.py:1783-1850.
+The user-facing forward/backward/step protocol is preserved: forward runs the
+compiled grad step and caches grads; backward accumulates; step applies at
+gradient-accumulation boundaries.
+"""
+
+import os
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from ..config import DeepSpeedConfig
+from ..parallel import mesh as mesh_mod
+from ..parallel.mesh import MeshContext
+from ..utils.logging import log_dist, logger
+from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+from . import checkpoint as ckpt_mod
+from .dataloader import DeepSpeedDataLoader
+from .fp16.loss_scaler import (LossScaleState, create_loss_scaler,
+                               update_loss_scale)
+from .lr_schedules import get_lr_schedule
+from .optimizers import build_optimizer
+from .zero.partition import ZeroPartitioner
+
+FORWARD_MICRO_TIMER = "forward_microstep"
+FORWARD_GLOBAL_TIMER = "forward"
+BACKWARD_MICRO_TIMER = "backward_microstep"
+BACKWARD_GLOBAL_TIMER = "backward"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+
+
+def _tree_cast(tree, dtype):
+    return jax.tree.map(
+        lambda x: x.astype(dtype) if hasattr(x, "astype") and jnp.issubdtype(
+            x.dtype, jnp.floating) else x, tree)
+
+
+class DeepSpeedEngine:
+    """Config-driven training engine over a named-axis TPU mesh."""
+
+    def __init__(self, model=None, config=None, optimizer=None,
+                 model_parameters=None, lr_scheduler=None, mesh=None, mpu=None,
+                 training_data=None, collate_fn=None, rng=None,
+                 dont_change_device=False):
+        self.module = model
+        self.client_optimizer = optimizer
+        self.client_lr_scheduler = lr_scheduler
+        self.global_steps = 0
+        self.micro_steps = 0
+        self.skipped_steps = 0
+        self.param_specs = None  # tensor-parallel base specs (set by TP layer)
+
+        # ---- mesh ---------------------------------------------------- #
+        # Only the mesh block may be read before the mesh exists (a full
+        # config parse would run the batch assertion with the wrong world
+        # size).
+        if mesh is None:
+            existing = mesh_mod.get_mesh_context(required=False)
+            if existing is not None:
+                self.mesh_ctx = existing
+            else:
+                from ..config import MeshConfig
+                from ..config_utils import load_config_dict
+                from .. import constants as C
+                raw = (config._param_dict if isinstance(config, DeepSpeedConfig)
+                       else load_config_dict(config))
+                mesh_cfg = MeshConfig.from_dict(raw.get(C.MESH))
+                self.mesh_ctx = MeshContext.from_config(mesh_cfg)
+                mesh_mod.set_mesh_context(self.mesh_ctx)
+        elif isinstance(mesh, MeshContext):
+            self.mesh_ctx = mesh
+            mesh_mod.set_mesh_context(self.mesh_ctx)
+        else:  # raw jax Mesh
+            self.mesh_ctx = MeshContext(mesh)
+            mesh_mod.set_mesh_context(self.mesh_ctx)
+
+        dp_world = self.mesh_ctx.data_parallel_world_size
+        self.config = (config if isinstance(config, DeepSpeedConfig)
+                       else DeepSpeedConfig(config, world_size=dp_world))
+        self.world_size = dp_world
+
+        # ---- precision ----------------------------------------------- #
+        if self.config.bf16.enabled:
+            self.compute_dtype = jnp.bfloat16
+        elif self.config.fp16.enabled:
+            self.compute_dtype = jnp.float16
+        else:
+            self.compute_dtype = jnp.float32
+        self.scaler_cfg, scaler_state = create_loss_scaler(
+            self.config.fp16 if self.config.fp16.enabled else None)
+
+        # ---- model apply fn ------------------------------------------ #
+        self._apply_model = self._make_apply_fn(model)
+        if model_parameters is None:
+            model_parameters = getattr(model, "params", None)
+        if model_parameters is None:
+            raise ValueError(
+                "model_parameters (a pytree of weights) is required — in JAX "
+                "parameters live outside the module")
+
+        # ---- ZeRO sharding ------------------------------------------- #
+        stage = self.config.zero_optimization_stage
+        self.zero_partitioner = ZeroPartitioner(
+            self.mesh_ctx, stage,
+            persistence_threshold=self.config.zero_config.
+            param_persistence_threshold)
+        self.param_shardings = self.zero_partitioner.param_shardings(
+            model_parameters, self.param_specs)
+        self.grad_shardings = self.zero_partitioner.grad_shardings(
+            model_parameters, self.param_specs)
+
+        # fp32 master weights, placed with their ZeRO sharding
+        # (reference: stage3.py:1257 fp32 partition creation).  Force a copy:
+        # the engine donates its param buffers every step, and a no-copy
+        # astype/device_put would let that donation delete the caller's arrays.
+        def _own_master(x):
+            dtype = (jnp.float32 if jnp.issubdtype(
+                jnp.asarray(x).dtype, jnp.floating) else None)
+            return jnp.array(x, dtype=dtype)
+        master = jax.tree.map(_own_master, model_parameters)
+        self.params = jax.tree.map(jax.device_put, master, self.param_shardings)
+
+        # ---- LR schedule + optimizer --------------------------------- #
+        self.lr_scheduler = self._configure_lr_scheduler(lr_scheduler)
+        schedule = (self.lr_scheduler.lr_at if self.lr_scheduler is not None
+                    else None)
+        if optimizer is not None and not callable(getattr(
+                optimizer, "update", None)):
+            raise ValueError("optimizer must be an optax GradientTransformation")
+        self.tx = optimizer if optimizer is not None else build_optimizer(
+            self.config.optimizer_name or "adam",
+            self.config.optimizer_params,
+            learning_rate=schedule,
+            gradient_clipping=self.config.gradient_clipping)
+
+        opt_shapes = jax.eval_shape(self.tx.init, self.params)
+        self.opt_shardings = self.zero_partitioner.opt_state_shardings(
+            opt_shapes, self.params, self.param_specs)
+        self.opt_state = jax.jit(
+            self.tx.init, out_shardings=self.opt_shardings)(self.params)
+        self.scaler_state = jax.device_put(
+            scaler_state, self.mesh_ctx.replicated())
+
+        # ---- compiled programs --------------------------------------- #
+        self._build_functions()
+
+        # ---- data ---------------------------------------------------- #
+        self.training_dataloader = self._configure_dataloader(
+            training_data, collate_fn)
+        self._rng = rng if rng is not None else jax.random.PRNGKey(42)
+
+        # ---- bookkeeping --------------------------------------------- #
+        self.timers = SynchronizedWallClockTimer()
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.train_micro_batch_size_per_gpu(),
+            num_workers=self.world_size,
+            steps_per_output=self.steps_per_print())
+        self._grad_acc = None
+        self._cached_grads = None
+        self._last_loss = None
+        self._last_overflow = None
+        self._summary_writer = self._configure_tensorboard()
+        self._is_train_mode = True
+
+        log_dist(
+            f"DeepSpeedEngine: zero_stage={stage} dtype={self.compute_dtype} "
+            f"mesh={dict(self.mesh_ctx.mesh.shape)} "
+            f"micro_batch={self.train_micro_batch_size_per_gpu()} "
+            f"gas={self.gradient_accumulation_steps()}", ranks=[0])
+
+    # ------------------------------------------------------------------ #
+    # configuration accessors (reference: engine.py:260-540)
+    # ------------------------------------------------------------------ #
+    def train_batch_size(self):
+        return self.config.train_batch_size
+
+    def train_micro_batch_size_per_gpu(self):
+        return self.config.train_micro_batch_size_per_gpu
+
+    def gradient_accumulation_steps(self):
+        return self.config.gradient_accumulation_steps
+
+    def steps_per_print(self):
+        return self.config.steps_per_print
+
+    def zero_optimization(self):
+        return self.config.zero_enabled
+
+    def zero_optimization_stage(self):
+        return self.config.zero_optimization_stage
+
+    def gradient_clipping(self):
+        return self.config.gradient_clipping
+
+    def fp16_enabled(self):
+        return self.config.fp16.enabled
+
+    def bfloat16_enabled(self):
+        return self.config.bf16.enabled
+
+    def wall_clock_breakdown(self):
+        return self.config.wall_clock_breakdown
+
+    def dynamic_loss_scale(self):
+        return self.scaler_cfg.dynamic
+
+    @property
+    def optimizer(self):
+        return self.tx
+
+    @property
+    def loss_scale(self):
+        return float(self.scaler_state.loss_scale)
+
+    def get_lr(self):
+        step = self._applied_step_count()
+        if self.lr_scheduler is not None:
+            return [float(self.lr_scheduler.lr_at(step))]
+        return [float(self.config.optimizer_params.get("lr", 1e-3))]
+
+    def _applied_step_count(self):
+        counts = [np.asarray(x) for x in jax.tree.leaves(self.opt_state)
+                  if getattr(x, "dtype", None) == jnp.int32 and
+                  getattr(x, "ndim", None) == 0]
+        return int(counts[0]) if counts else self.global_steps
+
+    def is_gradient_accumulation_boundary(self) -> bool:
+        return self.micro_steps % self.gradient_accumulation_steps() == 0
+
+    def train(self, mode: bool = True):
+        self._is_train_mode = mode
+        return self
+
+    def eval(self):
+        return self.train(False)
+
+    # ------------------------------------------------------------------ #
+    # construction helpers
+    # ------------------------------------------------------------------ #
+    def _make_apply_fn(self, model) -> Callable:
+        if model is None:
+            raise ValueError("deepspeed_tpu.initialize requires a model")
+        if hasattr(model, "apply") and hasattr(model, "init"):
+            # flax linen module: module.apply returns the loss (same contract
+            # as the reference, where the wrapped nn.Module returns loss)
+            def apply_fn(params, rng, *args, **kwargs):
+                return model.apply({"params": params}, *args,
+                                   rngs={"dropout": rng}, **kwargs)
+            return apply_fn
+        if callable(model):
+            # pure function: model(params, rng, *args, **kwargs) -> loss
+            return model
+        raise TypeError(f"Unsupported model type {type(model)}")
+
+    def _configure_lr_scheduler(self, client_sched):
+        if client_sched is not None:
+            if not callable(client_sched) and not hasattr(client_sched, "lr_at"):
+                raise TypeError(
+                    "lr_scheduler must expose lr_at(step)->lr (jit-traceable) "
+                    "or be a bare step->lr callable; a get_lr()-only scheduler "
+                    "cannot be traced into the compiled optimizer step")
+            if callable(client_sched) and not hasattr(client_sched, "lr_at"):
+                # bare schedule fn step->lr
+                class _Wrap:
+                    def __init__(self, fn):
+                        self.fn = fn
+                        self.last_batch_iteration = -1
+
+                    def lr_at(self, step):
+                        return self.fn(step)
+
+                    def step(self, *a, **k):
+                        self.last_batch_iteration += 1
+
+                    def state_dict(self):
+                        return {"last_batch_iteration":
+                                self.last_batch_iteration}
+
+                    def load_state_dict(self, sd):
+                        self.last_batch_iteration = sd["last_batch_iteration"]
+                return _Wrap(client_sched)
+            return client_sched
+        if self.config.scheduler_name is not None:
+            return get_lr_schedule(self.config.scheduler_name,
+                                   self.config.scheduler_params)
+        return None
+
+    def _configure_dataloader(self, training_data, collate_fn):
+        if training_data is None:
+            return None
+        # One yield == one micro step.  Single-controller: the loader yields
+        # the global micro batch.  Multi-host: each process yields only its
+        # 1/process_count slice; _shard_batch assembles the global array.
+        nproc = jax.process_count()
+        per_process = (self.train_micro_batch_size_per_gpu() *
+                       self.world_size) // nproc
+        return DeepSpeedDataLoader(
+            training_data, batch_size=per_process, collate_fn=collate_fn,
+            data_parallel_world_size=nproc,
+            data_parallel_rank=jax.process_index())
+
+    def _configure_tensorboard(self):
+        tb = self.config.tensorboard_config
+        if not tb.enabled:
+            return None
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            path = os.path.join(tb.output_path or "./runs", tb.job_name)
+            return SummaryWriter(log_dir=path)
+        except Exception as e:  # pragma: no cover
+            logger.warning(f"tensorboard unavailable: {e}")
+            return None
+
+    # ------------------------------------------------------------------ #
+    # compiled programs
+    # ------------------------------------------------------------------ #
+    def _build_functions(self):
+        gas = self.gradient_accumulation_steps()
+        compute_dtype = self.compute_dtype
+        apply_model = self._apply_model
+        tx = self.tx
+        scaler_cfg = self.scaler_cfg
+        prescale = self.config.prescale_gradients
+        predivide = self.config.gradient_predivide_factor
+
+        def loss_and_grads(params, scaler_state, rng, *args, **kwargs):
+            # inputs follow the compute dtype too — otherwise f32 activations
+            # silently promote every matmul back to f32 and the MXU runs fp32
+            args = _tree_cast(args, compute_dtype)
+            kwargs = _tree_cast(kwargs, compute_dtype)
+
+            def loss_fn(p):
+                cp = _tree_cast(p, compute_dtype)
+                out = apply_model(cp, rng, *args, **kwargs)
+                if isinstance(out, tuple):
+                    loss = out[0]
+                else:
+                    loss = out
+                scaled = (loss.astype(jnp.float32) *
+                          scaler_state.loss_scale)
+                return scaled, loss
+            (_, loss), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            if prescale and predivide:
+                grads = jax.tree.map(lambda g: g / predivide, grads)
+            return loss, grads
+
+        replicated = self.mesh_ctx.replicated()
+        self._grad_fn = jax.jit(
+            loss_and_grads,
+            out_shardings=(replicated, self.grad_shardings))
+
+        def accumulate(acc, grads):
+            return jax.tree.map(jnp.add, acc, grads)
+
+        self._acc_fn = jax.jit(
+            accumulate, out_shardings=self.grad_shardings,
+            donate_argnums=(0,))
+
+        def apply_step(params, opt_state, scaler_state, grads):
+            inv = 1.0 / (scaler_state.loss_scale * gas)
+            grads = jax.tree.map(
+                lambda g: g.astype(jnp.float32) * inv, grads)
+            finite = jnp.array(True)
+            for g in jax.tree.leaves(grads):
+                finite &= jnp.all(jnp.isfinite(g))
+            overflow = ~finite
+
+            def do_step(operand):
+                p, o, g = operand
+                updates, new_o = tx.update(g, o, p)
+                new_p = optax.apply_updates(p, updates)
+                return new_p, new_o
+
+            def skip_step(operand):
+                p, o, _ = operand
+                return p, o
+
+            new_params, new_opt = lax.cond(
+                finite, do_step, skip_step, (params, opt_state, grads))
+            new_scaler = update_loss_scale(scaler_cfg, scaler_state, overflow)
+            return new_params, new_opt, new_scaler, overflow
+
+        self._apply_fn = jax.jit(
+            apply_step,
+            out_shardings=(self.param_shardings, self.opt_shardings,
+                           replicated, replicated),
+            donate_argnums=(0, 1, 3))
+
+    # ------------------------------------------------------------------ #
+    # data placement
+    # ------------------------------------------------------------------ #
+    def _shard_batch(self, tree):
+        dp = self.world_size
+        multihost = jax.process_count() > 1
+
+        def place(x):
+            if multihost:
+                # x is this process's slice of the global batch
+                x = np.asarray(x)
+                if x.ndim >= 1:
+                    return jax.make_array_from_process_local_data(
+                        self.mesh_ctx.data_sharding(), x)
+                return jax.make_array_from_process_local_data(
+                    self.mesh_ctx.replicated(), x)
+            x = jnp.asarray(x) if not isinstance(x, jax.Array) else x
+            if getattr(x, "ndim", 0) >= 1 and x.shape[0] % dp == 0:
+                return jax.device_put(x, self.mesh_ctx.data_sharding())
+            return jax.device_put(x, self.mesh_ctx.replicated())
+        return jax.tree.map(place, tree)
+
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    # ------------------------------------------------------------------ #
+    # forward / backward / step (reference: engine.py:1224,1303,1462)
+    # ------------------------------------------------------------------ #
+    def forward(self, *args, **kwargs):
+        """Run the fused loss+grad program; returns the (unscaled) loss.
+
+        The gradient work rides along with forward (one compiled program)
+        instead of a separate autograd pass — backward() then only
+        accumulates.  This keeps the DeepSpeed call protocol while staying
+        single-dispatch on TPU."""
+        if self.wall_clock_breakdown():
+            self.timers(FORWARD_MICRO_TIMER).start()
+        if self._is_train_mode:
+            self.tput_timer.start()
+        batch = self._shard_batch((args, kwargs))
+        args, kwargs = batch
+        loss, grads = self._grad_fn(self.params, self.scaler_state,
+                                    self._next_rng(), *args, **kwargs)
+        self._cached_grads = grads
+        self._last_loss = loss
+        if self.wall_clock_breakdown():
+            self.timers(FORWARD_MICRO_TIMER).stop()
+        return loss
+
+    __call__ = forward
+
+    def backward(self, loss=None, allreduce_gradients=True, release_loss=False):
+        """Accumulate the cached gradients (reference: engine.py:1303).
+
+        The data-parallel reduction already happened inside the compiled grad
+        program (XLA collective), so this is purely the GAS accumulation."""
+        assert self._cached_grads is not None, \
+            "backward() called before forward()"
+        if self.wall_clock_breakdown():
+            self.timers(BACKWARD_MICRO_TIMER).start()
+        if self._grad_acc is None:
+            self._grad_acc = self._cached_grads
+        else:
+            self._grad_acc = self._acc_fn(self._grad_acc, self._cached_grads)
+        self._cached_grads = None
+        self.micro_steps += 1
+        if self.wall_clock_breakdown():
+            self.timers(BACKWARD_MICRO_TIMER).stop()
+        return loss if loss is not None else self._last_loss
+
+    def step(self, lr_kwargs=None):
+        """Apply the optimizer at gradient-accumulation boundaries
+        (reference: engine.py:1462 → _take_model_step:1413)."""
+        if not self.is_gradient_accumulation_boundary():
+            return
+        assert self._grad_acc is not None, "step() called before backward()"
+        if self.wall_clock_breakdown():
+            self.timers(STEP_MICRO_TIMER).start()
+
+        (self.params, self.opt_state, self.scaler_state,
+         overflow) = self._apply_fn(self.params, self.opt_state,
+                                    self.scaler_state, self._grad_acc)
+        self._grad_acc = None
+        self._last_overflow = overflow
+        self.global_steps += 1
+        # fp16 dynamic scaling: fetch the overflow flag (the reference's
+        # overflow check is a blocking allreduce anyway — stage2.py:1801) so
+        # skipped_steps and the python-side scheduler stay faithful.  bf16/
+        # fp32 paths keep fully-async dispatch: overflow is (near-)impossible
+        # and the on-device cond still protects the weights.
+        if self.scaler_cfg.dynamic:
+            if bool(overflow):
+                self.skipped_steps += 1
+            elif self.lr_scheduler is not None:
+                self.lr_scheduler.step(**(lr_kwargs or {}))
+        elif self.lr_scheduler is not None:
+            self.lr_scheduler.step(**(lr_kwargs or {}))
+        self.tput_timer.stop(global_step=True)
+
+        if self.global_steps % self.steps_per_print() == 0:
+            loss_val = (float(self._last_loss)
+                        if self._last_loss is not None else float("nan"))
+            lr = self.get_lr()[0]
+            log_dist(f"step={self.global_steps}, loss={loss_val:.6f}, "
+                     f"lr={lr:.3e}, loss_scale={self.loss_scale:g}",
+                     ranks=[0])
+        if self._summary_writer is not None:
+            self._summary_writer.add_scalar(
+                "Train/Samples/train_loss", float(self._last_loss),
+                self.global_steps * self.train_batch_size())
+            self._summary_writer.add_scalar("Train/Samples/lr",
+                                            self.get_lr()[0],
+                                            self.global_steps)
+        if self.wall_clock_breakdown():
+            self.timers(STEP_MICRO_TIMER).stop()
+
+    @property
+    def overflow(self) -> bool:
+        if self._last_overflow is None:
+            return False
+        return bool(self._last_overflow)
+
+    def was_step_applied(self) -> bool:
+        return not self.overflow
+
+    # ------------------------------------------------------------------ #
+    # train_batch convenience: full GAS loop in one call
+    # ------------------------------------------------------------------ #
+    def train_batch(self, data_iter=None):
+        """Run gradient_accumulation_steps micro-steps + one optimizer step.
+
+        (The non-pipeline reference leaves this loop to user code; provided
+        here because it is the natural TPU entry point for a whole batch.)"""
+        if data_iter is None:
+            if self.training_dataloader is None:
+                raise ValueError("train_batch needs data_iter or training_data")
+            data_iter = iter(self.training_dataloader)
+        total = 0.0
+        for _ in range(self.gradient_accumulation_steps()):
+            batch = next(data_iter)
+            if not isinstance(batch, tuple):
+                batch = (batch,)
+            loss = self.forward(*batch)
+            self.backward(loss)
+            self.step()
+            total += float(loss)
+        return total / self.gradient_accumulation_steps()
+
+    # ------------------------------------------------------------------ #
+    # memory estimate (reference: stage2.py:2141)
+    # ------------------------------------------------------------------ #
+    def estimate_memory(self):
+        return self.zero_partitioner.estimate_memory(self.params)
+
+    # ------------------------------------------------------------------ #
+    # checkpointing (reference: engine.py:1880-2430)
+    # ------------------------------------------------------------------ #
+    def _engine_state(self) -> Dict[str, Any]:
+        return {
+            "optimizer": self.opt_state,
+            "scaler": self.scaler_state,
+        }
+
+    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+                        save_latest=True):
+        if tag is None:
+            tag = f"global_step{self.global_steps}"
+        self._check_tag(tag)
+        client = dict(client_state or {})
+        client.update({
+            "global_steps": self.global_steps,
+            "micro_steps": self.micro_steps,
+            "skipped_steps": self.skipped_steps,
+            "lr_scheduler": (self.lr_scheduler.state_dict()
+                             if self.lr_scheduler is not None else None),
+            "ds_config_batch": [self.train_batch_size(),
+                                self.train_micro_batch_size_per_gpu(),
+                                self.gradient_accumulation_steps()],
+            "dp_world_size": self.world_size,
+        })
+        path = ckpt_mod.save_checkpoint_state(
+            save_dir, tag, module_state={"module": self.params},
+            optimizer_state=self._engine_state(), client_state=client)
+        log_dist(f"saved checkpoint {path}", ranks=[0])
+        return path
+
+    def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
+                        load_optimizer_states=True, load_lr_scheduler_states=True,
+                        load_module_only=False):
+        module_tmpl = {"module": self.params}
+        opt_tmpl = (None if load_module_only or not load_optimizer_states
+                    else self._engine_state())
+        module_state, opt_state, client = ckpt_mod.load_checkpoint_state(
+            load_dir, tag, module_tmpl, opt_tmpl,
+            strict=load_module_strict)
+        self.params = module_state["module"]
+        if opt_state is not None:
+            self.opt_state = opt_state["optimizer"]
+            self.scaler_state = opt_state["scaler"]
+        if load_lr_scheduler_states and self.lr_scheduler is not None and \
+                client.get("lr_scheduler"):
+            self.lr_scheduler.load_state_dict(client["lr_scheduler"])
+        if not load_module_only:
+            self.global_steps = client.get("global_steps", 0)
+            self.micro_steps = client.get("micro_steps", 0)
+            self.skipped_steps = client.get("skipped_steps", 0)
+        load_path = os.path.join(load_dir, str(
+            tag or ckpt_mod.read_latest_tag(load_dir)))
+        log_dist(f"loaded checkpoint {load_path}", ranks=[0])
+        return load_path, client
+
+    def _check_tag(self, tag):
+        """Validate tag agreement across hosts (reference: engine.py:2112-2127
+        does this with a bytes-allreduce).  Single-process always agrees."""
+        mode = self.config.checkpoint_config.tag_validation
+        if jax.process_count() <= 1 or mode == "IGNORE":
+            return
+        import hashlib
+        from jax.experimental import multihost_utils
+        digest = np.frombuffer(
+            hashlib.sha256(str(tag).encode()).digest()[:8], dtype=np.int64)
+        all_digests = np.asarray(multihost_utils.process_allgather(digest))
+        if not (all_digests == digest.reshape(1, -1)).all():
+            msg = (f"checkpoint tag {tag!r} differs across hosts — resume "
+                   f"from this checkpoint would be corrupt")
+            if mode == "FAIL":
+                raise RuntimeError(msg)
+            logger.warning(msg)
+
+    # -- module weights only (reference: engine.py module_state_dict) -- #
+    def module_state_dict(self):
+        return self.params
+
+    def load_module_state_dict(self, state_dict, strict=True):
+        self.params = jax.tree.map(
+            lambda tmpl, arr: jax.device_put(
+                jnp.asarray(arr, dtype=tmpl.dtype), tmpl.sharding),
+            self.params, state_dict)

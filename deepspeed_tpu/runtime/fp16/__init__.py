@@ -1,0 +1,3 @@
+from .loss_scaler import (DynamicLossScaler, LossScaler, LossScaleState,
+                          LossScalerConfig, create_loss_scaler,
+                          update_loss_scale)

@@ -1,0 +1,15 @@
+"""PipelineEngine — lands with the pipeline-parallel milestone.
+
+Reference: deepspeed/runtime/pipe/engine.py:46.  The TPU design executes the
+declarative PipeSchedule instruction stream (schedule.py) as a
+scan-over-microbatches with collective-permute p2p over the "pipe" mesh axis.
+"""
+
+from .module import PipelineModule  # noqa: F401
+
+
+class PipelineEngine:
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "PipelineEngine is not wired yet — coming with the pipeline "
+            "milestone (SURVEY.md §7 step 6)")

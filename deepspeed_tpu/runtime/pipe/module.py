@@ -1,0 +1,130 @@
+"""Pipeline model description: a model as an ordered list of layer factories.
+
+Reference: deepspeed/runtime/pipe/module.py — LayerSpec:25, TiedLayerSpec:73,
+PipelineModule:87, _partition_layers:355 (methods "parameters" / "uniform" /
+"type:regex").
+
+TPU-native: a LayerSpec wraps a pure stage function `fn(params, x) -> x` (or a
+flax module) plus a param initializer; PipelineModule groups specs into
+`num_stages` contiguous stages whose params shard over the "pipe" mesh axis.
+The schedule/executor lives in runtime/pipe/engine.py.
+"""
+
+import re
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+
+class LayerSpec:
+    """Deferred layer construction (reference: pipe/module.py:25)."""
+
+    def __init__(self, typename: Callable, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+
+    def build(self):
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    def __repr__(self):
+        name = getattr(self.typename, "__name__", str(self.typename))
+        return f"LayerSpec({name})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """Layer whose params are shared with another occurrence of the same key
+    (reference: pipe/module.py:73 — e.g. tied input/output embeddings)."""
+
+    def __init__(self, key: str, typename: Callable, *module_args,
+                 forward_fn: Optional[Callable] = None, **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+
+
+def partition_uniform(num_items: int, num_parts: int) -> List[int]:
+    """Even split boundaries (reference: runtime/utils.py:562)."""
+    chunk = num_items // num_parts
+    residual = num_items % num_parts
+    parts = [0]
+    for p in range(num_parts):
+        size = chunk + (1 if p < residual else 0)
+        parts.append(parts[-1] + size)
+    return parts
+
+
+def partition_balanced(weights: Sequence[float], num_parts: int) -> List[int]:
+    """Weight-balanced contiguous partition via prefix sums
+    (reference: runtime/utils.py partition_balanced)."""
+    weights = np.asarray(weights, dtype=np.float64)
+    prefix = np.concatenate([[0.0], np.cumsum(weights)])
+    total = prefix[-1]
+    parts = [0]
+    for p in range(1, num_parts):
+        target = total * p / num_parts
+        idx = int(np.searchsorted(prefix, target))
+        idx = max(parts[-1] + 1, min(idx, len(weights) - (num_parts - p)))
+        parts.append(idx)
+    parts.append(len(weights))
+    return parts
+
+
+class PipelineModule:
+    """A model expressed as a layer list, partitioned into pipeline stages
+    (reference: pipe/module.py:87)."""
+
+    def __init__(self, layers: Sequence[Any], num_stages: Optional[int] = None,
+                 topology=None, loss_fn: Optional[Callable] = None,
+                 partition_method: str = "parameters",
+                 activation_checkpoint_interval: int = 0, seed_layers=False,
+                 base_seed: int = 1234):
+        self.layer_specs = [l if isinstance(l, LayerSpec) else LayerSpec(l)
+                            if callable(l) else l for l in layers]
+        self.num_stages = num_stages or 1
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self.topology = topology
+        self.base_seed = base_seed
+        self._built = [spec.build() if isinstance(spec, LayerSpec) else spec
+                       for spec in self.layer_specs]
+        self.parts = self._partition_layers()
+
+    def __len__(self):
+        return len(self.layer_specs)
+
+    @property
+    def layers(self):
+        return self._built
+
+    def _layer_weights(self) -> List[float]:
+        method = self.partition_method.lower()
+        if method == "uniform":
+            return [1.0] * len(self._built)
+        if method == "parameters":
+            weights = []
+            for layer in self._built:
+                n = getattr(layer, "num_params", None)
+                weights.append(float(n() if callable(n) else (n or 1)))
+            return weights
+        if method.startswith("type:"):
+            pattern = method.split(":", 1)[1]
+            return [1.0 if re.search(pattern,
+                                     type(layer).__name__, re.IGNORECASE)
+                    else 0.0 for layer in self._built]
+        raise ValueError(f"Unknown partition method {self.partition_method!r}")
+
+    def _partition_layers(self) -> List[int]:
+        weights = self._layer_weights()
+        if all(w == weights[0] for w in weights):
+            return partition_uniform(len(weights), self.num_stages)
+        return partition_balanced(weights, self.num_stages)
+
+    def stage_layers(self, stage_id: int):
+        lo, hi = self.parts[stage_id], self.parts[stage_id + 1]
+        return self._built[lo:hi]
+
+    def tied_keys(self):
+        return sorted({spec.key for spec in self.layer_specs
+                       if isinstance(spec, TiedLayerSpec)})

@@ -1,0 +1,1 @@
+from .partition import ZeroPartitioner, zero_partition_spec

@@ -1,0 +1,66 @@
+"""Test model fixtures (modeled on reference tests/unit/simple_model.py:234 —
+SimpleModel, random/linear dataset generators, args helpers)."""
+
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def simple_model_params(hidden_dim: int, nlayers: int = 2, seed: int = 0,
+                        dtype=jnp.float32):
+    """An MLP regression model: x → (Linear+relu)*n → Linear(1)."""
+    rng = np.random.RandomState(seed)
+    params = {}
+    for i in range(nlayers):
+        params[f"layer_{i}"] = {
+            "w": jnp.asarray(
+                rng.normal(0, 0.1, (hidden_dim, hidden_dim)), dtype),
+            "b": jnp.zeros((hidden_dim,), dtype),
+        }
+    params["head"] = {
+        "w": jnp.asarray(rng.normal(0, 0.1, (hidden_dim, 1)), dtype),
+        "b": jnp.zeros((1,), dtype),
+    }
+    return params
+
+
+def simple_model_apply(params, rng, x, y):
+    """Returns MSE loss — the model-returns-loss contract of the engine."""
+    h = x
+    n = len([k for k in params if k.startswith("layer_")])
+    for i in range(n):
+        p = params[f"layer_{i}"]
+        h = jax.nn.relu(h @ p["w"] + p["b"])
+    pred = h @ params["head"]["w"] + params["head"]["b"]
+    return jnp.mean((pred.squeeze(-1) - y) ** 2)
+
+
+def random_dataset(total_samples: int, hidden_dim: int, seed: int = 12,
+                   dtype=np.float32) -> list:
+    rng = np.random.RandomState(seed)
+    xs = rng.normal(0, 1, (total_samples, hidden_dim)).astype(dtype)
+    w_true = rng.normal(0, 1.0 / np.sqrt(hidden_dim),
+                        (hidden_dim,)).astype(dtype)
+    ys = (xs @ w_true).astype(dtype)
+    return [(xs[i], ys[i]) for i in range(total_samples)]
+
+
+def random_dataloader(model_dim: int, total_samples: int, batch_size: int,
+                      seed: int = 12):
+    from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+    ds = random_dataset(total_samples, model_dim, seed)
+    return DeepSpeedDataLoader(ds, batch_size=batch_size)
+
+
+def base_engine_config(micro_batch: int = 8, gas: int = 1, **overrides):
+    cfg = {
+        "train_micro_batch_size_per_gpu": micro_batch,
+        "gradient_accumulation_steps": gas,
+        "steps_per_print": 10 ** 9,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+    }
+    cfg.update(overrides)
+    return cfg
