@@ -1,0 +1,18 @@
+"""deepspeed_tpu.ops — the TPU kernel layer.
+
+Plays the role of the reference's `deepspeed/ops/` (Python wrappers over
+csrc/ CUDA kernels).  On TPU the hot ops are Pallas kernels feeding the MXU;
+everything XLA already fuses well (bias+gelu, bias+dropout+residual, Adam
+elementwise math) is expressed as plain jnp and left to the compiler.
+"""
+
+from .flash_attention import flash_attention, mha_reference
+from .normalize import fused_layer_norm, layer_norm_reference
+from .activations import bias_gelu, bias_dropout_residual, gelu
+from .transformer import (DeepSpeedTransformerConfig, DeepSpeedTransformerLayer)
+
+__all__ = [
+    "flash_attention", "mha_reference", "fused_layer_norm",
+    "layer_norm_reference", "bias_gelu", "bias_dropout_residual", "gelu",
+    "DeepSpeedTransformerConfig", "DeepSpeedTransformerLayer",
+]

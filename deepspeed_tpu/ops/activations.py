@@ -1,0 +1,43 @@
+"""Fused elementwise transformer ops — gelu, bias+gelu, bias+dropout+residual.
+
+Reference: csrc/transformer/gelu_kernels.cu:330 (fused bias-gelu fwd/bwd) and
+csrc/transformer/dropout_kernels.cu:868 (fused bias+dropout+residual).
+
+On TPU these are expressed as plain jnp: XLA fuses the whole chain into the
+neighbouring matmul's epilogue, which is exactly what the hand-written CUDA
+kernels buy on GPU.  Dropout uses the JAX counter-based PRNG (threefry),
+giving reproducible masks under jit/shard_map — the role of the reference's
+per-kernel curand states (dropout_kernels.cu Dropout<T>::SetMask).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def gelu(x):
+    """tanh-approximation gelu, matching gelu_kernels.cu:10
+    (0.5 * x * (1 + tanh(sqrt(2/pi) * (x + 0.044715 x^3))))."""
+    xf = x.astype(jnp.float32)
+    out = 0.5 * xf * (1.0 + jnp.tanh(0.7978845608028654 *
+                                     (xf + 0.044715 * xf * xf * xf)))
+    return out.astype(x.dtype)
+
+
+def bias_gelu(x, bias):
+    """Fused bias-add + gelu (gelu_kernels.cu fused_bias_gelu)."""
+    return gelu(x + bias)
+
+
+def dropout(x, rate: float, rng, deterministic: bool = False):
+    if deterministic or rate <= 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, p=keep, shape=x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+def bias_dropout_residual(x, bias, residual, rate: float, rng,
+                          deterministic: bool = False):
+    """Fused bias-add + dropout + residual-add
+    (dropout_kernels.cu dropout_kernel + bias/residual variants)."""
+    return dropout(x + bias, rate, rng, deterministic) + residual

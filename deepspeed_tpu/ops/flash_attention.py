@@ -1,0 +1,226 @@
+"""Flash attention for TPU — the Pallas analog of the reference's fused
+attention kernels (csrc/transformer/softmax_kernels.cu:595 fused
+scale+mask+softmax + cublas strided-batch QK^T/PV matmuls,
+csrc/transformer/inference/csrc/softmax.cu).
+
+Instead of materializing the [S, S] score matrix in HBM between three kernel
+launches like the CUDA reference, the whole QK^T -> online-softmax -> PV chain
+runs in one Pallas kernel, streaming K/V blocks through VMEM with fp32
+accumulators (flash-attention style).  The MXU sees two big matmuls per block
+pair; HBM traffic is O(S*d) instead of O(S^2).
+
+Backward currently recomputes attention with the XLA reference path (exact
+same math, fp32 softmax) via custom_vjp; a dedicated Pallas backward kernel is
+a later optimization.
+"""
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific pallas bits are unavailable on some CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+# Finite mask value: keeps running-max finite for fully-masked rows (an -inf
+# row max would turn exp(s - m) into NaN).
+DEFAULT_MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
+
+_LANES = 128  # TPU lane width; softmax stats are carried at this width
+
+
+# --------------------------------------------------------------------------- #
+# Reference implementation (also the backward path and the CPU fallback)
+# --------------------------------------------------------------------------- #
+def mha_reference(q, k, v, causal: bool = False,
+                  sm_scale: Optional[float] = None, bias=None):
+    """Plain-XLA multi-head attention: q,k,v [B, H, S, D] -> [B, H, S, D].
+
+    fp32 softmax regardless of input dtype (matches the reference kernels,
+    which upcast for the softmax — softmax_kernels.cu attn_softmax)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        q_len, k_len = s.shape[-2], s.shape[-1]
+        idx_q = jax.lax.broadcasted_iota(jnp.int32, (q_len, k_len), 0)
+        idx_k = jax.lax.broadcasted_iota(jnp.int32, (q_len, k_len), 1)
+        s = jnp.where(idx_k > idx_q, DEFAULT_MASK_VALUE, s)
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+# --------------------------------------------------------------------------- #
+# Pallas kernel
+# --------------------------------------------------------------------------- #
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+               causal: bool, sm_scale: float, block_q: int, block_k: int,
+               num_k_blocks: int):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, DEFAULT_MASK_VALUE)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # With causal masking, blocks strictly above the diagonal contribute
+    # nothing — skip their matmuls entirely (the analog of the reference's
+    # triangular-launch trick).
+    should_compute = True
+    if causal:
+        should_compute = qi * block_q + block_q - 1 >= ki * block_k
+
+    @pl.when(should_compute)
+    def _compute():
+        # bf16 operands straight into the MXU; fp32 accumulation via
+        # preferred_element_type (upcasting first would force an fp32 matmul).
+        q = q_ref[0, 0]                               # [bq, d]
+        k = k_ref[0, 0]                               # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk] fp32
+
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(col > row, DEFAULT_MASK_VALUE, s)
+
+        m_prev = m_scr[...]                           # [bq, LANES]
+        l_prev = l_scr[...]
+        m_curr = jnp.max(s, axis=-1, keepdims=True)   # [bq, 1]
+        m_next = jnp.maximum(m_prev, m_curr)          # [bq, LANES]
+        alpha = jnp.exp(m_prev[:, :1] - m_next[:, :1])   # [bq, 1]
+        p = jnp.exp(s - m_next[:, :1])                # [bq, bk] fp32
+        l_corr = l_prev * alpha
+        l_next = l_corr + jnp.sum(p, axis=-1, keepdims=True)
+        m_scr[...] = m_next
+        l_scr[...] = jnp.broadcast_to(l_next[:, :1], l_scr.shape)
+
+        v_blk = v_ref[0, 0]                           # [bk, d]
+        pv = jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bq, d]
+        acc_scr[...] = acc_scr[...] * alpha + pv
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        l = l_scr[...][:, :1]
+        # Fully-masked rows have l == 0; emit zeros not NaN.
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+def flash_attention_pallas(q, k, v, causal: bool = False,
+                           sm_scale: Optional[float] = None,
+                           block_q: int = 128, block_k: int = 128,
+                           interpret: bool = False):
+    """Pallas flash attention. q,k,v: [B, H, S, D] -> [B, H, S, D]."""
+    if pltpu is None:
+        raise RuntimeError(
+            "pallas TPU support unavailable in this jax install — use "
+            "mha_reference / the public flash_attention dispatcher instead")
+    batch, heads, q_len, d = q.shape
+    k_len = k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    block_q = min(block_q, q_len)
+    block_k = min(block_k, k_len)
+    if q_len % block_q or k_len % block_k:
+        raise ValueError(
+            f"seq lengths ({q_len},{k_len}) must divide into blocks "
+            f"({block_q},{block_k})")
+    nq, nk = q_len // block_q, k_len // block_k
+
+    kernel = functools.partial(
+        _fa_kernel, causal=causal, sm_scale=float(sm_scale),
+        block_q=block_q, block_k=block_k, num_k_blocks=nk)
+
+    grid = (batch, heads, nq, nk)
+    scratch = [
+        pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
+        pltpu.VMEM((block_q, _LANES), jnp.float32),  # running sum
+        pltpu.VMEM((block_q, d), jnp.float32),       # output accumulator
+    ]
+    params = {}
+    if not interpret:
+        params["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"))
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=scratch,
+        interpret=interpret,
+        **params,
+    )(q, k, v)
+
+
+# --------------------------------------------------------------------------- #
+# Differentiable public entry point
+# --------------------------------------------------------------------------- #
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k):
+    return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)[0]
+
+
+def _use_pallas(q_len, k_len, d, block_q, block_k):
+    if pltpu is None or jax.default_backend() != "tpu":
+        return False
+    bq, bk = min(block_q, q_len), min(block_k, k_len)
+    return q_len % bq == 0 and k_len % bk == 0
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    if _use_pallas(q.shape[2], k.shape[2], q.shape[3], block_q, block_k):
+        out = flash_attention_pallas(q, k, v, causal=causal,
+                                     sm_scale=sm_scale,
+                                     block_q=block_q, block_k=block_k)
+    else:
+        out = mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: mha_reference(q_, k_, v_, causal=causal,
+                                         sm_scale=sm_scale), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    sm_scale: Optional[float] = None, bias=None,
+                    block_q: int = 128, block_k: int = 128):
+    """Fused multi-head attention: q,k,v [B, H, S, D] -> [B, H, S, D].
+
+    Dispatches to the Pallas kernel on TPU (bias-free paths); additive-bias
+    attention falls back to the XLA path, which the compiler still fuses into
+    few kernels."""
+    if bias is not None:
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
+                             bias=bias)
+    return _flash(q, k, v, causal, sm_scale, block_q, block_k)

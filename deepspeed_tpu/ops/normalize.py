@@ -1,0 +1,97 @@
+"""Fused LayerNorm — the analog of the reference's fused LN kernels
+(csrc/transformer/normalize_kernels.cu:2103, fwd/bwd incl. the "invertible"
+variant that recomputes the input from the output).
+
+On TPU, XLA already fuses mean/var/normalize/scale into one loop nest, so the
+default path is plain jnp (fp32 statistics).  A Pallas row-block kernel is
+provided for the hot transformer path where we want LN fused into the
+surrounding kernel schedule explicitly.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+
+def layer_norm_reference(x, gamma, beta, eps: float = 1e-5):
+    """LN over the last dim with fp32 statistics (normalize_kernels.cu
+    fused_bias_residual_layer_norm semantics, minus the fused residual which
+    callers express as x + residual before the call)."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (y * gamma.astype(jnp.float32) +
+            beta.astype(jnp.float32)).astype(x.dtype)
+
+
+def _ln_kernel(x_ref, g_ref, b_ref, o_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    o_ref[...] = (y * g_ref[...].astype(jnp.float32) +
+                  b_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def layer_norm_pallas(x, gamma, beta, eps: float = 1e-5,
+                      block_rows: int = 256, interpret: bool = False):
+    """Pallas LN over the last dim of a 2-D [rows, hidden] view."""
+    orig_shape = x.shape
+    hidden = orig_shape[-1]
+    x2 = x.reshape(-1, hidden)
+    rows = x2.shape[0]
+    block_rows = min(block_rows, rows)
+    while rows % block_rows:  # largest divisor of rows <= block_rows keeps
+        block_rows -= 1       # each block VMEM-sized (never one giant block)
+    kernel = functools.partial(_ln_kernel, eps=eps)
+    out = pl.pallas_call(
+        kernel,
+        grid=(rows // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((hidden,), lambda i: (0,)),
+            pl.BlockSpec((hidden,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, hidden), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x2.shape, x.dtype),
+        interpret=interpret,
+    )(x2, gamma, beta)
+    return out.reshape(orig_shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _fused_ln(x, gamma, beta, eps):
+    return _fused_ln_fwd(x, gamma, beta, eps)[0]
+
+
+def _fused_ln_fwd(x, gamma, beta, eps):
+    if pltpu is not None and jax.default_backend() == "tpu":
+        out = layer_norm_pallas(x, gamma, beta, eps)
+    else:
+        out = layer_norm_reference(x, gamma, beta, eps)
+    return out, (x, gamma, beta)
+
+
+def _fused_ln_bwd(eps, res, g):
+    x, gamma, beta = res
+    _, vjp = jax.vjp(
+        lambda x_, g_, b_: layer_norm_reference(x_, g_, b_, eps),
+        x, gamma, beta)
+    return vjp(g)
+
+
+_fused_ln.defvjp(_fused_ln_fwd, _fused_ln_bwd)
+
+
+def fused_layer_norm(x, gamma, beta, eps: float = 1e-5):
+    """Differentiable fused LayerNorm (Pallas on TPU, XLA elsewhere)."""
+    return _fused_ln(x, gamma, beta, eps)

@@ -1,0 +1,188 @@
+"""DeepSpeedTransformerLayer — the fused transformer block.
+
+Reference: deepspeed/ops/transformer/transformer.py — DeepSpeedTransformerConfig
+(:39), DeepSpeedTransformerLayer (:462, owns attn_qkvw/attn_qkvb/attn_ow/...),
+backed by the csrc/transformer CUDA kernels.
+
+TPU-native: the layer is a pure function over a param pytree (same weight
+names as the reference for checkpoint parity).  Attention runs the Pallas
+flash kernel; LN the fused LN; bias/gelu/dropout chains are left to XLA
+fusion.  Tensor parallelism is declared, not coded: `param_partition_specs`
+returns the Megatron-style column/row split over the "model" mesh axis and
+GSPMD inserts the per-layer collectives.
+"""
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import MODEL_AXIS
+from .activations import bias_gelu, bias_dropout_residual, dropout
+from .flash_attention import flash_attention
+from .normalize import fused_layer_norm
+
+
+@dataclass
+class DeepSpeedTransformerConfig:
+    """Mirror of ops/transformer/transformer.py:39 (CUDA-only knobs dropped,
+    TPU knobs added)."""
+    batch_size: int = -1
+    hidden_size: int = -1
+    intermediate_size: int = -1
+    heads: int = -1
+    attn_dropout_ratio: float = 0.1
+    hidden_dropout_ratio: float = 0.1
+    num_hidden_layers: int = -1
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-5
+    seed: int = -1
+    fp16: bool = False
+    bf16: bool = True
+    pre_layer_norm: bool = True
+    layer_id: int = 0
+    # TPU additions
+    causal: bool = False
+    block_q: int = 128
+    block_k: int = 128
+
+    def __post_init__(self):
+        if self.intermediate_size == -1 and self.hidden_size != -1:
+            self.intermediate_size = 4 * self.hidden_size
+
+    @property
+    def dtype(self):
+        if self.bf16:
+            return jnp.bfloat16
+        if self.fp16:
+            return jnp.float16
+        return jnp.float32
+
+
+class DeepSpeedTransformerLayer:
+    """Fused transformer layer (reference: transformer.py:462).
+
+    Weight names follow the reference exactly:
+      attn_qkvw [H, 3H], attn_qkvb [3H], attn_ow [H, H], attn_ob [H],
+      attn_nw/attn_nb [H] (post-attention LN), inter_w [H, I], inter_b [I],
+      output_w [I, H], output_b [H], norm_w/norm_b [H].
+    """
+
+    def __init__(self, config: DeepSpeedTransformerConfig):
+        self.config = config
+
+    # -- parameters ---------------------------------------------------- #
+    def init_params(self, rng):
+        cfg = self.config
+        h, inter = cfg.hidden_size, cfg.intermediate_size
+        std = cfg.initializer_range
+        keys = jax.random.split(rng, 4)
+        init = jax.nn.initializers.normal(std)
+        return {
+            "attn_qkvw": init(keys[0], (h, 3 * h), jnp.float32),
+            "attn_qkvb": jnp.zeros((3 * h,), jnp.float32),
+            "attn_ow": init(keys[1], (h, h), jnp.float32),
+            "attn_ob": jnp.zeros((h,), jnp.float32),
+            "attn_nw": jnp.ones((h,), jnp.float32),
+            "attn_nb": jnp.zeros((h,), jnp.float32),
+            "inter_w": init(keys[2], (h, inter), jnp.float32),
+            "inter_b": jnp.zeros((inter,), jnp.float32),
+            "output_w": init(keys[3], (inter, h), jnp.float32),
+            "output_b": jnp.zeros((h,), jnp.float32),
+            "norm_w": jnp.ones((h,), jnp.float32),
+            "norm_b": jnp.zeros((h,), jnp.float32),
+        }
+
+    @staticmethod
+    def param_partition_specs():
+        """Megatron-style TP: qkv/inter column-split, out/output row-split
+        over the "model" axis (the role the external Megatron mpu plays in
+        the reference — engine.py:739-770)."""
+        return {
+            "attn_qkvw": P(None, MODEL_AXIS),
+            "attn_qkvb": P(MODEL_AXIS),
+            "attn_ow": P(MODEL_AXIS, None),
+            "attn_ob": P(),
+            "attn_nw": P(), "attn_nb": P(),
+            "inter_w": P(None, MODEL_AXIS),
+            "inter_b": P(MODEL_AXIS),
+            "output_w": P(MODEL_AXIS, None),
+            "output_b": P(),
+            "norm_w": P(), "norm_b": P(),
+        }
+
+    def num_params(self):
+        h, i = self.config.hidden_size, self.config.intermediate_size
+        return 4 * h * h + 2 * h * i + 9 * h + i
+
+    # -- forward ------------------------------------------------------- #
+    def __call__(self, params, x, attn_mask=None, rng=None,
+                 deterministic: bool = False):
+        """x: [B, S, H] -> [B, S, H].  attn_mask: additive [B, 1, 1, S] or
+        [B, 1, S, S] bias, like the reference's input_mask."""
+        cfg = self.config
+        eps = cfg.layer_norm_eps
+        heads = cfg.heads
+        b, s, h = x.shape
+        d = h // heads
+        has_dropout = (cfg.attn_dropout_ratio > 0.0 or
+                       cfg.hidden_dropout_ratio > 0.0)
+        if rng is None:
+            if not deterministic and has_dropout:
+                raise ValueError(
+                    "transformer layer called in training mode with dropout "
+                    "configured but no rng — pass rng= or deterministic=True")
+            rng = jax.random.PRNGKey(0)
+            deterministic = True
+        r_attn, r_hid1, r_hid2 = jax.random.split(rng, 3)
+
+        x = x.astype(cfg.dtype)
+        residual = x
+        if cfg.pre_layer_norm:
+            attn_in = fused_layer_norm(x, params["norm_w"], params["norm_b"],
+                                       eps)
+        else:
+            attn_in = x
+
+        qkv = attn_in @ params["attn_qkvw"].astype(attn_in.dtype) + \
+            params["attn_qkvb"].astype(attn_in.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def to_heads(t):
+            return t.reshape(b, s, heads, d).transpose(0, 2, 1, 3)
+
+        q, k, v = to_heads(q), to_heads(k), to_heads(v)
+        ctx = flash_attention(q, k, v, causal=cfg.causal, bias=attn_mask,
+                              block_q=cfg.block_q, block_k=cfg.block_k)
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h)
+        ctx = dropout(ctx, cfg.attn_dropout_ratio, r_attn, deterministic)
+
+        attn_out = ctx @ params["attn_ow"].astype(ctx.dtype)
+        attn_out = bias_dropout_residual(
+            attn_out, params["attn_ob"].astype(attn_out.dtype), residual,
+            cfg.hidden_dropout_ratio, r_hid1, deterministic)
+
+        if cfg.pre_layer_norm:
+            mlp_in = fused_layer_norm(attn_out, params["attn_nw"],
+                                      params["attn_nb"], eps)
+            mlp_residual = attn_out
+        else:
+            attn_out = fused_layer_norm(attn_out, params["attn_nw"],
+                                        params["attn_nb"], eps)
+            mlp_in = attn_out
+            mlp_residual = attn_out
+
+        inter = bias_gelu(mlp_in @ params["inter_w"].astype(mlp_in.dtype),
+                          params["inter_b"].astype(mlp_in.dtype))
+        out = inter @ params["output_w"].astype(inter.dtype)
+        out = bias_dropout_residual(
+            out, params["output_b"].astype(out.dtype), mlp_residual,
+            cfg.hidden_dropout_ratio, r_hid2, deterministic)
+
+        if not cfg.pre_layer_norm:
+            out = fused_layer_norm(out, params["norm_w"], params["norm_b"],
+                                   eps)
+        return out
