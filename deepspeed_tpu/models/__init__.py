@@ -1,0 +1,12 @@
+"""deepspeed_tpu.models — model families built on the fused ops layer.
+
+The reference ships models through DeepSpeedExamples (Megatron-GPT2,
+bing_bert) and fuses them via module injection; here the flagship
+transformer-LM families are first-class so the framework is usable
+standalone.
+"""
+
+from .gpt2 import GPT2Config, GPT2Model
+from .bert import BertConfig, BertModel
+
+__all__ = ["GPT2Config", "GPT2Model", "BertConfig", "BertModel"]

@@ -1,0 +1,168 @@
+"""BERT family — encoder LM (the role bing_bert plays in the reference's
+headline benchmarks: BERT-large pretraining, docs/_tutorials/bert-pretraining.md
+and the fused-kernel tests tests/unit/modeling.py:1597).
+
+Same TPU structure as GPT-2: stacked layers + lax.scan, fused transformer
+body, declarative TP specs.  Loss = masked-LM cross entropy (positions with
+label == ignore_index contribute nothing), matching the reference pretraining
+objective minus NSP (which modern recipes drop).
+"""
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+from ..ops.transformer import (DeepSpeedTransformerConfig,
+                               DeepSpeedTransformerLayer)
+from ..ops.normalize import fused_layer_norm
+from ..ops.activations import dropout
+from ..parallel.mesh import MODEL_AXIS
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30592          # 30522 padded to a 128 multiple
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_size: int = 1024          # BERT-large defaults
+    num_layers: int = 24
+    num_heads: int = 16
+    intermediate_size: Optional[int] = None
+    embd_dropout: float = 0.1
+    attn_dropout: float = 0.1
+    hidden_dropout: float = 0.1
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    bf16: bool = True
+    pre_layer_norm: bool = True      # reference supports both (preln/postln)
+    activation_checkpointing: bool = False
+    ignore_index: int = -100
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+
+    @property
+    def dtype(self):
+        return jnp.bfloat16 if self.bf16 else jnp.float32
+
+    def layer_config(self) -> DeepSpeedTransformerConfig:
+        return DeepSpeedTransformerConfig(
+            hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            heads=self.num_heads,
+            attn_dropout_ratio=self.attn_dropout,
+            hidden_dropout_ratio=self.hidden_dropout,
+            num_hidden_layers=self.num_layers,
+            initializer_range=self.initializer_range,
+            layer_norm_eps=self.layer_norm_eps,
+            bf16=self.bf16,
+            pre_layer_norm=self.pre_layer_norm,
+            causal=False,
+        )
+
+    def num_params(self, include_embeddings: bool = True) -> int:
+        layer = DeepSpeedTransformerLayer(self.layer_config())
+        n = self.num_layers * layer.num_params() + 2 * self.hidden_size
+        if include_embeddings:
+            n += (self.vocab_size + self.max_position_embeddings +
+                  self.type_vocab_size) * self.hidden_size
+        return n
+
+
+class BertModel:
+    """Encoder LM over stacked DeepSpeedTransformerLayers (MLM objective)."""
+
+    def __init__(self, config: BertConfig):
+        self.config = config
+        self.layer = DeepSpeedTransformerLayer(config.layer_config())
+
+    def init_params(self, rng):
+        cfg = self.config
+        k_wte, k_wpe, k_tte, k_layers = jax.random.split(rng, 4)
+        init = jax.nn.initializers.normal(cfg.initializer_range)
+        layer_keys = jax.random.split(k_layers, cfg.num_layers)
+        stacked = jax.vmap(self.layer.init_params)(layer_keys)
+        return {
+            "wte": init(k_wte, (cfg.vocab_size, cfg.hidden_size), jnp.float32),
+            "wpe": init(k_wpe, (cfg.max_position_embeddings, cfg.hidden_size),
+                        jnp.float32),
+            "tte": init(k_tte, (cfg.type_vocab_size, cfg.hidden_size),
+                        jnp.float32),
+            "emb_ln": {"w": jnp.ones((cfg.hidden_size,), jnp.float32),
+                       "b": jnp.zeros((cfg.hidden_size,), jnp.float32)},
+            "h": stacked,
+        }
+
+    def param_partition_specs(self):
+        layer_specs = DeepSpeedTransformerLayer.param_partition_specs()
+        stacked_specs = {k: P(None, *list(s)) for k, s in layer_specs.items()}
+        return {
+            "wte": P(MODEL_AXIS, None),
+            "wpe": P(),
+            "tte": P(),
+            "emb_ln": {"w": P(), "b": P()},
+            "h": stacked_specs,
+        }
+
+    def hidden_states(self, params, input_ids, attention_mask=None,
+                      token_type_ids=None, rng=None,
+                      deterministic: bool = False):
+        cfg = self.config
+        b, s = input_ids.shape
+        if rng is None:
+            deterministic = True
+            rng = jax.random.PRNGKey(0)
+        r_embd, r_layers = jax.random.split(rng)
+
+        h = (params["wte"].astype(cfg.dtype)[input_ids] +
+             params["wpe"].astype(cfg.dtype)[jnp.arange(s)])
+        if token_type_ids is not None:
+            h = h + params["tte"].astype(cfg.dtype)[token_type_ids]
+        h = fused_layer_norm(h, params["emb_ln"]["w"], params["emb_ln"]["b"],
+                             cfg.layer_norm_eps)
+        h = dropout(h, cfg.embd_dropout, r_embd, deterministic)
+
+        bias = None
+        if attention_mask is not None:
+            # [B, S] 1/0 mask -> additive [B, 1, 1, S]
+            bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0,
+                             -1e9).astype(jnp.float32)
+
+        layer_fn = self.layer
+
+        def body(carry, xs):
+            layer_params, layer_rng = xs
+            out = layer_fn(layer_params, carry, attn_mask=bias, rng=layer_rng,
+                           deterministic=deterministic)
+            return out, None
+
+        if cfg.activation_checkpointing:
+            body = jax.checkpoint(body)
+        layer_rngs = jax.random.split(r_layers, cfg.num_layers)
+        h, _ = jax.lax.scan(body, h, (params["h"], layer_rngs))
+        return h
+
+    def mlm_loss(self, params, rng, input_ids, labels,
+                 attention_mask=None, token_type_ids=None):
+        """Masked-LM loss; positions with labels == ignore_index are
+        excluded (reference objective, bing_bert pretraining)."""
+        cfg = self.config
+        h = self.hidden_states(params, input_ids, attention_mask,
+                               token_type_ids, rng)
+        logits = (h @ params["wte"].astype(h.dtype).T).astype(jnp.float32)
+        valid = labels != cfg.ignore_index
+        safe_labels = jnp.where(valid, labels, 0)
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(
+            logits, safe_labels)
+        denom = jnp.maximum(jnp.sum(valid), 1)
+        return jnp.sum(per_tok * valid) / denom
+
+    def __call__(self, params, rng, input_ids, labels,
+                 attention_mask=None, token_type_ids=None):
+        return self.mlm_loss(params, rng, input_ids, labels,
+                             attention_mask, token_type_ids)

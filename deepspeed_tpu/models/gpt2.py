@@ -1,0 +1,181 @@
+"""GPT-2 family — the flagship decoder LM (the role Megatron-GPT2 plays for
+the reference's headline ZeRO benchmarks, docs/_tutorials/megatron.md).
+
+TPU-native structure:
+  - all transformer layers stored STACKED (leading layer axis) and executed
+    with `lax.scan` — one compiled layer body regardless of depth, the
+    XLA-friendly analog of the reference's per-layer module list;
+  - per-layer activation checkpointing = `jax.checkpoint` around the scanned
+    body (reference: runtime/activation_checkpointing/checkpointing.py);
+  - tensor parallelism is declarative: `param_partition_specs` emits
+    Megatron-style column/row specs over the "model" mesh axis, vocab-sharded
+    embedding included (the role of Megatron's VocabParallelEmbedding).
+"""
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+from ..ops.transformer import (DeepSpeedTransformerConfig,
+                               DeepSpeedTransformerLayer)
+from ..ops.normalize import fused_layer_norm
+from ..ops.activations import dropout
+from ..parallel.mesh import MODEL_AXIS
+
+
+@dataclass
+class GPT2Config:
+    vocab_size: int = 50304          # 50257 padded to a 128 multiple (MXU)
+    n_positions: int = 1024
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: Optional[int] = None
+    embd_dropout: float = 0.1
+    attn_dropout: float = 0.1
+    hidden_dropout: float = 0.1
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    bf16: bool = True
+    activation_checkpointing: bool = False
+    tie_word_embeddings: bool = True
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+
+    @property
+    def dtype(self):
+        return jnp.bfloat16 if self.bf16 else jnp.float32
+
+    def layer_config(self) -> DeepSpeedTransformerConfig:
+        return DeepSpeedTransformerConfig(
+            hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            heads=self.num_heads,
+            attn_dropout_ratio=self.attn_dropout,
+            hidden_dropout_ratio=self.hidden_dropout,
+            num_hidden_layers=self.num_layers,
+            initializer_range=self.initializer_range,
+            layer_norm_eps=self.layer_norm_eps,
+            bf16=self.bf16,
+            pre_layer_norm=True,
+            causal=True,
+        )
+
+    def num_params(self, include_embeddings: bool = True) -> int:
+        layer = DeepSpeedTransformerLayer(self.layer_config())
+        n = self.num_layers * layer.num_params() + 2 * self.hidden_size
+        if include_embeddings:
+            n += (self.vocab_size + self.n_positions) * self.hidden_size
+        return n
+
+    def flops_per_token(self) -> int:
+        """Training FLOPs/token (fwd+bwd ≈ 6N + attention term), the
+        standard accounting used for MFU."""
+        n = self.num_params(include_embeddings=False)
+        attn = 12 * self.num_layers * self.hidden_size * self.n_positions
+        return 6 * n + attn
+
+
+class GPT2Model:
+    """Decoder-only LM over stacked DeepSpeedTransformerLayers."""
+
+    def __init__(self, config: GPT2Config):
+        self.config = config
+        self.layer = DeepSpeedTransformerLayer(config.layer_config())
+
+    # -- parameters ---------------------------------------------------- #
+    def init_params(self, rng):
+        cfg = self.config
+        k_wte, k_wpe, k_layers = jax.random.split(rng, 3)
+        init = jax.nn.initializers.normal(cfg.initializer_range)
+        layer_keys = jax.random.split(k_layers, cfg.num_layers)
+        stacked = jax.vmap(self.layer.init_params)(layer_keys)
+        params = {
+            "wte": init(k_wte, (cfg.vocab_size, cfg.hidden_size), jnp.float32),
+            "wpe": init(k_wpe, (cfg.n_positions, cfg.hidden_size),
+                        jnp.float32),
+            "h": stacked,
+            "ln_f": {"w": jnp.ones((cfg.hidden_size,), jnp.float32),
+                     "b": jnp.zeros((cfg.hidden_size,), jnp.float32)},
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = init(
+                jax.random.fold_in(k_wte, 1),
+                (cfg.hidden_size, cfg.vocab_size), jnp.float32)
+        return params
+
+    def param_partition_specs(self):
+        """TP specs: vocab-sharded embeddings + Megatron column/row layer
+        splits over the "model" axis."""
+        layer_specs = DeepSpeedTransformerLayer.param_partition_specs()
+        stacked_specs = {k: P(None, *list(s)) for k, s in layer_specs.items()}
+        specs = {
+            "wte": P(MODEL_AXIS, None),
+            "wpe": P(),
+            "h": stacked_specs,
+            "ln_f": {"w": P(), "b": P()},
+        }
+        if not self.config.tie_word_embeddings:
+            specs["lm_head"] = P(None, MODEL_AXIS)
+        return specs
+
+    # -- forward ------------------------------------------------------- #
+    def hidden_states(self, params, input_ids, rng=None,
+                      deterministic: bool = False):
+        """input_ids [B, S] -> final hidden states [B, S, H]."""
+        cfg = self.config
+        b, s = input_ids.shape
+        if rng is None:
+            deterministic = True
+            rng = jax.random.PRNGKey(0)
+        r_embd, r_layers = jax.random.split(rng)
+
+        wte = params["wte"].astype(cfg.dtype)
+        wpe = params["wpe"].astype(cfg.dtype)
+        h = wte[input_ids] + wpe[jnp.arange(s)]
+        h = dropout(h, cfg.embd_dropout, r_embd, deterministic)
+
+        layer_fn = self.layer
+
+        def body(carry, xs):
+            layer_params, layer_rng = xs
+            out = layer_fn(layer_params, carry, rng=layer_rng,
+                           deterministic=deterministic)
+            return out, None
+
+        if cfg.activation_checkpointing:
+            body = jax.checkpoint(body)
+
+        layer_rngs = jax.random.split(r_layers, cfg.num_layers)
+        h, _ = jax.lax.scan(body, h, (params["h"], layer_rngs))
+        return fused_layer_norm(h, params["ln_f"]["w"], params["ln_f"]["b"],
+                                cfg.layer_norm_eps)
+
+    def logits(self, params, input_ids, rng=None, deterministic=False):
+        h = self.hidden_states(params, input_ids, rng, deterministic)
+        if self.config.tie_word_embeddings:
+            head = params["wte"].astype(h.dtype).T
+        else:
+            head = params["lm_head"].astype(h.dtype)
+        return h @ head
+
+    def loss(self, params, rng, input_ids, labels=None):
+        """Next-token cross entropy (fp32 softmax).  When labels is None,
+        input_ids[:, 1:] serve as targets."""
+        if labels is None:
+            labels = input_ids[:, 1:]
+            input_ids = input_ids[:, :-1]
+        logits = self.logits(params, input_ids, rng,
+                             deterministic=rng is None).astype(jnp.float32)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+
+    # engine entry point: model(params, rng, batch...) -> loss
+    def __call__(self, params, rng, input_ids, labels=None):
+        return self.loss(params, rng, input_ids, labels)

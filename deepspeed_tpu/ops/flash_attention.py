@@ -9,9 +9,11 @@ runs in one Pallas kernel, streaming K/V blocks through VMEM with fp32
 accumulators (flash-attention style).  The MXU sees two big matmuls per block
 pair; HBM traffic is O(S*d) instead of O(S^2).
 
-Backward currently recomputes attention with the XLA reference path (exact
-same math, fp32 softmax) via custom_vjp; a dedicated Pallas backward kernel is
-a later optimization.
+Backward is the FlashAttention-2 scheme: forward saves only the per-row
+logsumexp; two Pallas kernels recompute P block-wise and produce dk/dv
+(grid over k blocks) and dq (grid over q blocks) with no [S, S] HBM
+materialization.  The XLA reference path serves CPU and the bias/fallback
+cases.
 """
 
 import functools
@@ -33,6 +35,9 @@ except ImportError:  # pragma: no cover
 DEFAULT_MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
 
 _LANES = 128  # TPU lane width; softmax stats are carried at this width
+# Row statistics (logsumexp, delta) ride as [B,H,S,8] so their blocks satisfy
+# Mosaic's last-two-dims tiling rule; lane 0 holds the value.
+_STATS_LANES = 8
 
 
 # --------------------------------------------------------------------------- #
@@ -62,7 +67,7 @@ def mha_reference(q, k, v, causal: bool = False,
 # --------------------------------------------------------------------------- #
 # Pallas kernel
 # --------------------------------------------------------------------------- #
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
                causal: bool, sm_scale: float, block_q: int, block_k: int,
                num_k_blocks: int):
     qi = pl.program_id(2)
@@ -121,13 +126,27 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         # Fully-masked rows have l == 0; emit zeros not NaN.
         l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        # logsumexp residual for the backward pass (FlashAttention-2 style)
+        lse = m_scr[...][:, :1] + jnp.log(l_scr[...][:, :1] + 1e-37)
+        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
+
+
+def _check_blocks(q_len, k_len, block_q, block_k):
+    block_q = min(block_q, q_len)
+    block_k = min(block_k, k_len)
+    if q_len % block_q or k_len % block_k:
+        raise ValueError(
+            f"seq lengths ({q_len},{k_len}) must divide into blocks "
+            f"({block_q},{block_k})")
+    return block_q, block_k
 
 
 def flash_attention_pallas(q, k, v, causal: bool = False,
                            sm_scale: Optional[float] = None,
                            block_q: int = 128, block_k: int = 128,
-                           interpret: bool = False):
-    """Pallas flash attention. q,k,v: [B, H, S, D] -> [B, H, S, D]."""
+                           interpret: bool = False, return_lse: bool = False):
+    """Pallas flash attention. q,k,v: [B, H, S, D] -> [B, H, S, D]
+    (+ logsumexp [B, H, S] when return_lse)."""
     if pltpu is None:
         raise RuntimeError(
             "pallas TPU support unavailable in this jax install — use "
@@ -136,12 +155,7 @@ def flash_attention_pallas(q, k, v, causal: bool = False,
     k_len = k.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
-    block_q = min(block_q, q_len)
-    block_k = min(block_k, k_len)
-    if q_len % block_q or k_len % block_k:
-        raise ValueError(
-            f"seq lengths ({q_len},{k_len}) must divide into blocks "
-            f"({block_q},{block_k})")
+    block_q, block_k = _check_blocks(q_len, k_len, block_q, block_k)
     nq, nk = q_len // block_q, k_len // block_k
 
     kernel = functools.partial(
@@ -159,7 +173,7 @@ def flash_attention_pallas(q, k, v, causal: bool = False,
         params["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary"))
-    return pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -167,13 +181,203 @@ def flash_attention_pallas(q, k, v, causal: bool = False,
             pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d),
-                               lambda b, h, i, j: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, _STATS_LANES),
+                         lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((batch, heads, q_len, _STATS_LANES),
+                                 jnp.float32),
+        ],
         scratch_shapes=scratch,
         interpret=interpret,
         **params,
     )(q, k, v)
+    return (out, lse[..., 0]) if return_lse else out
+
+
+# --------------------------------------------------------------------------- #
+# Pallas backward kernels (FlashAttention-2 style)
+# --------------------------------------------------------------------------- #
+def _fa_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dk_ref, dv_ref, dk_scr, dv_scr, *,
+                        causal, sm_scale, block_q, block_k, num_q_blocks):
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    should_compute = True
+    if causal:  # q block fully above the diagonal contributes nothing
+        should_compute = qi * block_q + block_q - 1 >= ki * block_k
+
+    @pl.when(should_compute)
+    def _compute():
+        q = q_ref[0, 0]                               # [bq, d]
+        k = k_ref[0, 0]                               # [bk, d]
+        v = v_ref[0, 0]                               # [bk, d]
+        do = do_ref[0, 0]                             # [bq, d]
+        lse = lse_ref[0, 0][:, :1]                    # [bq, 1]
+        delta = delta_ref[0, 0][:, :1]                # [bq, 1]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale   # [bq, bk]
+        p = jnp.exp(s - lse)                          # [bq, bk] fp32
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            p = jnp.where(col > row, 0.0, p)
+
+        pt = p.astype(do.dtype)
+        dv_scr[...] += jax.lax.dot_general(            # p^T @ do -> [bk, d]
+            pt, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(                      # do @ v^T -> [bq, bk]
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale               # [bq, bk] fp32
+        dk_scr[...] += jax.lax.dot_general(            # ds^T @ q -> [bk, d]
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dq_scr, *,
+                      causal, sm_scale, block_q, block_k, num_k_blocks):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    should_compute = True
+    if causal:
+        should_compute = qi * block_q + block_q - 1 >= ki * block_k
+
+    @pl.when(should_compute)
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        p = jnp.exp(s - lse)
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            p = jnp.where(col > row, 0.0, p)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_scr[...] += jax.lax.dot_general(            # ds @ k -> [bq, d]
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
+                               sm_scale: Optional[float] = None,
+                               block_q: int = 128, block_k: int = 128,
+                               interpret: bool = False):
+    """Block-wise dq, dk, dv — no [S, S] materialization in HBM."""
+    batch, heads, q_len, d = q.shape
+    k_len = k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    block_q, block_k = _check_blocks(q_len, k_len, block_q, block_k)
+    nq, nk = q_len // block_q, k_len // block_k
+
+    # delta_i = rowsum(dO_i * O_i)  (cheap elementwise; leave to XLA)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                           # [B, H, S]
+    stats_shape = (*delta.shape, _STATS_LANES)
+    delta = jnp.broadcast_to(delta[..., None], stats_shape)
+    lse = jnp.broadcast_to(lse[..., None], stats_shape)
+
+    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0))
+    k_spec = pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0))
+    r_spec = pl.BlockSpec((1, 1, block_q, _STATS_LANES),
+                          lambda b, h, i, j: (b, h, i, 0))
+    params = {}
+    if not interpret:
+        params["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"))
+
+    # dk/dv: grid over k blocks, inner loop over q blocks
+    dkdv_kernel = functools.partial(
+        _fa_bwd_dkdv_kernel, causal=causal, sm_scale=float(sm_scale),
+        block_q=block_q, block_k=block_k, num_q_blocks=nq)
+    dk, dv = pl.pallas_call(
+        dkdv_kernel,
+        grid=(batch, heads, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, _STATS_LANES),
+                         lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, _STATS_LANES),
+                         lambda b, h, j, i: (b, h, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, j, i: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+        **params,
+    )(q, k, v, do, lse, delta)
+
+    # dq: grid over q blocks, inner loop over k blocks
+    dq_kernel = functools.partial(
+        _fa_bwd_dq_kernel, causal=causal, sm_scale=float(sm_scale),
+        block_q=block_q, block_k=block_k, num_k_blocks=nk)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(batch, heads, nq, nk),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+        **params,
+    )(q, k, v, do, lse, delta)
+
+    return dq, dk, dv
 
 
 # --------------------------------------------------------------------------- #
@@ -193,16 +397,20 @@ def _use_pallas(q_len, k_len, d, block_q, block_k):
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
     if _use_pallas(q.shape[2], k.shape[2], q.shape[3], block_q, block_k):
-        out = flash_attention_pallas(q, k, v, causal=causal,
-                                     sm_scale=sm_scale,
-                                     block_q=block_q, block_k=block_k)
-    else:
-        out = mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
-    return out, (q, k, v)
+        out, lse = flash_attention_pallas(
+            q, k, v, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k, return_lse=True)
+        return out, (q, k, v, out, lse)
+    out = mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    return out, (q, k, v, None, None)
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
-    q, k, v = res
+    q, k, v, out, lse = res
+    if lse is not None:
+        return flash_attention_bwd_pallas(
+            q, k, v, out, lse, g, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k)
     _, vjp = jax.vjp(
         lambda q_, k_, v_: mha_reference(q_, k_, v_, causal=causal,
                                          sm_scale=sm_scale), q, k, v)

@@ -73,7 +73,13 @@ class DeepSpeedEngine:
         self.global_steps = 0
         self.micro_steps = 0
         self.skipped_steps = 0
-        self.param_specs = None  # tensor-parallel base specs (set by TP layer)
+        # Tensor-parallel base specs: models that declare a Megatron-style
+        # layout (models/gpt2.py param_partition_specs) get it honored
+        # automatically — the role the external Megatron mpu plays in the
+        # reference (engine.py:739-770 adopting mpu's groups).
+        self.param_specs = None
+        if hasattr(model, "param_partition_specs"):
+            self.param_specs = model.param_partition_specs()
 
         # ---- mesh ---------------------------------------------------- #
         # Only the mesh block may be read before the mesh exists (a full

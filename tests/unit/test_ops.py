@@ -38,6 +38,31 @@ def test_flash_attention_pallas_matches_reference(causal):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_bwd_pallas_matches_reference(causal):
+    from deepspeed_tpu.ops.flash_attention import flash_attention_bwd_pallas
+    q, k, v = _qkv(s=128)
+    do = jax.random.normal(jax.random.PRNGKey(7), q.shape, q.dtype)
+    out, lse = flash_attention_pallas(q, k, v, causal=causal, block_q=64,
+                                      block_k=64, interpret=True,
+                                      return_lse=True)
+    dq, dk, dv = flash_attention_bwd_pallas(
+        q, k, v, out, lse, do, causal=causal, block_q=64, block_k=64,
+        interpret=True)
+
+    def ref_loss(q_, k_, v_):
+        r = mha_reference(q_, k_, v_, causal=causal).astype(jnp.float32)
+        return jnp.vdot(r, do.astype(jnp.float32))
+
+    rq, rk, rv = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(rq), rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(rk), rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(rv), rtol=1e-4,
+                               atol=1e-4)
+
+
 def test_flash_attention_public_dispatch_and_grad():
     q, k, v = _qkv(s=64)
     out = flash_attention(q, k, v, causal=True)
