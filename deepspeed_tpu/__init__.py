@@ -19,7 +19,7 @@ from .utils import logger, log_dist
 def initialize(args=None, model=None, config=None, config_params=None,
                optimizer=None, model_parameters=None, lr_scheduler=None,
                mesh=None, dist_init_required=None, collate_fn=None,
-               training_data=None, mpu=None, rng=None):
+               training_data=None, mpu=None, rng=None, example_input=None):
     """Create a TPU-backed training engine (reference: deepspeed/__init__.py:61).
 
     Returns (engine, optimizer, dataloader, lr_scheduler) like the reference.
@@ -40,7 +40,8 @@ def initialize(args=None, model=None, config=None, config_params=None,
         engine = PipelineEngine(model=model, config=cfg, optimizer=optimizer,
                                 lr_scheduler=lr_scheduler, mesh=mesh, mpu=mpu,
                                 training_data=training_data,
-                                collate_fn=collate_fn, rng=rng)
+                                collate_fn=collate_fn, rng=rng,
+                                example_input=example_input)
     else:
         engine = DeepSpeedEngine(model=model, config=cfg, optimizer=optimizer,
                                  model_parameters=model_parameters,

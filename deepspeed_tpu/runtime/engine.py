@@ -60,6 +60,29 @@ def _tree_cast(tree, dtype):
             x.dtype, jnp.floating) else x, tree)
 
 
+def resolve_mesh_ctx(config, mesh) -> MeshContext:
+    """Resolve the engine's MeshContext from (in order) an explicit `mesh`
+    argument, the global registry, or the config's "mesh" block.  Only the
+    mesh block may be read before the mesh exists (a full config parse would
+    run the batch assertion with the wrong world size)."""
+    if mesh is None:
+        existing = mesh_mod.get_mesh_context(required=False)
+        if existing is not None:
+            return existing
+        from ..config import MeshConfig
+        from ..config_utils import load_config_dict
+        from .. import constants as C
+        raw = (config._param_dict if isinstance(config, DeepSpeedConfig)
+               else load_config_dict(config))
+        mesh_cfg = MeshConfig.from_dict(raw.get(C.MESH))
+        ctx = MeshContext.from_config(mesh_cfg)
+        mesh_mod.set_mesh_context(ctx)
+        return ctx
+    ctx = mesh if isinstance(mesh, MeshContext) else MeshContext(mesh)
+    mesh_mod.set_mesh_context(ctx)
+    return ctx
+
+
 class DeepSpeedEngine:
     """Config-driven training engine over a named-axis TPU mesh."""
 
@@ -82,28 +105,7 @@ class DeepSpeedEngine:
             self.param_specs = model.param_partition_specs()
 
         # ---- mesh ---------------------------------------------------- #
-        # Only the mesh block may be read before the mesh exists (a full
-        # config parse would run the batch assertion with the wrong world
-        # size).
-        if mesh is None:
-            existing = mesh_mod.get_mesh_context(required=False)
-            if existing is not None:
-                self.mesh_ctx = existing
-            else:
-                from ..config import MeshConfig
-                from ..config_utils import load_config_dict
-                from .. import constants as C
-                raw = (config._param_dict if isinstance(config, DeepSpeedConfig)
-                       else load_config_dict(config))
-                mesh_cfg = MeshConfig.from_dict(raw.get(C.MESH))
-                self.mesh_ctx = MeshContext.from_config(mesh_cfg)
-                mesh_mod.set_mesh_context(self.mesh_ctx)
-        elif isinstance(mesh, MeshContext):
-            self.mesh_ctx = mesh
-            mesh_mod.set_mesh_context(self.mesh_ctx)
-        else:  # raw jax Mesh
-            self.mesh_ctx = MeshContext(mesh)
-            mesh_mod.set_mesh_context(self.mesh_ctx)
+        self.mesh_ctx = resolve_mesh_ctx(config, mesh)
 
         dp_world = self.mesh_ctx.data_parallel_world_size
         self.config = (config if isinstance(config, DeepSpeedConfig)

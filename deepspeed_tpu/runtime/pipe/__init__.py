@@ -1,1 +1,4 @@
-from .module import LayerSpec, PipelineModule, TiedLayerSpec
+from .module import (FlaxLayer, FnLayer, LayerSpec, PipeLayer,
+                     PipelineModule, TiedLayerSpec)
+from .topology import (PipeDataParallelTopology, PipeModelDataParallelTopology,
+                       PipelineParallelGrid, ProcessTopology)

@@ -1,15 +1,317 @@
-"""PipelineEngine — lands with the pipeline-parallel milestone.
+"""PipelineEngine — pipeline-parallel training compiled to one XLA program.
 
-Reference: deepspeed/runtime/pipe/engine.py:46.  The TPU design executes the
-declarative PipeSchedule instruction stream (schedule.py) as a
-scan-over-microbatches with collective-permute p2p over the "pipe" mesh axis.
+Reference: deepspeed/runtime/pipe/engine.py:46 — train_batch:250,
+eval_batch:328, the instruction executors (:540-1005) and _exec_schedule:1209.
+
+TPU-native design ("collective pipelining", the GSPMD/praxis pattern): the
+reference is MPMD — each rank runs its stage's instruction stream with
+explicit p2p (pipe/p2p.py:31).  Under SPMD one compiled program serves all
+stages instead:
+
+  - body params are STACKED [num_stages, layers_per_stage, ...] and sharded
+    over the "pipe" mesh axis (each stage's devices hold only its layers),
+  - a circular activation buffer [num_stages, micro_batch, ...] is also
+    pipe-sharded; each tick every stage applies its layers to its slot via
+    jax.vmap over the stage dim (devices compute in parallel, zero comms),
+  - the buffer then shifts one stage with jnp.roll along the sharded dim —
+    XLA lowers that to a collective-permute over ICI: the SendActivation/
+    RecvActivation pair of the schedule,
+  - a scan over micro_batches + num_stages - 1 ticks realizes the fill/drain
+    GPipe schedule; jax.grad through the scan reverses every permute,
+    yielding the SendGrad/RecvGrad stream; rematerialization on the stage
+    body bounds live activations like 1F1B's buffer count,
+  - pre/post chains (embedding / head) run replicated across the pipe axis —
+    cheap relative to the body, and their params can still be ZeRO-sharded.
+
+The declarative schedule (schedule.py) stays the semantic source of truth;
+train_batch consumes gradient_accumulation_steps microbatches per call like
+the reference (pipe/engine.py:250).
 """
 
-from .module import PipelineModule  # noqa: F401
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...config import DeepSpeedConfig
+from ...parallel.mesh import DATA_AXIS, EXPERT_AXIS, PIPE_AXIS
+from ...utils.logging import log_dist
+from ..engine import DeepSpeedEngine, resolve_mesh_ctx
+from .module import PipelineModule
+from .topology import PipelineParallelGrid
 
 
-class PipelineEngine:
-    def __init__(self, *args, **kwargs):
-        raise NotImplementedError(
-            "PipelineEngine is not wired yet — coming with the pipeline "
-            "milestone (SURVEY.md §7 step 6)")
+class _PipeModel:
+    """Callable wrapper carrying the pipeline's partition specs into the base
+    engine (which honors model.param_partition_specs())."""
+
+    def __init__(self, fn, specs):
+        self._fn = fn
+        self._specs = specs
+
+    def __call__(self, params, rng, *args, **kwargs):
+        return self._fn(params, rng, *args, **kwargs)
+
+    def param_partition_specs(self):
+        return self._specs
+
+
+class PipelineEngine(DeepSpeedEngine):
+    """Executes a PipelineModule as a scan-over-ticks pipeline over the
+    "pipe" mesh axis (reference: pipe/engine.py:46)."""
+
+    def __init__(self, model: PipelineModule, config=None, optimizer=None,
+                 lr_scheduler=None, mesh=None, mpu=None, training_data=None,
+                 collate_fn=None, rng=None, example_input=None):
+        assert isinstance(model, PipelineModule), \
+            "PipelineEngine needs a PipelineModule"
+        ctx = resolve_mesh_ctx(config, mesh)
+        num_stages = ctx.pipe_parallel_world_size
+        if model.num_stages in (None, 1):
+            model.num_stages = num_stages
+        if model.num_stages != num_stages:
+            raise ValueError(
+                f"PipelineModule has num_stages={model.num_stages} but the "
+                f"mesh pipe axis is {num_stages}")
+        self.pipeline_module = model
+        self.num_stages = num_stages
+        self.grid = PipelineParallelGrid(mesh_ctx=ctx)
+
+        dp = ctx.data_parallel_world_size
+        cfg = (config if isinstance(config, DeepSpeedConfig)
+               else DeepSpeedConfig(config, world_size=dp))
+        self._micro_batches = cfg.gradient_accumulation_steps
+        micro_global = cfg.train_micro_batch_size_per_gpu * dp
+
+        # ---- init pipeline params ------------------------------------ #
+        init_rng = rng if rng is not None else jax.random.PRNGKey(
+            model.base_seed)
+        init_rng, build_rng = jax.random.split(init_rng)
+        if example_input is None:
+            if training_data is not None:
+                sample = training_data[0]
+                x0 = sample[0] if isinstance(sample, (tuple, list)) else sample
+                example_input = jnp.zeros((micro_global,) + np.shape(x0),
+                                          jnp.asarray(x0).dtype)
+            else:
+                raise ValueError(
+                    "PipelineEngine needs example_input (one microbatch, "
+                    "global shape) or training_data to infer shapes — JAX "
+                    "init requires shapes up front")
+        pipeline_params = model.build(build_rng, example_input)
+
+        apply_fn = self._make_pipelined_apply(ctx, deterministic=False)
+        self._eval_apply = self._make_pipelined_apply(ctx, deterministic=True)
+        specs = self._make_partition_specs(pipeline_params)
+        super().__init__(model=_PipeModel(apply_fn, specs), config=cfg,
+                         optimizer=optimizer,
+                         model_parameters=pipeline_params,
+                         lr_scheduler=lr_scheduler, mesh=ctx, mpu=mpu,
+                         training_data=training_data, collate_fn=collate_fn,
+                         rng=init_rng)
+        self._eval_fn = None
+        log_dist(
+            f"PipelineEngine: stages={num_stages} "
+            f"micro_batches={self._micro_batches} "
+            f"body_layers={model.body_range[1] - model.body_range[0]}",
+            ranks=[0])
+
+    # ------------------------------------------------------------------ #
+    @property
+    def micro_batches(self) -> int:
+        return self._micro_batches
+
+    def is_first_stage(self) -> bool:
+        return self.grid.is_first_stage()
+
+    def is_last_stage(self) -> bool:
+        return self.grid.is_last_stage()
+
+    # ------------------------------------------------------------------ #
+    def _make_partition_specs(self, pipeline_params):
+        """blocks → leading 'pipe' dim (plus the body layer's own TP specs if
+        it declares them); pre/post/tied replicated (ZeRO may still shard)."""
+        module = self.pipeline_module
+        body = module.body_layer()
+        layer_specs = None
+        if hasattr(body, "param_partition_specs"):
+            layer_specs = body.param_partition_specs()
+
+        def block_spec(path_spec, leaf):
+            if path_spec is not None:
+                return PartitionSpec(PIPE_AXIS, None, *path_spec)
+            return PartitionSpec(PIPE_AXIS)
+
+        if layer_specs is not None:
+            blocks = jax.tree.map(block_spec, layer_specs,
+                                  pipeline_params["blocks"],
+                                  is_leaf=lambda x: x is None or
+                                  isinstance(x, PartitionSpec))
+        else:
+            blocks = jax.tree.map(lambda _: PartitionSpec(PIPE_AXIS),
+                                  pipeline_params["blocks"])
+        return {"pre": None, "blocks": blocks, "post": None, "tied": None}
+
+    # ------------------------------------------------------------------ #
+    def _make_pipelined_apply(self, ctx, deterministic=False):
+        module = self.pipeline_module
+        S = self.num_stages
+        M = self._micro_batches
+        lo, hi = module.body_range
+        n_layers = len(module.layer_specs)
+        body_layer = module.body_layer()
+        loss_fn = module.loss_fn
+        if loss_fn is None:
+            raise ValueError("PipelineModule.loss_fn is required for training")
+        mesh = ctx.mesh
+
+        def constrain(x, *spec):
+            return lax.with_sharding_constraint(
+                x, NamedSharding(mesh, PartitionSpec(*spec)))
+
+        def one_layer(carry, layer_params_and_idx):
+            layer_params, seed = layer_params_and_idx
+            r = (None if deterministic
+                 else jax.random.fold_in(jax.random.PRNGKey(0), seed))
+            return body_layer.apply(layer_params, carry, rng=r), None
+
+        # activation checkpointing: any interval > 0 remats at per-layer
+        # granularity — the finest; recompute is cheap relative to holding
+        # T × per-stage activations in HBM (the role of the reference's
+        # activation_checkpoint_interval, pipe/module.py:87)
+        if module.activation_checkpoint_interval > 0:
+            one_layer = jax.checkpoint(one_layer)
+
+        def stage_apply(stage_params, x, seed):
+            # scan over this stage's layers_per_stage blocks
+            k = jax.tree.leaves(stage_params)[0].shape[0]
+            seeds = seed + jnp.arange(k)
+            x, _ = lax.scan(one_layer, x, (stage_params, seeds))
+            return x
+
+        def pipelined_apply(params, rng, x, y):
+            pre, blocks = params["pre"], params["blocks"]
+            post, tied = params["post"], params["tied"]
+            # [M*Bg, ...] -> [M, Bg, ...]; microbatch dim unsharded, batch
+            # dim over the data axes
+            xm = x.reshape((M, -1) + x.shape[1:])
+            ym = y.reshape((M, -1) + y.shape[1:])
+            xm = constrain(xm, None, (DATA_AXIS, EXPERT_AXIS))
+            ym = constrain(ym, None, (DATA_AXIS, EXPERT_AXIS))
+
+            rng_pre, rng_post, rng_body = jax.random.split(rng, 3)
+            if deterministic:
+                h = jax.vmap(lambda xb: module.chain_apply(
+                    range(lo), pre, tied, xb, rng=None))(xm)
+            else:
+                pre_keys = jax.random.split(rng_pre, M)
+                h = jax.vmap(
+                    lambda xb, r: module.chain_apply(range(lo), pre, tied, xb,
+                                                     rng=r))(xm, pre_keys)
+            h = constrain(h, None, (DATA_AXIS, EXPERT_AXIS))
+
+            # fill/drain pipeline over T ticks
+            T = M + S - 1
+            buf0 = jnp.zeros((S,) + h.shape[1:], h.dtype)
+            outs0 = jnp.zeros_like(h)
+            pad = jnp.zeros((S - 1,) + h.shape[1:], h.dtype)
+            h_pad = jnp.concatenate([h, pad], axis=0)
+            seed_base = jax.random.randint(rng_body, (), 0, 2**31 - 1)
+
+            def tick(carry, t):
+                buf, outs = carry
+                inp = lax.dynamic_index_in_dim(h_pad, t, 0, keepdims=False)
+                buf = buf.at[0].set(inp)
+                buf = constrain(buf, PIPE_AXIS, (DATA_AXIS, EXPERT_AXIS))
+                seeds = seed_base + t * (S * 131071) + jnp.arange(S) * 8191
+                yb = jax.vmap(stage_apply)(blocks, buf, seeds)
+                yb = constrain(yb, PIPE_AXIS, (DATA_AXIS, EXPERT_AXIS))
+                out_t = yb[S - 1]
+                idx = jnp.clip(t - (S - 1), 0, M - 1)
+                outs = lax.cond(
+                    t >= S - 1,
+                    lambda o: lax.dynamic_update_index_in_dim(
+                        o, out_t, idx, 0),
+                    lambda o: o, outs)
+                # the SendActivation/RecvActivation pair: collective-permute
+                # over the pipe axis
+                buf = jnp.roll(yb, 1, axis=0)
+                return (buf, outs), None
+
+            (_, outs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(T))
+            outs = constrain(outs, None, (DATA_AXIS, EXPERT_AXIS))
+
+            def per_micro_loss(h_out, yb, r):
+                o = module.chain_apply(range(hi, n_layers), post, tied,
+                                       h_out, rng=r)
+                return loss_fn(o, yb)
+
+            if deterministic:
+                losses = jax.vmap(
+                    lambda h_out, yb: per_micro_loss(h_out, yb, None))(
+                        outs, ym)
+            else:
+                post_keys = jax.random.split(rng_post, M)
+                losses = jax.vmap(per_micro_loss)(outs, ym, post_keys)
+            # sum over microbatches: the base engine's apply_step divides by
+            # gradient_accumulation_steps, recovering the mean
+            return losses.sum()
+
+        return pipelined_apply
+
+    # ------------------------------------------------------------------ #
+    # train/eval batch (reference: pipe/engine.py:250,328)
+    # ------------------------------------------------------------------ #
+    def _collect_batch(self, data_iter):
+        xs, ys = [], []
+        for _ in range(self._micro_batches):
+            batch = next(data_iter)
+            x, y = batch[0], batch[1]
+            xs.append(np.asarray(x))
+            ys.append(np.asarray(y))
+        return np.concatenate(xs, axis=0), np.concatenate(ys, axis=0)
+
+    def forward(self, *args, **kwargs):
+        """One fused call computes all microbatches; report the per-microbatch
+        mean loss (the compiled program returns the sum so the base engine's
+        divide-by-gas yields mean gradients)."""
+        loss = super().forward(*args, **kwargs) / self._micro_batches
+        self._last_loss = loss
+        return loss
+
+    def train_batch(self, data_iter=None):
+        """Consume gradient_accumulation_steps microbatches and take one
+        optimizer step; returns the mean loss (reference: pipe/engine.py:250).
+        The whole pipeline (all microbatches, forward+backward+reduce) is one
+        compiled program."""
+        if self.micro_steps % self._micro_batches != 0:
+            raise RuntimeError(
+                "train_batch called mid-accumulation (micro_steps="
+                f"{self.micro_steps}, gas={self._micro_batches}) — finish the "
+                "manual forward/backward/step cycle first")
+        if data_iter is None:
+            if self.training_dataloader is None:
+                raise ValueError("train_batch needs data_iter or training_data")
+            data_iter = iter(self.training_dataloader)
+        x, y = self._collect_batch(data_iter)
+        loss = self.forward(x, y)
+        self.backward(loss)
+        # one fused call consumed all microbatches
+        self.micro_steps += self._micro_batches - 1
+        self.step()
+        return float(loss)
+
+    def eval_batch(self, data_iter):
+        """Forward-only pipelined evaluation, dropout off
+        (reference: pipe/engine.py:328)."""
+        if self._eval_fn is None:
+            self._eval_fn = jax.jit(self._eval_apply)
+        x, y = self._collect_batch(data_iter)
+        batch = self._shard_batch(((x, y), {}))
+        (x, y), _ = batch
+        loss = self._eval_fn(self.params, self._next_rng(), x, y)
+        return float(loss) / self._micro_batches

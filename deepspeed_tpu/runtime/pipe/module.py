@@ -4,16 +4,81 @@ Reference: deepspeed/runtime/pipe/module.py — LayerSpec:25, TiedLayerSpec:73,
 PipelineModule:87, _partition_layers:355 (methods "parameters" / "uniform" /
 "type:regex").
 
-TPU-native: a LayerSpec wraps a pure stage function `fn(params, x) -> x` (or a
-flax module) plus a param initializer; PipelineModule groups specs into
-`num_stages` contiguous stages whose params shard over the "pipe" mesh axis.
-The schedule/executor lives in runtime/pipe/engine.py.
+TPU-native design: the reference builds only the local stage's layers because
+torch pipelining is MPMD (one process per stage).  JAX SPMD compiles ONE
+program for all stages, so a PipelineModule instead splits its layers into
+
+  pre  — leading layers (e.g. embedding) computed replicated across the pipe
+         axis (cheap relative to the body; params may still be ZeRO/TP-sharded),
+  body — the maximal run of structurally-identical layers: their params are
+         STACKED with a leading [num_stages, layers_per_stage] dim sharded
+         over the "pipe" mesh axis, so each stage's devices hold exactly its
+         layers — the memory property the reference gets from building only
+         local layers,
+  post — trailing layers (e.g. final norm + LM head) computed replicated.
+
+The engine (pipe/engine.py) turns this into a scan-over-ticks pipeline with a
+collective-permute shift.  Tied layers (TiedLayerSpec) share one param pytree
+through a `tied` dict keyed by the tie name, giving the reference's
+tied-embedding semantics (pipe/module.py:73) with gradient flow from every use
+handled by autodiff instead of the explicit tied-grad allreduce.
 """
 
-import re
-from typing import Any, Callable, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class PipeLayer:
+    """Layer protocol for pipeline stages: `init_params(rng, x)` returns the
+    param pytree ({} if stateless); `apply(params, x, rng=None)` computes the
+    layer.  Shape inference threads the example input through init."""
+
+    def init_params(self, rng, x):
+        return {}
+
+    def apply(self, params, x, rng=None):
+        raise NotImplementedError
+
+    def num_params(self, params) -> int:
+        return sum(int(np.prod(np.shape(l))) for l in jax.tree.leaves(params))
+
+
+class FnLayer(PipeLayer):
+    """Stateless layer from a bare callable f(x)."""
+
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def apply(self, params, x, rng=None):
+        return self.fn(x)
+
+
+class FlaxLayer(PipeLayer):
+    """Adapter for a flax linen module."""
+
+    def __init__(self, module):
+        self.module = module
+
+    def init_params(self, rng, x):
+        return self.module.init(rng, x)["params"]
+
+    def apply(self, params, x, rng=None):
+        rngs = {"dropout": rng} if rng is not None else None
+        return self.module.apply({"params": params}, x, rngs=rngs)
+
+
+def as_pipe_layer(obj) -> PipeLayer:
+    if isinstance(obj, PipeLayer):
+        return obj
+    if hasattr(obj, "init") and hasattr(obj, "apply"):
+        return FlaxLayer(obj)
+    if callable(obj):
+        return FnLayer(obj)
+    raise TypeError(f"Cannot interpret {obj!r} as a pipeline layer")
 
 
 class LayerSpec:
@@ -24,8 +89,9 @@ class LayerSpec:
         self.module_args = module_args
         self.module_kwargs = module_kwargs
 
-    def build(self):
-        return self.typename(*self.module_args, **self.module_kwargs)
+    def build(self) -> PipeLayer:
+        return as_pipe_layer(self.typename(*self.module_args,
+                                           **self.module_kwargs))
 
     def __repr__(self):
         name = getattr(self.typename, "__name__", str(self.typename))
@@ -70,6 +136,15 @@ def partition_balanced(weights: Sequence[float], num_parts: int) -> List[int]:
     return parts
 
 
+def _params_signature(params) -> tuple:
+    """Structure + leaf shapes/dtypes — two layers with equal signatures can
+    be stacked into one scanned/vmapped body."""
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    return (str(treedef),
+            tuple((tuple(np.shape(l)), str(np.asarray(l).dtype))
+                  for l in leaves))
+
+
 class PipelineModule:
     """A model expressed as a layer list, partitioned into pipeline stages
     (reference: pipe/module.py:87)."""
@@ -83,13 +158,24 @@ class PipelineModule:
                             if callable(l) else l for l in layers]
         self.num_stages = num_stages or 1
         self.loss_fn = loss_fn
+        # "uniform" and "parameters" coincide for the stacked homogeneous
+        # body (every body layer has identical param count); the reference's
+        # "type:regex" weighting has no meaning there.
+        if partition_method.lower() not in ("uniform", "parameters"):
+            raise NotImplementedError(
+                f"partition_method={partition_method!r}: the SPMD pipeline "
+                "stacks a homogeneous body, so stages are uniform by "
+                "construction — use 'uniform' or 'parameters'")
         self.partition_method = partition_method
         self.activation_checkpoint_interval = activation_checkpoint_interval
         self.topology = topology
         self.base_seed = base_seed
-        self._built = [spec.build() if isinstance(spec, LayerSpec) else spec
-                       for spec in self.layer_specs]
-        self.parts = self._partition_layers()
+        self._built: List[PipeLayer] = [
+            spec.build() if isinstance(spec, LayerSpec) else as_pipe_layer(spec)
+            for spec in self.layer_specs]
+        # filled by build(); exposed for the engine
+        self.body_range = None   # (lo, hi) of the stacked body layers
+        self.parts = None        # stage boundaries within the body
 
     def __len__(self):
         return len(self.layer_specs)
@@ -98,33 +184,123 @@ class PipelineModule:
     def layers(self):
         return self._built
 
-    def _layer_weights(self) -> List[float]:
-        method = self.partition_method.lower()
-        if method == "uniform":
-            return [1.0] * len(self._built)
-        if method == "parameters":
-            weights = []
-            for layer in self._built:
-                n = getattr(layer, "num_params", None)
-                weights.append(float(n() if callable(n) else (n or 1)))
-            return weights
-        if method.startswith("type:"):
-            pattern = method.split(":", 1)[1]
-            return [1.0 if re.search(pattern,
-                                     type(layer).__name__, re.IGNORECASE)
-                    else 0.0 for layer in self._built]
-        raise ValueError(f"Unknown partition method {self.partition_method!r}")
-
-    def _partition_layers(self) -> List[int]:
-        weights = self._layer_weights()
-        if all(w == weights[0] for w in weights):
-            return partition_uniform(len(weights), self.num_stages)
-        return partition_balanced(weights, self.num_stages)
-
-    def stage_layers(self, stage_id: int):
-        lo, hi = self.parts[stage_id], self.parts[stage_id + 1]
-        return self._built[lo:hi]
-
     def tied_keys(self):
         return sorted({spec.key for spec in self.layer_specs
                        if isinstance(spec, TiedLayerSpec)})
+
+    # ------------------------------------------------------------------ #
+    # parameter construction (SPMD analog of reference _build:300 which
+    # instantiates only the local stage's layers)
+    # ------------------------------------------------------------------ #
+    def build(self, rng, example_input) -> Dict[str, Any]:
+        """Initialize all layer params by threading `example_input` through
+        the layer chain; returns
+        {"pre": [...], "blocks": stacked, "post": [...], "tied": {...}}.
+
+        `blocks` leaves have leading dims [num_stages, layers_per_stage].
+        """
+        per_layer = []
+        tied: Dict[str, Any] = {}
+        x = example_input
+        for i, (spec, layer) in enumerate(zip(self.layer_specs, self._built)):
+            rng, sub = jax.random.split(rng)
+            key = spec.key if isinstance(spec, TiedLayerSpec) else None
+            if key is not None and key in tied:
+                params = tied[key]
+            else:
+                params = layer.init_params(sub, x)
+                if key is not None:
+                    tied[key] = params
+            per_layer.append(params)
+            if key is not None and spec.forward_fn is not None:
+                x = jax.eval_shape(lambda p, xx, f=spec.forward_fn: f(p, xx),
+                                   params, x)
+            else:
+                x = jax.eval_shape(lambda p, xx, l=layer: l.apply(p, xx),
+                                   params, x)
+            x = jnp.zeros(x.shape, x.dtype) if hasattr(x, "shape") else x
+
+        self.body_range = self._find_body(per_layer)
+        lo, hi = self.body_range
+        n_body = hi - lo
+        if n_body % self.num_stages != 0:
+            raise ValueError(
+                f"pipeline body has {n_body} layers (indices {lo}:{hi}), not "
+                f"divisible by {self.num_stages} stages — pad the model or "
+                f"change num_stages")
+        per_stage = n_body // self.num_stages
+        self.parts = partition_uniform(n_body, self.num_stages)
+
+        body = per_layer[lo:hi]
+        stacked = jax.tree.map(
+            lambda *leaves: jnp.stack(leaves).reshape(
+                (self.num_stages, per_stage) + np.shape(leaves[0])), *body)
+
+        def strip_tied(idx_range):
+            out = []
+            for i in idx_range:
+                spec = self.layer_specs[i]
+                if isinstance(spec, TiedLayerSpec):
+                    out.append(None)  # resolved via tied dict at apply time
+                else:
+                    out.append(per_layer[i])
+            return out
+
+        return {
+            "pre": strip_tied(range(lo)),
+            "blocks": stacked,
+            "post": strip_tied(range(hi, len(per_layer))),
+            "tied": tied,
+        }
+
+    def _find_body(self, per_layer) -> tuple:
+        """Maximal contiguous run of structurally-identical parameterized
+        layers of the same class — the stackable pipeline body."""
+        sigs = []
+        for layer, params in zip(self._built, per_layer):
+            n_leaves = len(jax.tree.leaves(params))
+            sigs.append((type(layer), _params_signature(params))
+                        if n_leaves else None)
+        # tied layers can't live in the stacked body (their params are shared
+        # from the tied dict, not the stack)
+        for i, spec in enumerate(self.layer_specs):
+            if isinstance(spec, TiedLayerSpec):
+                sigs[i] = None
+        best = (0, 0)
+        i = 0
+        while i < len(sigs):
+            if sigs[i] is None:
+                i += 1
+                continue
+            j = i
+            while j < len(sigs) and sigs[j] == sigs[i]:
+                j += 1
+            if j - i > best[1] - best[0]:
+                best = (i, j)
+            i = j
+        if best[1] - best[0] == 0:
+            raise ValueError(
+                "no stackable run of identical layers found — a pipelined "
+                "model needs a homogeneous body (e.g. transformer blocks)")
+        return best
+
+    # -- apply helpers used by the engine ------------------------------ #
+    def chain_apply(self, idx_range, slot_params, tied, x, rng=None):
+        """Apply layers [idx_range] with per-slot params (None ⇒ tied)."""
+        for i, params in zip(idx_range, slot_params):
+            spec = self.layer_specs[i]
+            layer = self._built[i]
+            if isinstance(spec, TiedLayerSpec):
+                p = tied[spec.key]
+                if spec.forward_fn is not None:
+                    x = spec.forward_fn(p, x)
+                    continue
+            else:
+                p = params
+            x = layer.apply(p, x, rng=rng)
+        return x
+
+    def body_layer(self) -> PipeLayer:
+        if self.body_range is None:
+            raise RuntimeError("call build() first")
+        return self._built[self.body_range[0]]

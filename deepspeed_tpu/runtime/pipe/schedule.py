@@ -1,0 +1,250 @@
+"""Declarative pipeline schedules — instruction streams decoupled from
+execution.
+
+Reference: deepspeed/runtime/pipe/schedule.py — PipeSchedule:6 (abstract),
+InferenceSchedule:129, TrainSchedule:182 (1F1B), DataParallelSchedule:292,
+instruction dataclasses :317-481.  The reference's schedule module is already
+device-agnostic (zero torch imports); this module keeps that shape but
+generates the 1F1B stream from an explicit simulation of the compute order
+(warmup forwards → steady 1F1B → cooldown backwards) instead of the
+even/odd-step index arithmetic.
+
+On TPU the *compiled* path (pipe/engine.py) realizes the equivalent dataflow
+as a scan over microbatch ticks with a collective-permute shift over the
+"pipe" mesh axis; these instruction streams remain the source of truth for
+what that dataflow must do, and are what the symbolic schedule tests assert
+against (reference: tests/unit/test_pipe_schedule.py:157).
+"""
+
+from typing import Iterator, List
+
+
+class PipeInstruction:
+    """A single instruction to be executed by a pipeline stage
+    (reference: schedule.py:317)."""
+
+    def __init__(self, **kwargs):
+        self.name = self.__class__.__name__
+        self.kwargs = kwargs
+        for key, val in kwargs.items():
+            setattr(self, key, val)
+
+    def __repr__(self):
+        if self.kwargs:
+            inner = ", ".join(f"{k}={v}" for k, v in sorted(self.kwargs.items()))
+            return f"{self.name}({inner})"
+        return self.name
+
+    def __eq__(self, other):
+        return (type(self) is type(other) and self.kwargs == other.kwargs)
+
+    def __hash__(self):
+        return hash((self.name, tuple(sorted(self.kwargs.items()))))
+
+
+class OptimizerStep(PipeInstruction):
+    """Apply the optimizer (reference: schedule.py:327)."""
+
+
+class ReduceGrads(PipeInstruction):
+    """Data-parallel gradient reduction (reference: schedule.py:336)."""
+
+
+class ReduceTiedGrads(PipeInstruction):
+    """All-reduce gradients of tied weights across the stages that share them
+    (reference: schedule.py:341)."""
+
+
+class BufferOpInstruction(PipeInstruction):
+    """Instruction operating on one of the stage's pipe buffers
+    (reference: schedule.py:354)."""
+
+    def __init__(self, buffer_id, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class LoadMicroBatch(BufferOpInstruction):
+    """First stage loads inputs / last stage loads labels
+    (reference: schedule.py:364)."""
+
+
+class ForwardPass(BufferOpInstruction):
+    """Run the stage's layers forward (reference: schedule.py:377)."""
+
+
+class BackwardPass(BufferOpInstruction):
+    """Backprop through the stage's layers (reference: schedule.py:390)."""
+
+
+class SendActivation(BufferOpInstruction):
+    """Send activations to the next stage (reference: schedule.py:405)."""
+
+
+class RecvActivation(BufferOpInstruction):
+    """Receive activations from the previous stage (reference: schedule.py:425)."""
+
+
+class SendGrad(BufferOpInstruction):
+    """Send activation gradients to the previous stage
+    (reference: schedule.py:445)."""
+
+
+class RecvGrad(BufferOpInstruction):
+    """Receive activation gradients from the next stage
+    (reference: schedule.py:463)."""
+
+
+class PipeSchedule:
+    """Generator of per-step instruction lists for one stage
+    (reference: schedule.py:6)."""
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        assert 0 <= stage_id < stages, "stage_id out of range"
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = stage_id - 1
+        self.next_stage = stage_id + 1
+
+    # -- abstract ------------------------------------------------------ #
+    def steps(self) -> Iterator[List[PipeInstruction]]:
+        raise NotImplementedError
+
+    def num_pipe_buffers(self) -> int:
+        return self.micro_batches
+
+    # -- helpers (reference: schedule.py:61-108) ----------------------- #
+    def _valid_micro_batch(self, micro_batch_id: int) -> bool:
+        return 0 <= micro_batch_id < self.micro_batches
+
+    def _valid_stage(self, stage_id: int) -> bool:
+        return 0 <= stage_id < self.stages
+
+    @property
+    def stage(self) -> int:
+        return self.stage_id
+
+    @property
+    def num_stages(self) -> int:
+        return self.stages
+
+    @property
+    def num_micro_batches(self) -> int:
+        return self.micro_batches
+
+    @property
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.stages - 1
+
+    def _buffer_idx(self, micro_batch_id: int) -> int:
+        assert self._valid_micro_batch(micro_batch_id)
+        return micro_batch_id % self.num_pipe_buffers()
+
+    def __iter__(self):
+        return iter(self.steps())
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only staggered schedule (reference: schedule.py:129).
+
+    At global tick t, stage s forwards microbatch t - s (if valid); inputs
+    ride one tick ahead of the compute wave.
+    """
+
+    def num_pipe_buffers(self) -> int:
+        return 2
+
+    def steps(self):
+        total_steps = self.micro_batches + self.stages - 1
+        for step_id in range(total_steps):
+            micro_batch_id = step_id - self.stage_id
+            cmds: List[PipeInstruction] = []
+            if self._valid_micro_batch(micro_batch_id):
+                buf = self._buffer_idx(micro_batch_id)
+                if self.is_first_stage or self.is_last_stage:
+                    cmds.append(LoadMicroBatch(buf))
+                if self._valid_stage(self.prev_stage):
+                    cmds.append(RecvActivation(buf))
+                cmds.append(ForwardPass(buf))
+                if self._valid_stage(self.next_stage):
+                    cmds.append(SendActivation(buf))
+            yield cmds
+
+
+class TrainSchedule(PipeSchedule):
+    """Non-interleaved 1F1B training schedule (reference: schedule.py:182).
+
+    Compute order for stage s with M microbatches and S stages:
+      - warmup:   W = min(S - 1 - s, M) forward passes,
+      - steady:   alternate (forward W + i, backward i),
+      - cooldown: the remaining W backward passes,
+    which bounds live activations at W + 1 — the 1F1B memory property.
+    """
+
+    def _warmup(self) -> int:
+        return min(self.stages - 1 - self.stage_id, self.micro_batches)
+
+    def num_pipe_buffers(self) -> int:
+        """Max simultaneously-live activations; ≥2 so send/recv can overlap
+        compute (the role of the reference's buffer-count floor)."""
+        return max(2, min(self._warmup() + 1, self.micro_batches))
+
+    def _compute_order(self):
+        """Yield ('fwd'|'bwd', micro_batch_id) in 1F1B order."""
+        w = self._warmup()
+        m = self.micro_batches
+        for i in range(w):
+            yield ("fwd", i)
+        for i in range(m - w):
+            yield ("fwd", w + i)
+            yield ("bwd", i)
+        for i in range(m - w, m):
+            yield ("bwd", i)
+
+    def steps(self):
+        ops = list(self._compute_order())
+        for idx, (kind, mb) in enumerate(ops):
+            buf = self._buffer_idx(mb)
+            cmds: List[PipeInstruction] = []
+            if kind == "fwd":
+                if self._valid_stage(self.prev_stage):
+                    cmds.append(RecvActivation(buf))
+                if self.is_first_stage or self.is_last_stage:
+                    cmds.append(LoadMicroBatch(buf))
+                cmds.append(ForwardPass(buf))
+                if self._valid_stage(self.next_stage):
+                    cmds.append(SendActivation(buf))
+            else:
+                if self._valid_stage(self.next_stage):
+                    cmds.append(RecvGrad(buf))
+                cmds.append(BackwardPass(buf))
+                if self._valid_stage(self.prev_stage):
+                    cmds.append(SendGrad(buf))
+            if idx == len(ops) - 1:
+                cmds.append(ReduceTiedGrads())
+                cmds.append(ReduceGrads())
+                cmds.append(OptimizerStep())
+            yield cmds
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Degenerate single-stage schedule: load/forward/backward each microbatch,
+    reduce + step at the end (reference: schedule.py:292)."""
+
+    def num_pipe_buffers(self) -> int:
+        return 1
+
+    def steps(self):
+        for mb in range(self.micro_batches):
+            cmds: List[PipeInstruction] = [
+                LoadMicroBatch(0),
+                ForwardPass(0),
+                BackwardPass(0),
+            ]
+            if mb == self.micro_batches - 1:
+                cmds.extend([ReduceGrads(), OptimizerStep()])
+            yield cmds

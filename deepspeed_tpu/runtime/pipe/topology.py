@@ -1,0 +1,195 @@
+"""Named-axis cartesian process topology and the pipeline-parallel grid.
+
+Reference: deepspeed/runtime/pipe/topology.py — ProcessTopology:12 (named-axis
+rank map), PipeDataParallelTopology:235, PipelineParallelGrid:252.
+
+On TPU the live communication substrate is the jax Mesh (parallel/mesh.py);
+this module provides the same pure-python rank bookkeeping the reference's
+grid provides — used by the launcher, checkpoint shard naming, and the
+schedule tests — and a PipelineParallelGrid that answers stage/rank queries
+either standalone or backed by a MeshContext.
+"""
+
+import itertools
+from collections import namedtuple
+from typing import Dict, List, Sequence
+
+
+class ProcessTopology:
+    """Maps n-dimensional axis coordinates to flat ranks (row-major, first
+    axis outermost) and back (reference: topology.py:12)."""
+
+    def __init__(self, axes: Sequence[str], dims: Sequence[int]):
+        assert len(axes) == len(dims)
+        self.axes = list(axes)
+        self.dims = list(dims)
+        self.ProcessCoord = namedtuple("ProcessCoord", self.axes)
+        self.mapping: Dict[object, int] = {}
+        for rank, coord in enumerate(itertools.product(
+                *[range(d) for d in self.dims])):
+            self.mapping[self.ProcessCoord(*coord)] = rank
+
+    def get_rank(self, **coord_kwargs) -> int:
+        if len(coord_kwargs) != len(self.axes):
+            raise ValueError(f"get_rank() needs all axes {self.axes}")
+        return self.mapping[self.ProcessCoord(**coord_kwargs)]
+
+    def get_axis_names(self) -> List[str]:
+        return self.axes
+
+    def get_rank_repr(self, rank: int, omit_axes=("data",),
+                      inner_sep="_", outer_sep="-") -> str:
+        """Canonical shard-name fragment, e.g. 'pipe_00-model_00'
+        (reference: topology.py:80 — used in checkpoint file names)."""
+        omit = set(omit_axes)
+        coord = self.get_coord(rank)
+        parts = [f"{axis}{inner_sep}{getattr(coord, axis):02d}"
+                 for axis in self.axes if axis not in omit]
+        return outer_sep.join(parts)
+
+    def get_dim(self, axis: str) -> int:
+        if axis not in self.axes:
+            return 0
+        return self.dims[self.axes.index(axis)]
+
+    def get_coord(self, rank: int):
+        for coord, r in self.mapping.items():
+            if r == rank:
+                return coord
+        raise ValueError(f"rank {rank} not in topology")
+
+    def get_axis_comm_lists(self, axis: str) -> List[List[int]]:
+        """Groups of ranks that differ only along `axis` — the process groups
+        a collective over that axis spans (reference: topology.py:130)."""
+        if axis not in self.axes:
+            return []
+        other_axes = [a for a in self.axes if a != axis]
+        lists = []
+        for other_coord in itertools.product(
+                *[range(self.get_dim(a)) for a in other_axes]):
+            fixed = dict(zip(other_axes, other_coord))
+            ranks = [self.get_rank(**{**fixed, axis: i})
+                     for i in range(self.get_dim(axis))]
+            lists.append(ranks)
+        return lists
+
+    def filter_match(self, **filter_kwargs) -> List[int]:
+        """Ranks whose coordinates match all given axis=value filters
+        (reference: topology.py:163)."""
+        def matches(coord):
+            return all(getattr(coord, k) == v for k, v in filter_kwargs.items())
+        return sorted(rank for coord, rank in self.mapping.items()
+                      if matches(coord))
+
+    def get_axis_list(self, axis: str, idx: int) -> List[int]:
+        return self.filter_match(**{axis: idx})
+
+    def world_size(self) -> int:
+        return len(self.mapping)
+
+    def __str__(self):
+        return str(self.mapping)
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    """Pipe-outer / data-inner 2D topology (reference: topology.py:235):
+    adjacent data-parallel ranks stay close for the bandwidth-heavy gradient
+    reduction; pipeline p2p is the lighter traffic."""
+
+    def __init__(self, num_pp: int, num_dp: int):
+        super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """3D pipe × data × model topology (reference: topology.py:245)."""
+
+    def __init__(self, num_pp: int, num_mp: int, num_dp: int):
+        super().__init__(axes=["pipe", "data", "model"],
+                         dims=[num_pp, num_dp, num_mp])
+
+
+class PipelineParallelGrid:
+    """Stage/rank bookkeeping for the pipeline engine
+    (reference: topology.py:252).
+
+    Either wraps an explicit ProcessTopology (process_id addressing, used by
+    the launcher and tests) or derives one from the live MeshContext — in
+    which case "rank" means position in the flattened (pipe, data, model)
+    grid, the same ordering the mesh lays devices out in.
+    """
+
+    def __init__(self, topology: ProcessTopology = None, mesh_ctx=None,
+                 process_rank: int = 0):
+        if topology is None:
+            if mesh_ctx is None:
+                from ...parallel import mesh as mesh_mod
+                mesh_ctx = mesh_mod.get_mesh_context()
+            topology = PipeModelDataParallelTopology(
+                num_pp=mesh_ctx.pipe_parallel_world_size,
+                num_mp=mesh_ctx.model_parallel_world_size,
+                num_dp=(mesh_ctx.data_parallel_world_size *
+                        mesh_ctx.seq_parallel_world_size))
+        self._topo = topology
+        self.global_rank = process_rank
+        self.world_size = topology.world_size()
+
+        self.pipe_parallel_size = topology.get_dim("pipe")
+        self.data_parallel_size = max(1, topology.get_dim("data"))
+        self.model_parallel_size = max(1, topology.get_dim("model"))
+
+        coord = topology.get_coord(self.global_rank)
+        self.stage_id = getattr(coord, "pipe", 0)
+        self.data_parallel_id = getattr(coord, "data", 0)
+        self.model_parallel_id = getattr(coord, "model", 0)
+
+    # -- queries (reference: topology.py:340-456) ---------------------- #
+    @property
+    def topology(self) -> ProcessTopology:
+        return self._topo
+
+    def get_stage_id(self) -> int:
+        return self.stage_id
+
+    def get_data_parallel_id(self) -> int:
+        return self.data_parallel_id
+
+    def get_pipe_parallel_rank(self) -> int:
+        return self.stage_id
+
+    def get_data_parallel_rank(self) -> int:
+        return self.data_parallel_id
+
+    def get_model_parallel_rank(self) -> int:
+        return self.model_parallel_id
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self.pipe_parallel_size
+
+    def get_data_parallel_world_size(self) -> int:
+        return self.data_parallel_size
+
+    def get_model_parallel_world_size(self) -> int:
+        return self.model_parallel_size
+
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.pipe_parallel_size - 1
+
+    def stage_to_global(self, stage_id: int) -> int:
+        """Rank holding `stage_id` at this grid cell's other coordinates
+        (reference: topology.py:430)."""
+        coord = self._topo.get_coord(self.global_rank)
+        kwargs = coord._asdict()
+        kwargs["pipe"] = stage_id
+        return self._topo.get_rank(**kwargs)
+
+    def p2p_matrix(self) -> List[tuple]:
+        """(src, dst) rank pairs for forward activation flow — the
+        collective-permute permutation the compiled pipeline uses."""
+        pairs = []
+        for group in self._topo.get_axis_comm_lists("pipe"):
+            for a, b in zip(group[:-1], group[1:]):
+                pairs.append((a, b))
+        return pairs

@@ -1,0 +1,138 @@
+"""Symbolic pipeline-schedule tests (reference: tests/unit/test_pipe_schedule.py:157)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.pipe import schedule as sched
+
+
+def _flat(s):
+    return [cmd for step in s.steps() for cmd in step]
+
+
+class TestInferenceSchedule:
+    def test_forward_counts(self):
+        for stages in (1, 2, 4):
+            for stage_id in range(stages):
+                s = sched.InferenceSchedule(micro_batches=4, stages=stages,
+                                            stage_id=stage_id)
+                cmds = _flat(s)
+                fwd = [c for c in cmds if isinstance(c, sched.ForwardPass)]
+                assert len(fwd) == 4
+
+    def test_stagger(self):
+        # stage s first forwards at tick s
+        s = sched.InferenceSchedule(micro_batches=3, stages=4, stage_id=2)
+        steps = list(s.steps())
+        first_fwd = next(i for i, step in enumerate(steps)
+                         if any(isinstance(c, sched.ForwardPass) for c in step))
+        assert first_fwd == 2
+
+    def test_load_only_ends(self):
+        s = sched.InferenceSchedule(micro_batches=3, stages=4, stage_id=1)
+        assert not any(isinstance(c, sched.LoadMicroBatch) for c in _flat(s))
+        for sid in (0, 3):
+            s = sched.InferenceSchedule(micro_batches=3, stages=4, stage_id=sid)
+            loads = [c for c in _flat(s) if isinstance(c, sched.LoadMicroBatch)]
+            assert len(loads) == 3
+
+
+class TestTrainSchedule:
+    @pytest.mark.parametrize("micro_batches", [1, 2, 4, 8])
+    @pytest.mark.parametrize("stages", [1, 2, 4])
+    def test_counts(self, micro_batches, stages):
+        for stage_id in range(stages):
+            s = sched.TrainSchedule(micro_batches, stages, stage_id)
+            cmds = _flat(s)
+            fwd = [c for c in cmds if isinstance(c, sched.ForwardPass)]
+            bwd = [c for c in cmds if isinstance(c, sched.BackwardPass)]
+            assert len(fwd) == micro_batches
+            assert len(bwd) == micro_batches
+            assert len([c for c in cmds
+                        if isinstance(c, sched.OptimizerStep)]) == 1
+            assert len([c for c in cmds
+                        if isinstance(c, sched.ReduceGrads)]) == 1
+
+    @pytest.mark.parametrize("stages", [2, 4])
+    def test_send_recv_pairing(self, stages):
+        """Every SendActivation at stage s has a matching RecvActivation at
+        s+1 (same microbatch order), and symmetrically for grads."""
+        micro = 4
+        streams = {sid: _flat(sched.TrainSchedule(micro, stages, sid))
+                   for sid in range(stages)}
+
+        def order(sid, cls):
+            # microbatch order reconstructed from the compute stream: buffer
+            # ids recycle, so pair sends/recvs positionally
+            return [c.buffer_id for c in streams[sid] if isinstance(c, cls)]
+
+        for sid in range(stages - 1):
+            sends = order(sid, sched.SendActivation)
+            recvs = order(sid + 1, sched.RecvActivation)
+            assert len(sends) == micro and len(recvs) == micro
+            grads_send = order(sid + 1, sched.SendGrad)
+            grads_recv = order(sid, sched.RecvGrad)
+            assert len(grads_send) == micro and len(grads_recv) == micro
+
+    def test_one_f_one_b_memory(self):
+        """Live activations never exceed num_pipe_buffers."""
+        for stages in (2, 4):
+            for stage_id in range(stages):
+                s = sched.TrainSchedule(8, stages, stage_id)
+                live = 0
+                peak = 0
+                for kind, _mb in s._compute_order():
+                    if kind == "fwd":
+                        live += 1
+                    else:
+                        live -= 1
+                    peak = max(peak, live)
+                assert peak <= s.num_pipe_buffers()
+
+    def test_buffer_no_collision(self):
+        """A pipe buffer is never reused before its backward consumed it."""
+        for stages in (2, 4):
+            for stage_id in range(stages):
+                s = sched.TrainSchedule(8, stages, stage_id)
+                in_use = {}
+                for kind, mb in s._compute_order():
+                    buf = s._buffer_idx(mb)
+                    if kind == "fwd":
+                        assert buf not in in_use, \
+                            f"buffer {buf} reused while live (stage {stage_id})"
+                        in_use[buf] = mb
+                    else:
+                        assert in_use.pop(buf) == mb
+
+    def test_first_stage_no_recv_activation(self):
+        s = sched.TrainSchedule(4, 4, 0)
+        cmds = _flat(s)
+        assert not any(isinstance(c, sched.RecvActivation) for c in cmds)
+        assert not any(isinstance(c, sched.SendGrad) for c in cmds)
+
+    def test_last_stage_no_send_activation(self):
+        s = sched.TrainSchedule(4, 4, 3)
+        cmds = _flat(s)
+        assert not any(isinstance(c, sched.SendActivation) for c in cmds)
+        assert not any(isinstance(c, sched.RecvGrad) for c in cmds)
+
+    def test_single_stage_is_pure_compute(self):
+        s = sched.TrainSchedule(4, 1, 0)
+        cmds = _flat(s)
+        assert not any(isinstance(c, (sched.SendActivation,
+                                      sched.RecvActivation, sched.SendGrad,
+                                      sched.RecvGrad)) for c in cmds)
+
+
+class TestDataParallelSchedule:
+    def test_stream(self):
+        s = sched.DataParallelSchedule(micro_batches=2, stages=1, stage_id=0)
+        steps = list(s.steps())
+        assert len(steps) == 2
+        assert any(isinstance(c, sched.OptimizerStep) for c in steps[-1])
+
+
+def test_instruction_repr_eq():
+    assert sched.ForwardPass(1) == sched.ForwardPass(1)
+    assert sched.ForwardPass(1) != sched.ForwardPass(2)
+    assert sched.ForwardPass(1) != sched.BackwardPass(1)
+    assert "buffer_id=1" in repr(sched.ForwardPass(1))

@@ -1,0 +1,89 @@
+"""Topology rank-grid math (reference: tests/unit/test_topology.py:222)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.pipe.topology import (PipeDataParallelTopology,
+                                                 PipeModelDataParallelTopology,
+                                                 PipelineParallelGrid,
+                                                 ProcessTopology)
+
+
+def test_topology_2d():
+    topo = ProcessTopology(axes=["row", "col"], dims=[2, 2])
+    assert topo.world_size() == 4
+    assert topo.get_rank(row=0, col=0) == 0
+    assert topo.get_rank(row=0, col=1) == 1
+    assert topo.get_rank(row=1, col=0) == 2
+    assert topo.get_rank(row=1, col=1) == 3
+
+
+def test_topology_dims():
+    topo = ProcessTopology(axes=["a", "b", "c"], dims=[2, 3, 4])
+    assert topo.world_size() == 24
+    assert topo.get_dim("a") == 2
+    assert topo.get_dim("b") == 3
+    assert topo.get_dim("c") == 4
+    assert topo.get_dim("missing") == 0
+
+
+def test_topology_coord_roundtrip():
+    topo = PipeDataParallelTopology(num_pp=4, num_dp=2)
+    for rank in range(8):
+        coord = topo.get_coord(rank)
+        assert topo.get_rank(pipe=coord.pipe, data=coord.data) == rank
+
+
+def test_comm_lists():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    pipe_lists = topo.get_axis_comm_lists("pipe")
+    data_lists = topo.get_axis_comm_lists("data")
+    # ranks: (p,d) -> p*2+d
+    assert sorted(map(tuple, pipe_lists)) == [(0, 2), (1, 3)]
+    assert sorted(map(tuple, data_lists)) == [(0, 1), (2, 3)]
+
+
+def test_filter_match():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    assert topo.filter_match(pipe=0) == [0, 1, 2, 3]
+    assert topo.filter_match(pipe=1, model=0) == [4, 6]
+
+
+def test_rank_repr():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    # data omitted by default, like the reference's checkpoint shard names
+    assert "pipe_00" in topo.get_rank_repr(0)
+    assert "data" not in topo.get_rank_repr(0)
+
+
+def test_grid_stage_queries():
+    topo = PipeDataParallelTopology(num_pp=4, num_dp=2)
+    grid = PipelineParallelGrid(topology=topo, process_rank=5)
+    coord = topo.get_coord(5)
+    assert grid.get_stage_id() == coord.pipe
+    assert grid.get_data_parallel_id() == coord.data
+    assert grid.get_pipe_parallel_world_size() == 4
+    assert grid.get_data_parallel_world_size() == 2
+    # walking stage_to_global visits one rank per stage, same data coord
+    ranks = [grid.stage_to_global(s) for s in range(4)]
+    assert len(set(ranks)) == 4
+    assert all(topo.get_coord(r).data == coord.data for r in ranks)
+
+
+def test_p2p_matrix():
+    topo = PipeDataParallelTopology(num_pp=3, num_dp=2)
+    grid = PipelineParallelGrid(topology=topo)
+    pairs = grid.p2p_matrix()
+    # every non-final stage sends to its successor within each data column
+    assert len(pairs) == 2 * 2
+    for src, dst in pairs:
+        c_src, c_dst = topo.get_coord(src), topo.get_coord(dst)
+        assert c_dst.pipe == c_src.pipe + 1
+        assert c_dst.data == c_src.data
+
+
+def test_grid_from_mesh():
+    import deepspeed_tpu
+    deepspeed_tpu.initialize_mesh(pipe=4, data=-1)
+    grid = PipelineParallelGrid()
+    assert grid.get_pipe_parallel_world_size() == 4
+    assert grid.get_data_parallel_world_size() == 2
