@@ -14,12 +14,14 @@ from .parallel import (MeshContext, get_mesh_context, initialize_mesh,
                        reset_mesh_context)
 from .parallel import groups
 from .utils import logger, log_dist
+from . import moe
 
 
 def initialize(args=None, model=None, config=None, config_params=None,
                optimizer=None, model_parameters=None, lr_scheduler=None,
                mesh=None, dist_init_required=None, collate_fn=None,
-               training_data=None, mpu=None, rng=None, example_input=None):
+               training_data=None, mpu=None, rng=None, example_input=None,
+               param_partition_specs=None):
     """Create a TPU-backed training engine (reference: deepspeed/__init__.py:61).
 
     Returns (engine, optimizer, dataloader, lr_scheduler) like the reference.
@@ -47,7 +49,8 @@ def initialize(args=None, model=None, config=None, config_params=None,
                                  model_parameters=model_parameters,
                                  lr_scheduler=lr_scheduler, mesh=mesh, mpu=mpu,
                                  training_data=training_data,
-                                 collate_fn=collate_fn, rng=rng)
+                                 collate_fn=collate_fn, rng=rng,
+                                 param_partition_specs=param_partition_specs)
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 
 

@@ -1,0 +1,42 @@
+"""Expert modules (reference: deepspeed/moe/experts.py:9 — class Experts).
+
+The reference deep-copies the user's expert module `num_local_experts` times
+per rank and tags params `allreduce=False, group_name` so the engine reduces
+them over the expert-data group only.  Under SPMD the stacked [E, ...] expert
+params carry a leading "expert" PartitionSpec instead (each expert-parallel
+shard holds E/ep_size experts), and the gradient reduction scope follows from
+the sharding — no tags needed.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class ExpertMLP:
+    """Default expert: 2-layer GeLU MLP, the standard GShard/transformer
+    expert shape (plays the role of the user-supplied expert module in
+    reference moe/layer.py:18)."""
+
+    def __init__(self, d_model: int, d_ff: int = None):
+        self.d_model = d_model
+        self.d_ff = d_ff or 4 * d_model
+
+    def init_params(self, rng, x):
+        k1, k2 = jax.random.split(rng)
+        s1 = 1.0 / np.sqrt(self.d_model)
+        s2 = 1.0 / np.sqrt(self.d_ff)
+        return {
+            "wi": jax.random.normal(k1, (self.d_model, self.d_ff),
+                                    jnp.float32) * s1,
+            "bi": jnp.zeros((self.d_ff,), jnp.float32),
+            "wo": jax.random.normal(k2, (self.d_ff, self.d_model),
+                                    jnp.float32) * s2,
+            "bo": jnp.zeros((self.d_model,), jnp.float32),
+        }
+
+    def apply(self, params, x, rng=None):
+        h = jax.nn.gelu(x @ params["wi"].astype(x.dtype) +
+                        params["bi"].astype(x.dtype))
+        return h @ params["wo"].astype(x.dtype) + params["bo"].astype(x.dtype)

@@ -1,0 +1,67 @@
+"""MoE layer wrapper — the user-facing API (reference: deepspeed/moe/layer.py:18).
+
+Reference semantics: `MoE(hidden_size, expert, num_experts, k, capacity_factor,
+eval_capacity_factor, min_capacity, noisy_gate_policy)` creates the expert
+parallel group (ep_size bounded by world size) and wraps gate + experts;
+forward returns (output, l_aux, exp_counts).
+
+TPU-native: ep_size is the mesh's "expert" axis; num_experts must divide over
+it.  The layer conforms to the PipeLayer protocol (init_params/apply) so it
+drops into plain models, pipeline bodies, and the engine's partition-spec
+discovery alike.
+"""
+
+from typing import Optional
+
+from ..parallel import mesh as mesh_mod
+from ..parallel.mesh import EXPERT_AXIS
+from ..utils.logging import log_dist
+from .experts import ExpertMLP
+from .sharded_moe import MOELayer, TopKGate
+
+
+class MoE:
+    """Gated mixture-of-experts layer (reference: moe/layer.py:18)."""
+
+    def __init__(self, hidden_size: int, expert=None, num_experts: int = 1,
+                 k: int = 1, capacity_factor: float = 1.0,
+                 eval_capacity_factor: float = 1.0, min_capacity: int = 4,
+                 noisy_gate_policy: Optional[str] = None,
+                 expert_ff_size: Optional[int] = None):
+        if noisy_gate_policy is not None and noisy_gate_policy not in (
+                "None", "Jitter", "RSample"):
+            raise ValueError(
+                f"Unsupported noisy_gate_policy {noisy_gate_policy!r}")
+        self.hidden_size = hidden_size
+        self.num_experts = num_experts
+        ctx = mesh_mod.get_mesh_context(required=False)
+        ep_size = ctx.expert_parallel_world_size if ctx is not None else 1
+        if num_experts % max(1, ep_size) != 0:
+            raise ValueError(
+                f"num_experts={num_experts} must divide the expert mesh axis "
+                f"({ep_size})")
+        self.ep_size = ep_size
+        self.num_local_experts = num_experts // max(1, ep_size)
+        log_dist(
+            f"MoE: num_experts={num_experts} ep_size={ep_size} "
+            f"local_experts={self.num_local_experts} k={k}", ranks=[0])
+
+        expert = expert if expert is not None else ExpertMLP(
+            hidden_size, expert_ff_size)
+        gate = TopKGate(hidden_size, num_experts, k, capacity_factor,
+                        eval_capacity_factor, min_capacity,
+                        None if noisy_gate_policy == "None"
+                        else noisy_gate_policy)
+        self.deepspeed_moe = MOELayer(gate, expert, num_experts)
+
+    # -- PipeLayer protocol ------------------------------------------- #
+    def init_params(self, rng, x):
+        return self.deepspeed_moe.init_params(rng, x)
+
+    def param_partition_specs(self, params):
+        return self.deepspeed_moe.param_partition_specs(params)
+
+    def apply(self, params, x, rng=None, train=True):
+        """Returns (output, l_aux, exp_counts) like the reference forward
+        (moe/layer.py:42)."""
+        return self.deepspeed_moe.apply(params, x, rng=rng, train=train)

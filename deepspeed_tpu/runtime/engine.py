@@ -89,7 +89,7 @@ class DeepSpeedEngine:
     def __init__(self, model=None, config=None, optimizer=None,
                  model_parameters=None, lr_scheduler=None, mesh=None, mpu=None,
                  training_data=None, collate_fn=None, rng=None,
-                 dont_change_device=False):
+                 dont_change_device=False, param_partition_specs=None):
         self.module = model
         self.client_optimizer = optimizer
         self.client_lr_scheduler = lr_scheduler
@@ -99,9 +99,11 @@ class DeepSpeedEngine:
         # Tensor-parallel base specs: models that declare a Megatron-style
         # layout (models/gpt2.py param_partition_specs) get it honored
         # automatically — the role the external Megatron mpu plays in the
-        # reference (engine.py:739-770 adopting mpu's groups).
-        self.param_specs = None
-        if hasattr(model, "param_partition_specs"):
+        # reference (engine.py:739-770 adopting mpu's groups).  A bare-function
+        # model can pass the spec tree explicitly via param_partition_specs.
+        self.param_specs = param_partition_specs
+        if self.param_specs is None and hasattr(model,
+                                                "param_partition_specs"):
             self.param_specs = model.param_partition_specs()
 
         # ---- mesh ---------------------------------------------------- #

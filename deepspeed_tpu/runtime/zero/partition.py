@@ -26,33 +26,51 @@ from jax.sharding import NamedSharding, PartitionSpec
 from ...parallel.mesh import MeshContext, ZERO_AXES
 
 
-def zero_partition_spec(shape: Tuple[int, ...], zero_size: int,
+def zero_partition_spec(shape: Tuple[int, ...], axis_sizes: dict,
                         persistence_threshold: int = 0,
                         existing: Optional[PartitionSpec] = None
                         ) -> PartitionSpec:
     """Choose the dimension to shard over the ZeRO ("data","expert") axes.
 
-    Picks the largest dimension divisible by `zero_size` that is not already
+    `axis_sizes` maps each ZeRO axis name to its mesh size.  Picks the largest
+    dimension divisible by the effective shard factor that is not already
     claimed by another mesh axis in `existing` (e.g. a tensor-parallel "model"
     spec).  Falls back to replication when nothing divides — the analog of the
     reference keeping small/awkward params whole (persistence threshold,
     partition_parameters.py:688 padding case handled by replication instead).
     """
     n = int(np.prod(shape)) if shape else 1
+    zero_size = int(np.prod([axis_sizes.get(a, 1) for a in ZERO_AXES]))
     if zero_size <= 1 or n < max(1, persistence_threshold):
         return existing if existing is not None else PartitionSpec()
     existing_parts = list(existing) if existing is not None else [None] * len(shape)
     while len(existing_parts) < len(shape):
         existing_parts.append(None)
+    # A mesh axis can appear only once in a spec: params already sharded over
+    # an expert/data axis (e.g. stacked MoE experts with a leading "expert"
+    # dim) ZeRO-shard over the remaining axes only — the reference's
+    # expert-data-parallel group reducing expert params over data only
+    # (utils/groups.py:23-49, stage2.py:467 _configure_moe_settings) — and
+    # divisibility is against the surviving axes' product.
+    used = set()
+    for part in existing_parts:
+        if part is None:
+            continue
+        for ax in (part if isinstance(part, tuple) else (part,)):
+            used.add(ax)
+    zero_axes = tuple(a for a in ZERO_AXES if a not in used)
+    shard_factor = int(np.prod([axis_sizes.get(a, 1) for a in zero_axes]))
+    if not zero_axes or shard_factor <= 1:
+        return existing if existing is not None else PartitionSpec()
     best_dim, best_size = None, 0
     for i, d in enumerate(shape):
         if existing_parts[i] is not None:
             continue
-        if d % zero_size == 0 and d > best_size:
+        if d % shard_factor == 0 and d > best_size:
             best_dim, best_size = i, d
     if best_dim is None:
         return existing if existing is not None else PartitionSpec()
-    existing_parts[best_dim] = ZERO_AXES
+    existing_parts[best_dim] = zero_axes
     return PartitionSpec(*existing_parts)
 
 
@@ -74,6 +92,7 @@ class ZeroPartitioner:
         self.ctx = mesh_ctx
         self.stage = stage
         self.zero_size = mesh_ctx.data_parallel_world_size
+        self.axis_sizes = {a: mesh_ctx.axis_size(a) for a in ZERO_AXES}
         # stage 3 honors the persistence threshold; lower stages partition
         # whatever divides.
         self.persistence_threshold = (persistence_threshold
@@ -81,7 +100,7 @@ class ZeroPartitioner:
 
     # -- single-leaf specs -------------------------------------------- #
     def _zspec(self, leaf, existing=None) -> PartitionSpec:
-        return zero_partition_spec(_leaf_shape(leaf), self.zero_size,
+        return zero_partition_spec(_leaf_shape(leaf), self.axis_sizes,
                                    self.persistence_threshold, existing)
 
     @staticmethod
@@ -153,7 +172,7 @@ class ZeroPartitioner:
         """Optimizer-state sharding ignores the stage-3 persistence threshold:
         even "persistent" (always-gathered) params keep sharded Adam moments,
         like the reference keeps fp32 optimizer shards for every param."""
-        return zero_partition_spec(shape, self.zero_size, 0, existing)
+        return zero_partition_spec(shape, self.axis_sizes, 0, existing)
 
     # -- memory estimation -------------------------------------------- #
     def estimate_memory(self, params: Any, bytes_per_param: int = 4,

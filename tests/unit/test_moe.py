@@ -1,0 +1,193 @@
+"""MoE gating + expert-parallel layer tests (reference: tests/unit/test_moe.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.moe import MoE, MOELayer, TopKGate, top1gating, top2gating
+from deepspeed_tpu.moe.experts import ExpertMLP
+
+D = 8
+E = 4
+
+
+class TestTop1Gating:
+    def test_shapes(self):
+        logits = jax.random.normal(jax.random.PRNGKey(0), (16, E))
+        l_aux, combine, dispatch, counts = top1gating(
+            logits, capacity_factor=2.0, min_capacity=1)
+        cap = max(1, int(np.ceil(16 / E * 2.0)))
+        assert combine.shape == (16, E, cap)
+        assert dispatch.shape == (16, E, cap)
+        assert counts.shape == (E,)
+        assert np.isfinite(float(l_aux))
+
+    def test_all_tokens_dispatched_when_capacity_ample(self):
+        logits = jax.random.normal(jax.random.PRNGKey(1), (16, E))
+        _, combine, dispatch, _ = top1gating(logits, capacity_factor=float(E),
+                                             min_capacity=16)
+        # each token occupies exactly one (expert, slot)
+        per_token = dispatch.sum(axis=(1, 2))
+        np.testing.assert_array_equal(np.asarray(per_token), np.ones(16))
+
+    def test_capacity_drops_tokens(self):
+        # all tokens prefer expert 0; capacity 2 keeps only 2
+        logits = jnp.stack([jnp.full((16,), 5.0)] + [jnp.zeros(16)] * (E - 1),
+                           axis=1)
+        _, _, dispatch, _ = top1gating(logits, capacity_factor=0.5,
+                                       min_capacity=2)
+        kept = float(dispatch.sum())
+        assert kept == 2.0
+
+    def test_l_aux_uniform_is_one(self):
+        # perfectly uniform router → l_aux == 1 (E * E * (1/E²))
+        logits = jnp.zeros((E * 8, E))
+        l_aux, _, _, _ = top1gating(logits, capacity_factor=2.0,
+                                    min_capacity=64)
+        # argmax breaks ties to expert 0 → ce is one-hot; me uniform
+        # so l_aux = E * sum(me*ce) = E * 1/E = 1
+        assert float(l_aux) == pytest.approx(1.0, rel=1e-5)
+
+    def test_combine_weights_are_gate_probs(self):
+        logits = jax.random.normal(jax.random.PRNGKey(2), (8, E))
+        gates = jax.nn.softmax(logits, axis=-1)
+        _, combine, dispatch, _ = top1gating(logits, capacity_factor=float(E),
+                                             min_capacity=8)
+        sel = np.asarray(jnp.argmax(logits, axis=-1))
+        w = np.asarray(combine.sum(axis=2))  # [S, E]
+        for s in range(8):
+            assert w[s, sel[s]] == pytest.approx(
+                float(gates[s, sel[s]]), rel=1e-5)
+
+
+class TestTop2Gating:
+    def test_shapes_and_two_experts(self):
+        logits = jax.random.normal(jax.random.PRNGKey(3), (16, E))
+        l_aux, combine, dispatch, counts = top2gating(
+            logits, capacity_factor=float(E), min_capacity=32)
+        per_token_experts = (dispatch.sum(axis=2) > 0).sum(axis=1)
+        np.testing.assert_array_equal(np.asarray(per_token_experts),
+                                      np.full(16, 2))
+        # combine weights normalized over the two experts
+        np.testing.assert_allclose(np.asarray(combine.sum(axis=(1, 2))),
+                                   np.ones(16), rtol=1e-5)
+
+    def test_second_differs_from_first(self):
+        logits = jax.random.normal(jax.random.PRNGKey(4), (16, E))
+        _, _, dispatch, _ = top2gating(logits, capacity_factor=float(E),
+                                       min_capacity=32)
+        experts_hit = np.asarray(dispatch.sum(axis=2))  # [S, E] 0/1
+        assert (experts_hit.max(axis=1) <= 1).all()
+
+
+class TestMOELayer:
+    def test_parity_with_per_token_expert(self):
+        """k=1, ample capacity: y[token] == gate_prob * expert(token)."""
+        gate = TopKGate(D, E, k=1, capacity_factor=float(E), min_capacity=64)
+        expert = ExpertMLP(D, 2 * D)
+        layer = MOELayer(gate, expert, E)
+        rng = jax.random.PRNGKey(5)
+        x = jax.random.normal(jax.random.PRNGKey(6), (16, D))
+        params = layer.init_params(rng, x)
+        y, l_aux, counts = layer.apply(params, x, train=False)
+        assert y.shape == x.shape
+        assert float(counts.sum()) == 16
+
+        logits = np.asarray(x.astype(jnp.float32) @ params["gate"]["wg"])
+        gates = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+        sel = logits.argmax(axis=-1)
+        for s in range(16):
+            p_e = jax.tree.map(lambda a: a[sel[s]], params["experts"])
+            expected = gates[s, sel[s]] * np.asarray(
+                expert.apply(p_e, x[s:s + 1]))[0]
+            np.testing.assert_allclose(np.asarray(y[s]), expected, rtol=1e-4)
+
+    def test_batched_input_shape(self):
+        gate = TopKGate(D, E, k=2, capacity_factor=2.0)
+        layer = MOELayer(gate, ExpertMLP(D), E)
+        x = jax.random.normal(jax.random.PRNGKey(7), (2, 8, D))
+        params = layer.init_params(jax.random.PRNGKey(8), x)
+        y, l_aux, _ = layer.apply(params, x, train=False)
+        assert y.shape == x.shape
+
+
+class TestMoEWrapper:
+    def test_requires_divisible_experts(self):
+        deepspeed_tpu.initialize_mesh(expert=4, data=-1)
+        with pytest.raises(ValueError, match="divide"):
+            MoE(hidden_size=D, num_experts=6)
+
+    def test_expert_params_sharded(self):
+        deepspeed_tpu.initialize_mesh(expert=4, data=-1)
+        moe = MoE(hidden_size=D, num_experts=4, k=1)
+        assert moe.num_local_experts == 1
+        x = jnp.zeros((8, D))
+        params = moe.init_params(jax.random.PRNGKey(0), x)
+        specs = moe.param_partition_specs(params)
+        from jax.sharding import PartitionSpec
+        for leaf in jax.tree.leaves(
+                specs["experts"],
+                is_leaf=lambda s: isinstance(s, PartitionSpec)):
+            assert leaf == PartitionSpec("expert")
+
+    def test_training_decreases_loss(self):
+        """MoE regression model trained through the engine on an expert=4
+        mesh (the reference's SimpleMoEModel scenario, simple_model.py:234)."""
+        deepspeed_tpu.initialize_mesh(expert=4, data=-1)
+        moe = MoE(hidden_size=D, num_experts=4, k=1, capacity_factor=4.0,
+                  min_capacity=64)
+        rng = jax.random.PRNGKey(0)
+        x0 = jnp.zeros((16, D))
+        moe_params = moe.init_params(rng, x0)
+        k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+        head = jax.random.normal(k1, (D, D)) * 0.3
+        params = {"moe": moe_params, "head": head}
+
+        def model(p, rng, x, y):
+            h, l_aux, _ = moe.apply(p["moe"], x, rng=rng)
+            pred = h @ p["head"]
+            return jnp.mean((pred - y) ** 2) + 0.01 * l_aux
+
+        config = {
+            "train_batch_size": 16,
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 2},
+            "steps_per_print": 100,
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, config=config, model_parameters=params)
+        rs = np.random.RandomState(0)
+        w = rs.randn(D, D).astype(np.float32)
+        xb = rs.randn(16, D).astype(np.float32)
+        yb = xb @ w
+        losses = []
+        for i in range(50):
+            loss = engine.forward(xb, yb)
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.3, losses
+
+    def test_moe_zero_specs_no_duplicate_axis(self):
+        """ZeRO partitioning must not reuse the expert axis already claimed
+        by stacked expert params."""
+        deepspeed_tpu.initialize_mesh(expert=4, data=-1)
+        from deepspeed_tpu.parallel.mesh import get_mesh_context
+        from deepspeed_tpu.runtime.zero.partition import ZeroPartitioner
+        moe = MoE(hidden_size=D, num_experts=4, k=1)
+        params = moe.init_params(jax.random.PRNGKey(0), jnp.zeros((8, D)))
+        specs = moe.param_partition_specs(params)
+        zp = ZeroPartitioner(get_mesh_context(), stage=2)
+        shardings = zp.grad_shardings(params, specs)
+        for s in jax.tree.leaves(shardings):
+            axes = []
+            for part in s.spec:
+                if part is None:
+                    continue
+                axes.extend(part if isinstance(part, tuple) else (part,))
+            assert len(axes) == len(set(axes)), s.spec
