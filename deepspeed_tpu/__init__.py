@@ -39,6 +39,11 @@ def initialize(args=None, model=None, config=None, config_params=None,
 
     if isinstance(model, PipelineModule):
         from .runtime.pipe.engine import PipelineEngine
+        if param_partition_specs is not None:
+            raise ValueError(
+                "param_partition_specs is not supported with a "
+                "PipelineModule — declare specs on the stage layers "
+                "(PipeLayer.param_partition_specs) instead")
         engine = PipelineEngine(model=model, config=cfg, optimizer=optimizer,
                                 lr_scheduler=lr_scheduler, mesh=mesh, mpu=mpu,
                                 training_data=training_data,

@@ -34,17 +34,15 @@ class MoE:
                 f"Unsupported noisy_gate_policy {noisy_gate_policy!r}")
         self.hidden_size = hidden_size
         self.num_experts = num_experts
-        ctx = mesh_mod.get_mesh_context(required=False)
-        ep_size = ctx.expert_parallel_world_size if ctx is not None else 1
-        if num_experts % max(1, ep_size) != 0:
-            raise ValueError(
-                f"num_experts={num_experts} must divide the expert mesh axis "
-                f"({ep_size})")
-        self.ep_size = ep_size
-        self.num_local_experts = num_experts // max(1, ep_size)
-        log_dist(
-            f"MoE: num_experts={num_experts} ep_size={ep_size} "
-            f"local_experts={self.num_local_experts} k={k}", ranks=[0])
+        # ep_size comes from the mesh, which usually doesn't exist yet at
+        # model-construction time (the engine creates it from the config in
+        # deepspeed_tpu.initialize).  Validate lazily on first use; an early
+        # check here still fires for callers that initialized the mesh first.
+        self.ep_size = 1
+        self.num_local_experts = num_experts
+        self._mesh_checked = False
+        if mesh_mod.get_mesh_context(required=False) is not None:
+            self._check_mesh()
 
         expert = expert if expert is not None else ExpertMLP(
             hidden_size, expert_ff_size)
@@ -54,14 +52,33 @@ class MoE:
                         else noisy_gate_policy)
         self.deepspeed_moe = MOELayer(gate, expert, num_experts)
 
+    def _check_mesh(self):
+        ctx = mesh_mod.get_mesh_context(required=False)
+        if ctx is None:
+            return  # no mesh yet; stay at the ep_size=1 defaults
+        ep_size = ctx.expert_parallel_world_size
+        if self.num_experts % max(1, ep_size) != 0:
+            raise ValueError(
+                f"num_experts={self.num_experts} must divide the expert mesh "
+                f"axis ({ep_size})")
+        self.ep_size = ep_size
+        self.num_local_experts = self.num_experts // max(1, ep_size)
+        if not self._mesh_checked:
+            log_dist(
+                f"MoE: num_experts={self.num_experts} ep_size={ep_size} "
+                f"local_experts={self.num_local_experts}", ranks=[0])
+        self._mesh_checked = True
+
     # -- PipeLayer protocol ------------------------------------------- #
     def init_params(self, rng, x):
         return self.deepspeed_moe.init_params(rng, x)
 
-    def param_partition_specs(self, params):
+    def param_partition_specs(self, params=None):
+        self._check_mesh()
         return self.deepspeed_moe.param_partition_specs(params)
 
     def apply(self, params, x, rng=None, train=True):
         """Returns (output, l_aux, exp_counts) like the reference forward
         (moe/layer.py:42)."""
+        self._check_mesh()
         return self.deepspeed_moe.apply(params, x, rng=rng, train=train)

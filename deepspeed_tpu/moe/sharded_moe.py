@@ -98,8 +98,11 @@ def top2gating(logits: jnp.ndarray, capacity_factor: float = 1.0,
                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Top-2 gating (reference: sharded_moe.py:173).
 
-    Second expert chosen from noised logits with the top-1 expert masked out;
-    top-2 capacity doubles the slot budget like the reference (2 * S / E).
+    Second expert chosen with the top-1 expert masked out; gumbel noise is
+    added to the selection when an rng is available (the reference noises
+    unconditionally via torch's implicit global RNG; JAX needs an explicit
+    key, so pass rng= for reference-parity stochastic second choice).
+    Top-2 capacity doubles the slot budget like the reference (2 * S / E).
     """
     num_tokens, num_experts = logits.shape
     capacity = _capacity(num_tokens, num_experts, 2 * capacity_factor,
@@ -112,6 +115,9 @@ def top2gating(logits: jnp.ndarray, capacity_factor: float = 1.0,
     select2 = logits.astype(jnp.float32)
     if noisy_gate_policy == "RSample":
         assert rng is not None, "RSample needs an rng"
+    if rng is not None:
+        # Reference noises the second choice unconditionally
+        # (sharded_moe.py:180 logits_w_noise); here that needs a key.
         select2 = select2 + gumbel_rsample(rng, logits.shape)
     select2 = select2 + mask1 * -1e9  # exclude the first expert
     indices2 = jnp.argmax(select2, axis=-1)
@@ -171,7 +177,11 @@ class TopKGate:
     def apply(self, params, x, rng=None, train=True):
         """x: [S, d] tokens → (l_aux, combine, dispatch, exp_counts)."""
         x32 = x.astype(jnp.float32)
-        if train and self.noisy_gate_policy == "Jitter" and rng is not None:
+        if train and self.noisy_gate_policy == "Jitter":
+            if rng is None:
+                raise ValueError(
+                    "noisy_gate_policy='Jitter' needs an rng during training "
+                    "— pass rng= to MoE.apply (RSample enforces the same)")
             rng, sub = jax.random.split(rng)
             x32 = x32 * jax.random.uniform(
                 sub, x32.shape, jnp.float32, 1.0 - JITTER_EPS, 1.0 + JITTER_EPS)
@@ -208,8 +218,14 @@ class MOELayer:
                                *expert_params)
         return {"gate": self.gate.init_params(gate_rng), "experts": stacked}
 
-    def param_partition_specs(self, params):
+    def param_partition_specs(self, params=None):
         from jax.sharding import PartitionSpec
+        if params is None:
+            # Zero-arg protocol (engine/pipe discovery): recover the param
+            # tree structure abstractly — no arrays are materialized.
+            params = jax.eval_shape(
+                self.init_params, jax.random.PRNGKey(0),
+                jax.ShapeDtypeStruct((1, self.gate.model_dim), jnp.float32))
         return {
             "gate": jax.tree.map(lambda _: None, params["gate"]),
             "experts": jax.tree.map(lambda _: PartitionSpec(EXPERT_AXIS),

@@ -3,3 +3,6 @@ from .mesh import (DATA_AXIS, EXPERT_AXIS, MESH_AXES, MODEL_AXIS, PIPE_AXIS,
                    initialize_mesh, reset_mesh_context, resolve_mesh_shape,
                    set_mesh_context)
 from . import groups
+from .sequence import (ring_attention, ring_attention_inner,
+                       sequence_parallel_attention, sp_attention_inner,
+                       ulysses_attention, ulysses_attention_inner)

@@ -1,0 +1,207 @@
+"""Sequence/context parallelism over the ``seq`` mesh axis.
+
+The reference (DeepSpeed v0.5.2) predates sequence parallelism — its
+long-context story is block-sparse attention + activation partitioning
+(SURVEY.md §5).  On TPU, long context is first-class: the sequence dimension
+is sharded over the ``seq`` mesh axis and attention runs either as
+
+  * **ring attention** — K/V shards rotate around the ring via
+    ``lax.ppermute`` while each device accumulates its queries' output with a
+    flash-style online softmax.  Per-step comms overlap with the block
+    attention compute; HBM never holds more than one remote K/V shard.
+    (Liu et al., "Ring Attention with Blockwise Transformers".)
+  * **Ulysses-style all-to-all** — ``lax.all_to_all`` reshards
+    sequence-sharded Q/K/V to head-sharded, runs *exact* local attention on
+    the full sequence per head group, and reshards back (DeepSpeed-Ulysses,
+    arXiv:2309.14509 — later-era DeepSpeed; here built TPU-native).
+
+Both are exact (not approximations) and bit-compatible with dense attention
+up to fp32 accumulation order.
+
+Layout convention matches deepspeed_tpu.ops.flash_attention: [B, H, S, D].
+The ``*_inner`` functions run inside an existing ``shard_map`` (manual-mesh
+code such as the pipeline engine); the public wrappers shard_map themselves
+over the global mesh for GSPMD-style callers.
+"""
+
+import functools
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .mesh import SEQ_AXIS, MeshContext, get_mesh_context
+
+# Finite "minus infinity" for masked logits; see ops.flash_attention.
+from ..ops.flash_attention import DEFAULT_MASK_VALUE, flash_attention
+
+
+def _axis_size(axis_name: str) -> int:
+    # Static under shard_map: psum of a python literal constant-folds.
+    return lax.psum(1, axis_name)
+
+
+# --------------------------------------------------------------------------- #
+# Ring attention
+# --------------------------------------------------------------------------- #
+def ring_attention_inner(q, k, v, axis_name: str = SEQ_AXIS,
+                         causal: bool = False,
+                         sm_scale: Optional[float] = None):
+    """Ring attention over ``axis_name``; call inside shard_map.
+
+    q, k, v: [B, H, S_local, D] — the local sequence shard.  Global sequence
+    order follows the ring index (shard i holds positions
+    [i*S_local, (i+1)*S_local)).  Returns the local output shard [B,H,S,D].
+    """
+    sp = _axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    orig_dtype = q.dtype
+    b, h, q_len, d = q.shape
+    k_len = k.shape[2]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+
+    q32 = q.astype(jnp.float32)
+    q_pos = idx * q_len + lax.broadcasted_iota(jnp.int32, (q_len, k_len), 0)
+
+    # Ring rotation: shard j hands its current K/V block to shard j+1, so at
+    # step i the block on shard `idx` originated on shard (idx - i) % sp.
+    perm = [(j, (j + 1) % sp) for j in range(sp)]
+
+    def block(m, l, acc, k_cur, v_cur, src):
+        """Flash-style online-softmax update with one remote K/V block."""
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_cur.astype(jnp.float32),
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = src * k_len + lax.broadcasted_iota(
+                jnp.int32, (q_len, k_len), 1)
+            valid = (k_pos <= q_pos)[None, None]
+            s = jnp.where(valid, s, DEFAULT_MASK_VALUE)
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(s - m_new[..., None])
+        if causal:
+            # Explicit zero for masked entries: when an entire block is
+            # masked, s == m_new == DEFAULT_MASK_VALUE and exp(0)=1 would
+            # otherwise pollute the running sum.
+            p = jnp.where(valid, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    def step(carry, i):
+        # Rotate first, then consume: the local (i=0) block is handled
+        # outside the loop, so only sp-1 ppermutes ride the ring.
+        k_cur, v_cur, m, l, acc = carry
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        m, l, acc = block(m, l, acc, k_cur, v_cur, (idx - i) % sp)
+        return (k_cur, v_cur, m, l, acc), None
+
+    m0 = jnp.full((b, h, q_len), DEFAULT_MASK_VALUE, jnp.float32)
+    l0 = jnp.zeros((b, h, q_len), jnp.float32)
+    a0 = jnp.zeros((b, h, q_len, d), jnp.float32)
+    m0, l0, a0 = block(m0, l0, a0, k, v, idx)
+    (_, _, _, l, acc), _ = lax.scan(step, (k, v, m0, l0, a0),
+                                    jnp.arange(1, sp))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(orig_dtype)
+
+
+# --------------------------------------------------------------------------- #
+# Ulysses (all-to-all head↔sequence reshard)
+# --------------------------------------------------------------------------- #
+def ulysses_attention_inner(q, k, v, axis_name: str = SEQ_AXIS,
+                            causal: bool = False,
+                            sm_scale: Optional[float] = None,
+                            attn_fn: Optional[Callable] = None):
+    """Ulysses-style attention; call inside shard_map.
+
+    q, k, v: [B, H, S_local, D].  Requires H % seq_parallel_size == 0.
+    all_to_all turns the sequence sharding into a head sharding, local exact
+    attention (flash) runs on the full sequence, and the inverse all_to_all
+    restores sequence sharding.  Two all-to-alls ride ICI per call — cheaper
+    than a ring when S_local is small relative to head count.
+    """
+    sp = _axis_size(axis_name)
+    h = q.shape[1]
+    if h % sp != 0:
+        raise ValueError(f"Ulysses needs heads ({h}) divisible by the "
+                         f"sequence-parallel degree ({sp})")
+    attn = attn_fn or (lambda *a: flash_attention(a[0], a[1], a[2],
+                                                  causal=causal,
+                                                  sm_scale=sm_scale))
+    # [B, H, S/sp, D] -> [B, H/sp, S, D]
+    qg, kg, vg = (lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                 tiled=True) for x in (q, k, v))
+    og = attn(qg, kg, vg)
+    # [B, H/sp, S, D] -> [B, H, S/sp, D]
+    return lax.all_to_all(og, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def sp_attention_inner(q, k, v, mode: str = "ring", axis_name: str = SEQ_AXIS,
+                       causal: bool = False, sm_scale: Optional[float] = None):
+    """Mode-dispatched sequence-parallel attention for shard_map callers."""
+    if mode == "ring":
+        return ring_attention_inner(q, k, v, axis_name, causal, sm_scale)
+    if mode == "ulysses":
+        return ulysses_attention_inner(q, k, v, axis_name, causal, sm_scale)
+    raise ValueError(f"Unknown sequence-parallel mode {mode!r}")
+
+
+# --------------------------------------------------------------------------- #
+# Public GSPMD-facing wrappers
+# --------------------------------------------------------------------------- #
+def _wrap(inner, q, k, v, mesh_ctx: Optional[MeshContext]):
+    ctx = mesh_ctx or get_mesh_context()
+    spec = P(None, None, SEQ_AXIS, None)
+    fn = jax.shard_map(inner, mesh=ctx.mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v)
+
+
+def ring_attention(q, k, v, causal: bool = False,
+                   sm_scale: Optional[float] = None,
+                   mesh_ctx: Optional[MeshContext] = None):
+    """Ring attention on globally-shaped [B,H,S,D] arrays; S is sharded over
+    the mesh ``seq`` axis (other axes replicated by this wrapper)."""
+    inner = functools.partial(ring_attention_inner, axis_name=SEQ_AXIS,
+                              causal=causal, sm_scale=sm_scale)
+    return _wrap(inner, q, k, v, mesh_ctx)
+
+
+def ulysses_attention(q, k, v, causal: bool = False,
+                      sm_scale: Optional[float] = None,
+                      mesh_ctx: Optional[MeshContext] = None):
+    """Ulysses attention on globally-shaped [B,H,S,D] arrays."""
+    inner = functools.partial(ulysses_attention_inner, axis_name=SEQ_AXIS,
+                              causal=causal, sm_scale=sm_scale)
+    return _wrap(inner, q, k, v, mesh_ctx)
+
+
+def sequence_parallel_attention(q, k, v, mode: str = "auto",
+                                causal: bool = False,
+                                sm_scale: Optional[float] = None,
+                                mesh_ctx: Optional[MeshContext] = None):
+    """Config-driven entry: mode from DeepSpeedConfig.sequence_parallel_config.
+
+    "auto" picks Ulysses when the head count divides evenly by the seq degree
+    (exact attention + fewer collectives), else ring.
+    """
+    ctx = mesh_ctx or get_mesh_context()
+    sp = ctx.seq_parallel_world_size
+    if sp == 1:
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    if mode == "auto":
+        mode = "ulysses" if q.shape[1] % sp == 0 else "ring"
+    if mode == "ring":
+        return ring_attention(q, k, v, causal, sm_scale, ctx)
+    if mode == "ulysses":
+        return ulysses_attention(q, k, v, causal, sm_scale, ctx)
+    raise ValueError(f"Unknown sequence-parallel mode {mode!r}")

@@ -96,18 +96,20 @@ class DeepSpeedEngine:
         self.global_steps = 0
         self.micro_steps = 0
         self.skipped_steps = 0
+        # ---- mesh ---------------------------------------------------- #
+        self.mesh_ctx = resolve_mesh_ctx(config, mesh)
+
         # Tensor-parallel base specs: models that declare a Megatron-style
         # layout (models/gpt2.py param_partition_specs) get it honored
         # automatically — the role the external Megatron mpu plays in the
         # reference (engine.py:739-770 adopting mpu's groups).  A bare-function
         # model can pass the spec tree explicitly via param_partition_specs.
+        # Discovery runs after mesh creation so mesh-dependent layers (MoE
+        # expert-axis validation) see the real axis sizes.
         self.param_specs = param_partition_specs
         if self.param_specs is None and hasattr(model,
                                                 "param_partition_specs"):
             self.param_specs = model.param_partition_specs()
-
-        # ---- mesh ---------------------------------------------------- #
-        self.mesh_ctx = resolve_mesh_ctx(config, mesh)
 
         dp_world = self.mesh_ctx.data_parallel_world_size
         self.config = (config if isinstance(config, DeepSpeedConfig)
