@@ -188,6 +188,7 @@ class TopKGate:
         logits = x32 @ params["wg"]
         cf = self.capacity_factor if train else self.eval_capacity_factor
         policy = self.noisy_gate_policy if train else None
+        rng = rng if train else None  # eval routing is deterministic
         if self.k == 1:
             return top1gating(logits, cf, self.min_capacity,
                               noisy_gate_policy=policy, rng=rng)

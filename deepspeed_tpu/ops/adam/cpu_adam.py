@@ -1,0 +1,215 @@
+"""DeepSpeedCPUAdam — host-memory Adam/AdamW over a pytree of fp32 shards.
+
+Reference: deepspeed/ops/adam/cpu_adam.py:186 (DeepSpeedCPUAdam) backed by
+csrc/adam/cpu_adam.cpp.  Role in ZeRO-Offload: fp32 master params and m/v
+moments live in host DRAM; each step consumes device gradients and produces
+updated parameters, optionally fused with the fp32→bf16 cast for the
+device-bound copy (the reference's `adam_update_copy` overlapping H2D path).
+
+The native kernel is csrc/adam/host_adam.cpp loaded via ctypes
+(CPUAdamBuilder); when no toolchain is available a vectorized NumPy fallback
+keeps the API usable (slower, same numerics).
+"""
+
+import ctypes
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+
+from ...utils.logging import logger
+from ..op_builder import CPUAdamBuilder
+
+
+def _load_native():
+    builder = CPUAdamBuilder()
+    if not builder.is_compatible():
+        return None
+    try:
+        lib = builder.load()
+    except RuntimeError as e:  # pragma: no cover - toolchain-specific
+        logger.warning(f"cpu_adam native build failed, using NumPy: {e}")
+        return None
+    f32p = ctypes.POINTER(ctypes.c_float)
+    u16p = ctypes.POINTER(ctypes.c_uint16)
+    lib.ds_adam_step.argtypes = [
+        f32p, f32p, f32p, f32p, ctypes.c_int64, ctypes.c_float,
+        ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+        ctypes.c_int64, ctypes.c_int]
+    lib.ds_adam_step.restype = None
+    lib.ds_adam_step_bf16.argtypes = lib.ds_adam_step.argtypes + [u16p]
+    lib.ds_adam_step_bf16.restype = None
+    lib.ds_adam_num_threads.restype = ctypes.c_int
+    return lib
+
+
+_NATIVE: Optional[ctypes.CDLL] = None
+_NATIVE_TRIED = False
+
+
+def get_native_lib():
+    global _NATIVE, _NATIVE_TRIED
+    if not _NATIVE_TRIED:
+        _NATIVE = _load_native()
+        _NATIVE_TRIED = True
+    return _NATIVE
+
+
+def _as_f32_ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def adam_step_buffers(p: np.ndarray, m: np.ndarray, v: np.ndarray,
+                      g: np.ndarray, *, lr: float, beta1: float,
+                      beta2: float, eps: float, weight_decay: float,
+                      step: int, adamw_mode: bool,
+                      bf16_out: Optional[np.ndarray] = None,
+                      lib="auto") -> None:
+    """One fused Adam/AdamW update over flat fp32 buffers, in place.
+
+    Shared by DeepSpeedCPUAdam (RAM-resident states) and the NVMe optimizer
+    swapper (states paged through these buffers).  Uses the native kernel
+    when available, NumPy otherwise."""
+    if lib == "auto":
+        lib = get_native_lib()
+    if lib is not None:
+        args = (_as_f32_ptr(p.reshape(-1)), _as_f32_ptr(m.reshape(-1)),
+                _as_f32_ptr(v.reshape(-1)), _as_f32_ptr(g.reshape(-1)),
+                ctypes.c_int64(p.size), ctypes.c_float(lr),
+                ctypes.c_float(beta1), ctypes.c_float(beta2),
+                ctypes.c_float(eps), ctypes.c_float(weight_decay),
+                ctypes.c_int64(step), ctypes.c_int(1 if adamw_mode else 0))
+        if bf16_out is None:
+            lib.ds_adam_step(*args)
+        else:
+            lib.ds_adam_step_bf16(
+                *args, bf16_out.reshape(-1).ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_uint16)))
+        return
+    _adam_step_numpy(p, m, v, g, lr=lr, beta1=beta1, beta2=beta2, eps=eps,
+                     weight_decay=weight_decay, step=step,
+                     adamw_mode=adamw_mode, bf16_out=bf16_out)
+
+
+def _adam_step_numpy(p, m, v, g, *, lr, beta1, beta2, eps, weight_decay,
+                     step, adamw_mode, bf16_out=None):
+    bias1 = 1.0 - beta1 ** step
+    bias2 = 1.0 - beta2 ** step
+    if not adamw_mode and weight_decay > 0:
+        g = g + weight_decay * p
+    m *= beta1
+    m += (1 - beta1) * g
+    v *= beta2
+    v += (1 - beta2) * g * g
+    denom = np.sqrt(v) / np.sqrt(bias2) + eps
+    if adamw_mode and weight_decay > 0:
+        p *= 1.0 - lr * weight_decay
+    p -= (lr / bias1) * (m / denom)
+    if bf16_out is not None:
+        import ml_dtypes
+        bf16_out[...] = p.astype(ml_dtypes.bfloat16).view(np.uint16)
+
+
+class DeepSpeedCPUAdam:
+    """Adam/AdamW stepping fp32 host shards in place.
+
+    params: a pytree of numpy fp32 arrays (the host master copy).  step()
+    takes a matching pytree of gradients (any float dtype; converted to
+    fp32), updates params/m/v in place, and can emit a bf16 copy-out tree
+    for the device upload.
+    """
+
+    def __init__(self, params: Any, lr: float = 1e-3,
+                 betas: Tuple[float, float] = (0.9, 0.999),
+                 eps: float = 1e-8, weight_decay: float = 0.0,
+                 adamw_mode: bool = True):
+        self.lr = float(lr)
+        self.betas = (float(betas[0]), float(betas[1]))
+        self.eps = float(eps)
+        self.weight_decay = float(weight_decay)
+        self.adamw_mode = bool(adamw_mode)
+        self.step_count = 0
+
+        def _host_master(x):
+            arr = np.asarray(x)
+            if not np.issubdtype(arr.dtype, np.floating) and arr.dtype != \
+                    np.dtype("bfloat16"):
+                return np.array(arr, copy=True)  # int leaves pass through
+            return np.ascontiguousarray(
+                np.array(arr, dtype=np.float32, copy=True))
+        self.params = jax.tree.map(_host_master, params)
+        # Moments as flat lists aligned with tree_leaves(self.params); None
+        # for non-float (pass-through) leaves.  Kept out of pytree form so
+        # None entries don't collapse the tree structure.
+        self._p_leaves, self._treedef = jax.tree_util.tree_flatten(
+            self.params)
+        self.exp_avg = [np.zeros_like(p) if p.dtype == np.float32 else None
+                        for p in self._p_leaves]
+        self.exp_avg_sq = [np.zeros_like(p) if p.dtype == np.float32
+                           else None for p in self._p_leaves]
+        self._lib = get_native_lib()
+
+    @property
+    def using_native(self) -> bool:
+        return self._lib is not None
+
+    # ------------------------------------------------------------------ #
+    def _step_leaf(self, p, m, v, g, bf16_out):
+        adam_step_buffers(
+            p, m, v, g, lr=self.lr, beta1=self.betas[0],
+            beta2=self.betas[1], eps=self.eps,
+            weight_decay=self.weight_decay, step=self.step_count,
+            adamw_mode=self.adamw_mode, bf16_out=bf16_out, lib=self._lib)
+
+    def step(self, grads: Any, lr: Optional[float] = None,
+             emit_bf16: bool = False) -> Optional[Any]:
+        """One fused update; returns the bf16 copy-out tree if emit_bf16."""
+        if lr is not None:
+            self.lr = float(lr)
+        self.step_count += 1
+        g_leaves = self._treedef.flatten_up_to(grads)
+        out_leaves = []
+        for p, m, v, g in zip(self._p_leaves, self.exp_avg,
+                              self.exp_avg_sq, g_leaves):
+            if m is None:  # non-float leaf: pass through untouched
+                out_leaves.append(p)
+                continue
+            g = np.ascontiguousarray(np.asarray(g, dtype=np.float32))
+            if g.shape != p.shape:
+                raise ValueError(
+                    f"grad shape {g.shape} != param shape {p.shape}")
+            bf16_out = (np.empty(p.shape, dtype=np.uint16)
+                        if emit_bf16 else None)
+            self._step_leaf(p, m, v, g, bf16_out)
+            out_leaves.append(bf16_out)
+        if emit_bf16:
+            import ml_dtypes
+            return jax.tree_util.tree_unflatten(
+                self._treedef,
+                [o.view(ml_dtypes.bfloat16) if isinstance(o, np.ndarray)
+                 and o.dtype == np.uint16 else o for o in out_leaves])
+        return None
+
+    # -- checkpoint support -------------------------------------------- #
+    def state_dict(self) -> Dict[str, Any]:
+        placeholder = np.zeros(0, np.float32)
+        return {
+            "step": self.step_count,
+            "exp_avg": {str(i): (m if m is not None else placeholder)
+                        for i, m in enumerate(self.exp_avg)},
+            "exp_avg_sq": {str(i): (v if v is not None else placeholder)
+                           for i, v in enumerate(self.exp_avg_sq)},
+            "params": self.params,
+        }
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.step_count = int(sd["step"])
+        for i, (m, v) in enumerate(zip(self.exp_avg, self.exp_avg_sq)):
+            if m is None:
+                continue
+            m[...] = np.asarray(sd["exp_avg"][str(i)], dtype=np.float32)
+            v[...] = np.asarray(sd["exp_avg_sq"][str(i)], dtype=np.float32)
+        src_leaves = self._treedef.flatten_up_to(sd["params"])
+        for dst, src in zip(self._p_leaves, src_leaves):
+            dst[...] = np.asarray(src, dtype=dst.dtype)

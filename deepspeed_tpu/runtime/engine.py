@@ -146,16 +146,37 @@ class DeepSpeedEngine:
         self.grad_shardings = self.zero_partitioner.grad_shardings(
             model_parameters, self.param_specs)
 
-        # fp32 master weights, placed with their ZeRO sharding
-        # (reference: stage3.py:1257 fp32 partition creation).  Force a copy:
-        # the engine donates its param buffers every step, and a no-copy
-        # astype/device_put would let that donation delete the caller's arrays.
-        def _own_master(x):
-            dtype = (jnp.float32 if jnp.issubdtype(
-                jnp.asarray(x).dtype, jnp.floating) else None)
-            return jnp.array(x, dtype=dtype)
-        master = jax.tree.map(_own_master, model_parameters)
-        self.params = jax.tree.map(jax.device_put, master, self.param_shardings)
+        # ZeRO-Offload: optimizer states (and the fp32 master) live in host
+        # DRAM, stepped by the native host Adam; the device holds only
+        # compute-dtype params (reference: stage2.py:976-1125 cpu_offload).
+        oo = self.config.zero_config.offload_optimizer
+        self._offload_enabled = oo is not None and oo.device not in (
+            None, "none")
+        self._offload_device = oo.device if self._offload_enabled else None
+
+        if self._offload_enabled:
+            # Device params in compute dtype — master fp32 stays on host.
+            def _own_device(x):
+                arr = jnp.asarray(x)
+                if jnp.issubdtype(arr.dtype, jnp.floating):
+                    return jnp.array(arr, dtype=self.compute_dtype)
+                return jnp.array(arr)
+            self.params = jax.tree.map(
+                lambda x, s: jax.device_put(_own_device(x), s),
+                model_parameters, self.param_shardings)
+        else:
+            # fp32 master weights, placed with their ZeRO sharding
+            # (reference: stage3.py:1257 fp32 partition creation).  Force a
+            # copy: the engine donates its param buffers every step, and a
+            # no-copy astype/device_put would let that donation delete the
+            # caller's arrays.
+            def _own_master(x):
+                dtype = (jnp.float32 if jnp.issubdtype(
+                    jnp.asarray(x).dtype, jnp.floating) else None)
+                return jnp.array(x, dtype=dtype)
+            master = jax.tree.map(_own_master, model_parameters)
+            self.params = jax.tree.map(jax.device_put, master,
+                                       self.param_shardings)
 
         # ---- LR schedule + optimizer --------------------------------- #
         self.lr_scheduler = self._configure_lr_scheduler(lr_scheduler)
@@ -164,17 +185,39 @@ class DeepSpeedEngine:
         if optimizer is not None and not callable(getattr(
                 optimizer, "update", None)):
             raise ValueError("optimizer must be an optax GradientTransformation")
-        self.tx = optimizer if optimizer is not None else build_optimizer(
-            self.config.optimizer_name or "adam",
-            self.config.optimizer_params,
-            learning_rate=schedule,
-            gradient_clipping=self.config.gradient_clipping)
+        if self._offload_enabled:
+            if optimizer is not None:
+                raise ValueError(
+                    "offload_optimizer is driven by the host Adam — a client "
+                    "optax optimizer cannot be offloaded")
+            if self._offload_device == "nvme":
+                from .swap_tensor import create_nvme_offload_optimizer
+                self._offload_opt = create_nvme_offload_optimizer(
+                    model_parameters, self.config,
+                    gradient_clipping=self.config.gradient_clipping)
+            else:
+                from .zero.offload import HostOffloadOptimizer
+                self._offload_opt = HostOffloadOptimizer(
+                    model_parameters,
+                    self.config.optimizer_name or "adam",
+                    self.config.optimizer_params,
+                    gradient_clipping=self.config.gradient_clipping)
+            self.tx = None
+            self.opt_shardings = None
+            self.opt_state = {}
+        else:
+            self._offload_opt = None
+            self.tx = optimizer if optimizer is not None else build_optimizer(
+                self.config.optimizer_name or "adam",
+                self.config.optimizer_params,
+                learning_rate=schedule,
+                gradient_clipping=self.config.gradient_clipping)
 
-        opt_shapes = jax.eval_shape(self.tx.init, self.params)
-        self.opt_shardings = self.zero_partitioner.opt_state_shardings(
-            opt_shapes, self.params, self.param_specs)
-        self.opt_state = jax.jit(
-            self.tx.init, out_shardings=self.opt_shardings)(self.params)
+            opt_shapes = jax.eval_shape(self.tx.init, self.params)
+            self.opt_shardings = self.zero_partitioner.opt_state_shardings(
+                opt_shapes, self.params, self.param_specs)
+            self.opt_state = jax.jit(
+                self.tx.init, out_shardings=self.opt_shardings)(self.params)
         self.scaler_state = jax.device_put(
             scaler_state, self.mesh_ctx.replicated())
 
@@ -243,6 +286,8 @@ class DeepSpeedEngine:
 
     @property
     def optimizer(self):
+        if self._offload_enabled:
+            return self._offload_opt
         return self.tx
 
     @property
@@ -256,6 +301,8 @@ class DeepSpeedEngine:
         return [float(self.config.optimizer_params.get("lr", 1e-3))]
 
     def _applied_step_count(self):
+        if self._offload_enabled:
+            return self._offload_opt.step_count()
         counts = [np.asarray(x) for x in jax.tree.leaves(self.opt_state)
                   if getattr(x, "dtype", None) == jnp.int32 and
                   getattr(x, "ndim", None) == 0]
@@ -394,6 +441,12 @@ class DeepSpeedEngine:
             accumulate, out_shardings=self.grad_shardings,
             donate_argnums=(0,))
 
+        if self._offload_enabled:
+            # Offload path: the optimizer step is host-side (HostOffload /
+            # NVMe swapper); no compiled apply program.
+            self._apply_fn = None
+            return
+
         def apply_step(params, opt_state, scaler_state, grads):
             inv = 1.0 / (scaler_state.loss_scale * gas)
             grads = jax.tree.map(
@@ -504,9 +557,12 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown():
             self.timers(STEP_MICRO_TIMER).start()
 
-        (self.params, self.opt_state, self.scaler_state,
-         overflow) = self._apply_fn(self.params, self.opt_state,
-                                    self.scaler_state, self._grad_acc)
+        if self._offload_enabled:
+            overflow = self._offload_step()
+        else:
+            (self.params, self.opt_state, self.scaler_state,
+             overflow) = self._apply_fn(self.params, self.opt_state,
+                                        self.scaler_state, self._grad_acc)
         self._grad_acc = None
         self._last_overflow = overflow
         self.global_steps += 1
@@ -540,6 +596,26 @@ class DeepSpeedEngine:
                                             self.global_steps)
         if self.wall_clock_breakdown():
             self.timers(STEP_MICRO_TIMER).stop()
+
+    def _offload_step(self) -> bool:
+        """Host-side optimizer step (ZeRO-Offload/-Infinity path)."""
+        scale_inv = 1.0 / (float(self.scaler_state.loss_scale) *
+                           self.gradient_accumulation_steps())
+        lr = None
+        if self.lr_scheduler is not None:
+            lr = float(self.lr_scheduler.lr_at(
+                self._offload_opt.step_count()))
+        new_host_params = self._offload_opt.apply(
+            self._grad_acc, scale_inv, lr, self.compute_dtype)
+        overflow = new_host_params is None
+        if not overflow:
+            # Single direct host->HBM transfer into the target sharding;
+            # dispatch is async so the next forward overlaps the upload.
+            self.params = jax.tree.map(jax.device_put, new_host_params,
+                                       self.param_shardings)
+        self.scaler_state = update_loss_scale(
+            self.scaler_cfg, self.scaler_state, jnp.asarray(overflow))
+        return overflow
 
     @property
     def overflow(self) -> bool:
@@ -583,8 +659,10 @@ class DeepSpeedEngine:
     # checkpointing (reference: engine.py:1880-2430)
     # ------------------------------------------------------------------ #
     def _engine_state(self) -> Dict[str, Any]:
+        opt = (self._offload_opt.state_dict() if self._offload_enabled
+               else self.opt_state)
         return {
-            "optimizer": self.opt_state,
+            "optimizer": opt,
             "scaler": self.scaler_state,
         }
 
@@ -622,8 +700,17 @@ class DeepSpeedEngine:
             strict=load_module_strict)
         self.params = module_state["module"]
         if opt_state is not None:
-            self.opt_state = opt_state["optimizer"]
+            if self._offload_enabled:
+                self._offload_opt.load_state_dict(opt_state["optimizer"])
+            else:
+                self.opt_state = opt_state["optimizer"]
             self.scaler_state = opt_state["scaler"]
+        elif self._offload_enabled:
+            # No optimizer state loaded (load_module_only /
+            # load_optimizer_states=False): the host fp32 master would
+            # otherwise keep the constructor-time weights and clobber the
+            # restored params at the next step.
+            self._offload_opt.load_master_params(self.params)
         if load_lr_scheduler_states and self.lr_scheduler is not None and \
                 client.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(client["lr_scheduler"])

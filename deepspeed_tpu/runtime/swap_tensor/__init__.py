@@ -1,0 +1,5 @@
+from .aio_handle import AsyncIOHandle, get_aio_lib
+from .async_swapper import AsyncTensorSwapper
+from .optimizer_swapper import (NVMeOffloadOptimizer,
+                                create_nvme_offload_optimizer)
+from .utils import SwapBuffer, SwapBufferPool, aligned_empty

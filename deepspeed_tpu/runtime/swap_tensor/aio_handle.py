@@ -1,0 +1,126 @@
+"""ctypes wrapper over the native async file I/O engine.
+
+Reference: csrc/aio/py_lib/deepspeed_py_aio_handle.cpp:282 (`aio_handle`
+bound via pybind) with the knobs of runtime/swap_tensor/constants.py —
+block_size, queue_depth, single_submit, overlap_events, thread_count.  Same
+handle API here, backed by csrc/aio/host_aio.cpp (pthread pool + positional
+I/O) and loaded with ctypes via AsyncIOBuilder.
+"""
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from ...ops.op_builder import AsyncIOBuilder
+from ...utils.logging import logger
+
+_LIB = None
+_TRIED = False
+
+
+def get_aio_lib():
+    global _LIB, _TRIED
+    if not _TRIED:
+        _TRIED = True
+        builder = AsyncIOBuilder()
+        if builder.is_compatible():
+            try:
+                lib = builder.load()
+                lib.ds_aio_create.restype = ctypes.c_void_p
+                lib.ds_aio_create.argtypes = [ctypes.c_int64, ctypes.c_int,
+                                              ctypes.c_int, ctypes.c_int,
+                                              ctypes.c_int]
+                lib.ds_aio_destroy.argtypes = [ctypes.c_void_p]
+                for fn in (lib.ds_aio_pread, lib.ds_aio_pwrite):
+                    fn.restype = ctypes.c_int
+                    fn.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                   ctypes.c_int64, ctypes.c_char_p,
+                                   ctypes.c_int]
+                lib.ds_aio_wait.restype = ctypes.c_int
+                lib.ds_aio_wait.argtypes = [ctypes.c_void_p]
+                _LIB = lib
+            except RuntimeError as e:  # pragma: no cover
+                logger.warning(f"async_io native build failed: {e}")
+    return _LIB
+
+
+class AsyncIOHandle:
+    """One submission context (reference aio_handle).  Python-side fallback
+    does synchronous numpy file I/O when the native engine is unavailable."""
+
+    def __init__(self, block_size: int = 1048576, queue_depth: int = 8,
+                 single_submit: bool = False, overlap_events: bool = True,
+                 thread_count: int = 4):
+        self.block_size = block_size
+        self.queue_depth = queue_depth
+        self.single_submit = single_submit
+        self.overlap_events = overlap_events
+        self.thread_count = thread_count
+        self._lib = get_aio_lib()
+        self._handle = None
+        self._sync_completed = 0
+        if self._lib is not None:
+            self._handle = self._lib.ds_aio_create(
+                block_size, queue_depth, int(single_submit),
+                int(overlap_events), thread_count)
+
+    @property
+    def using_native(self) -> bool:
+        return self._handle is not None
+
+    def _check(self, rc: int, op: str, path: str):
+        if rc < 0:
+            raise OSError(-rc, f"aio {op} failed for {path}")
+
+    def pread(self, buffer: np.ndarray, path: str,
+              async_op: bool = False) -> None:
+        """Read len(buffer) bytes from path.  With async_op the caller must
+        keep `buffer` alive until wait() — the engine reads/writes the raw
+        pointer (same contract as the reference's pinned bounce buffers)."""
+        nbytes = buffer.nbytes
+        if self._handle is not None:
+            rc = self._lib.ds_aio_pread(
+                self._handle, buffer.ctypes.data_as(ctypes.c_void_p),
+                nbytes, path.encode(), int(async_op))
+            self._check(rc, "pread", path)
+            return
+        with open(path, "rb") as f:  # fallback
+            data = f.read(nbytes)
+        flat = buffer.reshape(-1).view(np.uint8)
+        flat[:len(data)] = np.frombuffer(data, np.uint8)
+        self._sync_completed += 1
+
+    def pwrite(self, buffer: np.ndarray, path: str,
+               async_op: bool = False) -> None:
+        if self._handle is not None:
+            rc = self._lib.ds_aio_pwrite(
+                self._handle, buffer.ctypes.data_as(ctypes.c_void_p),
+                buffer.nbytes, path.encode(), int(async_op))
+            self._check(rc, "pwrite", path)
+            return
+        with open(path, "wb") as f:
+            f.write(buffer.tobytes())
+        self._sync_completed += 1
+
+    def wait(self) -> int:
+        """Block until all in-flight requests complete; returns the number
+        of completed requests (reference aio_handle.wait)."""
+        if self._handle is not None:
+            rc = self._lib.ds_aio_wait(self._handle)
+            self._check(rc, "wait", "<batch>")
+            return rc
+        n = self._sync_completed
+        self._sync_completed = 0
+        return n
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.ds_aio_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # pragma: no cover - interpreter teardown
+            pass

@@ -1,0 +1,41 @@
+"""AsyncTensorSwapper — fire-and-forget tensor writes to NVMe.
+
+Reference: runtime/swap_tensor/async_swapper.py:16 (AsyncTensorSwapper):
+gradients/tensors are handed to the swapper, which stages them into
+aligned buffers and writes asynchronously, overlapping with compute;
+callers reclaim buffers at the next synchronization point.
+"""
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .aio_handle import AsyncIOHandle
+from .utils import SwapBuffer, SwapBufferPool
+
+
+class AsyncTensorSwapper:
+    def __init__(self, handle: AsyncIOHandle, buffer_bytes: int,
+                 buffer_count: int = 4):
+        self.handle = handle
+        self.pool = SwapBufferPool(buffer_bytes, buffer_count)
+        self._inflight: List[SwapBuffer] = []
+
+    def swap_out(self, array: np.ndarray, path: str) -> None:
+        """Stage `array` into a pool buffer and write asynchronously."""
+        if self.pool.free_count == 0:
+            self.synchronize()
+        buf = self.pool.allocate()
+        view = buf.view(array.size, array.dtype)
+        view[...] = array.reshape(-1)
+        self.handle.pwrite(view, path, async_op=True)
+        self._inflight.append(buf)
+
+    def synchronize(self) -> None:
+        """Wait for all in-flight writes; reclaim buffers."""
+        if not self._inflight:
+            return
+        self.handle.wait()
+        for buf in self._inflight:
+            self.pool.release(buf)
+        self._inflight.clear()

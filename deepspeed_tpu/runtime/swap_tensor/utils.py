@@ -1,0 +1,58 @@
+"""Swap buffer management (reference: runtime/swap_tensor/utils.py:37,95,178
+SwapBuffer/SwapBufferPool/SwapBufferManager — pinned, io-aligned host
+buffers reused across swap operations)."""
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+AIO_ALIGN_BYTES = 4096  # O_DIRECT-friendly alignment (reference block align)
+
+
+def aligned_empty(num_bytes: int, dtype=np.float32) -> np.ndarray:
+    """Allocate a buffer whose base address is AIO_ALIGN_BYTES-aligned (the
+    reference's pinned+aligned bounce buffers; host DRAM here)."""
+    itemsize = np.dtype(dtype).itemsize
+    count = (num_bytes + itemsize - 1) // itemsize
+    raw = np.empty(count * itemsize + AIO_ALIGN_BYTES, dtype=np.uint8)
+    offset = (-raw.ctypes.data) % AIO_ALIGN_BYTES
+    return raw[offset:offset + count * itemsize].view(dtype)
+
+
+class SwapBuffer:
+    """One reusable buffer with a dtype-view cache."""
+
+    def __init__(self, num_bytes: int):
+        self.num_bytes = num_bytes
+        self.data = aligned_empty(num_bytes, np.uint8)
+
+    def view(self, count: int, dtype=np.float32) -> np.ndarray:
+        nbytes = count * np.dtype(dtype).itemsize
+        if nbytes > self.num_bytes:
+            raise ValueError(
+                f"swap buffer too small: need {nbytes}, have {self.num_bytes}")
+        return self.data[:nbytes].view(dtype)
+
+
+class SwapBufferPool:
+    """Fixed pool of equal-size buffers (reference SwapBufferPool:95)."""
+
+    def __init__(self, num_bytes: int, count: int):
+        self.buffers: List[SwapBuffer] = [
+            SwapBuffer(num_bytes) for _ in range(count)]
+        self._free = list(range(count))
+
+    def allocate(self) -> SwapBuffer:
+        if not self._free:
+            raise RuntimeError("swap buffer pool exhausted")
+        return self.buffers[self._free.pop()]
+
+    def release(self, buf: SwapBuffer) -> None:
+        idx = self.buffers.index(buf)
+        if idx in self._free:
+            raise RuntimeError("double release of swap buffer")
+        self._free.append(idx)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)

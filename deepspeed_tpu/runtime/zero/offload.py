@@ -1,0 +1,112 @@
+"""ZeRO-Offload: optimizer states in TPU-VM host DRAM, stepped by native
+host Adam while the chips hold only compute-dtype parameters.
+
+Reference: the stage-2 CPU-offload path (runtime/zero/stage2.py:976-1125
+pinned-buffer grad staging + DeepSpeedCPUAdam step + fp16 copy back).  The
+TPU recasting: device keeps bf16/fp16 params; each step the (already
+ZeRO-sharded, already data-parallel-reduced) gradients are fetched to host,
+the C++ OpenMP Adam (csrc/adam/host_adam.cpp) updates fp32 master + moments
+in place, and the updated params return to HBM via an async device_put —
+fused with the fp32→bf16 cast in native code (the adam_update_copy analog).
+
+The dynamic-loss-scale overflow check runs on host for free during the
+gradient fetch (stage2.py:1783 has a dedicated allreduce for this).
+"""
+
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.logging import log_dist
+from ...ops.adam import DeepSpeedCPUAdam
+
+
+def _global_grad_norm(leaves) -> float:
+    sq = 0.0
+    for g in leaves:
+        sq += float(np.vdot(g, g).real)
+    return float(np.sqrt(sq))
+
+
+class HostOffloadOptimizer:
+    """Owns the host-side fp32 master/moments and the native Adam step.
+
+    apply() is synchronous host math between two async device epochs: the
+    grad fetch blocks on the last device program, the device_put of updated
+    params dispatches without blocking the next forward.
+    """
+
+    def __init__(self, master_params: Any, optimizer_name: str,
+                 optimizer_params: dict, gradient_clipping: float = 0.0):
+        name = (optimizer_name or "adam").lower()
+        if name not in ("adam", "adamw"):
+            raise ValueError(
+                f"offload_optimizer supports Adam/AdamW, got {optimizer_name!r}"
+                " (reference: only DeepSpeedCPUAdam is offloadable —"
+                " stage2.py:1011 cpu_offload requires it)")
+        p = dict(optimizer_params or {})
+        betas = p.get("betas", (0.9, 0.999))
+        self.opt = DeepSpeedCPUAdam(
+            master_params, lr=p.get("lr", 1e-3), betas=tuple(betas),
+            eps=p.get("eps", 1e-8),
+            weight_decay=p.get("weight_decay", 0.0),
+            adamw_mode=(name == "adamw" or bool(p.get("adam_w_mode", False))))
+        self.gradient_clipping = float(gradient_clipping or 0.0)
+        log_dist(
+            f"ZeRO-Offload: host {name} over "
+            f"{sum(l.size for l in jax.tree.leaves(self.opt.params))} params, "
+            f"native={self.opt.using_native}", ranks=[0])
+
+    @property
+    def master_params(self):
+        return self.opt.params
+
+    def step_count(self) -> int:
+        return self.opt.step_count
+
+    def apply(self, grads_device: Any, scale_inv: float,
+              lr: Optional[float], store_dtype) -> Any:
+        """Fetch grads, step host Adam, return updated device-ready params
+        (or None on overflow — the caller skips and rescales)."""
+        g_leaves = [np.asarray(g, dtype=np.float32)
+                    for g in jax.tree.leaves(grads_device)]
+        finite = all(np.isfinite(g).all() for g in g_leaves)
+        if not finite:
+            return None
+        if scale_inv != 1.0:
+            for g in g_leaves:
+                g *= scale_inv
+        if self.gradient_clipping > 0.0:
+            norm = _global_grad_norm(g_leaves)
+            if norm > self.gradient_clipping:
+                clip = self.gradient_clipping / (norm + 1e-6)
+                for g in g_leaves:
+                    g *= clip
+        treedef = jax.tree.structure(self.opt.params)
+        grads = jax.tree_util.tree_unflatten(treedef, g_leaves)
+        if store_dtype == jnp.bfloat16:
+            # Native fused update+cast writes the device-bound bf16 copy.
+            return self.opt.step(grads, lr=lr, emit_bf16=True)
+        self.opt.step(grads, lr=lr)
+        return jax.tree.map(
+            lambda pm: pm.astype(np.dtype(store_dtype))
+            if pm.dtype == np.float32 and store_dtype != jnp.float32
+            else pm, self.opt.params)
+
+    def load_master_params(self, params: Any) -> None:
+        """Overwrite the host fp32 master from a (device or host) param tree
+        without touching moments — used when a checkpoint restores module
+        weights but not optimizer state."""
+        src_leaves = jax.tree.structure(self.opt.params).flatten_up_to(params)
+        for dst, src in zip(jax.tree.leaves(self.opt.params), src_leaves):
+            dst[...] = np.asarray(src, dtype=dst.dtype)
+
+    # -- checkpoint ----------------------------------------------------- #
+    def state_dict(self):
+        return self.opt.state_dict()
+
+    def load_state_dict(self, sd):
+        self.opt.load_state_dict(sd)

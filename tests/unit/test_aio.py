@@ -1,0 +1,177 @@
+"""Async I/O engine + NVMe optimizer swapper tests (reference shapes:
+tests/unit/test_aio.py:335 single/parallel read-write; ZeRO-Infinity step
+behavior from stage3.py:2777)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.runtime.swap_tensor import (AsyncIOHandle,
+                                               AsyncTensorSwapper,
+                                               NVMeOffloadOptimizer,
+                                               SwapBufferPool, aligned_empty)
+
+
+def test_native_aio_builds():
+    h = AsyncIOHandle()
+    assert h.using_native, "host_aio.cpp must compile in this image"
+    h.close()
+
+
+def test_sync_read_write_roundtrip(tmp_path):
+    h = AsyncIOHandle(block_size=4096, thread_count=2)
+    data = np.random.RandomState(0).randn(10000).astype(np.float32)
+    path = str(tmp_path / "t.bin")
+    h.pwrite(data, path, async_op=False)
+    out = np.empty_like(data)
+    h.pread(out, path, async_op=False)
+    np.testing.assert_array_equal(data, out)
+    h.close()
+
+
+def test_async_batch(tmp_path):
+    h = AsyncIOHandle(block_size=8192, queue_depth=4, thread_count=4)
+    arrays = [np.random.RandomState(i).randn(5000 + i).astype(np.float32)
+              for i in range(8)]
+    for i, a in enumerate(arrays):
+        h.pwrite(a, str(tmp_path / f"a{i}.bin"), async_op=True)
+    completed = h.wait()
+    assert completed == 8
+    outs = [np.empty_like(a) for a in arrays]
+    for i, o in enumerate(outs):
+        h.pread(o, str(tmp_path / f"a{i}.bin"), async_op=True)
+    h.wait()
+    for a, o in zip(arrays, outs):
+        np.testing.assert_array_equal(a, o)
+    h.close()
+
+
+def test_aligned_buffers():
+    buf = aligned_empty(1000)
+    assert buf.ctypes.data % 4096 == 0
+    pool = SwapBufferPool(4096, 3)
+    b1, b2 = pool.allocate(), pool.allocate()
+    assert pool.free_count == 1
+    pool.release(b1)
+    assert pool.free_count == 2
+    with pytest.raises(RuntimeError):
+        pool.release(b1)
+    pool.release(b2)
+
+
+def test_async_tensor_swapper(tmp_path):
+    h = AsyncIOHandle(thread_count=2)
+    sw = AsyncTensorSwapper(h, buffer_bytes=64 * 1024, buffer_count=2)
+    arrays = [np.random.RandomState(i).randn(1000).astype(np.float32)
+              for i in range(5)]
+    for i, a in enumerate(arrays):
+        sw.swap_out(a, str(tmp_path / f"g{i}.bin"))  # >2 forces sync cycles
+    sw.synchronize()
+    for i, a in enumerate(arrays):
+        out = np.empty_like(a)
+        h.pread(out, str(tmp_path / f"g{i}.bin"), async_op=False)
+        np.testing.assert_array_equal(a, out)
+    h.close()
+
+
+def _params():
+    rs = np.random.RandomState(0)
+    return {"w1": rs.randn(32, 16).astype(np.float32),
+            "w2": rs.randn(16, 8).astype(np.float32),
+            "count": np.array(0, np.int32)}
+
+
+def test_nvme_optimizer_matches_host_adam(tmp_path):
+    from deepspeed_tpu.ops.adam import DeepSpeedCPUAdam
+    params = _params()
+    nvme = NVMeOffloadOptimizer(params, str(tmp_path / "swap"),
+                                optimizer_name="adamw",
+                                optimizer_params={"lr": 1e-2,
+                                                  "weight_decay": 0.01})
+    ram = DeepSpeedCPUAdam({k: v for k, v in params.items()},
+                           lr=1e-2, weight_decay=0.01, adamw_mode=True)
+    for i in range(4):
+        rs = np.random.RandomState(100 + i)
+        grads = {"w1": rs.randn(32, 16).astype(np.float32),
+                 "w2": rs.randn(16, 8).astype(np.float32),
+                 "count": np.zeros((), np.int32)}
+        out = nvme.apply(grads, scale_inv=1.0, lr=None,
+                         store_dtype=jnp.float32)
+        assert out is not None
+        ram.step(grads)
+    master = nvme.gather_master()
+    for k in ("w1", "w2"):
+        np.testing.assert_allclose(master[k], ram.params[k],
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(out[k], ram.params[k],
+                                   rtol=1e-6, atol=1e-7)
+    assert out["count"].dtype == np.int32
+
+
+def test_nvme_overflow_skips(tmp_path):
+    params = _params()
+    nvme = NVMeOffloadOptimizer(params, str(tmp_path / "swap"))
+    grads = {"w1": np.full((32, 16), np.inf, np.float32),
+             "w2": np.zeros((16, 8), np.float32),
+             "count": np.zeros((), np.int32)}
+    assert nvme.apply(grads, 1.0, None, jnp.float32) is None
+    assert nvme.step_count() == 0
+
+
+def test_nvme_state_roundtrip(tmp_path):
+    params = _params()
+    a = NVMeOffloadOptimizer(params, str(tmp_path / "a"))
+    rs = np.random.RandomState(3)
+    g = {"w1": rs.randn(32, 16).astype(np.float32),
+         "w2": rs.randn(16, 8).astype(np.float32),
+         "count": np.zeros((), np.int32)}
+    a.apply(g, 1.0, None, jnp.float32)
+    sd = a.state_dict()
+    b = NVMeOffloadOptimizer(params, str(tmp_path / "b"))
+    b.load_state_dict(sd)
+    assert b.step_count() == 1
+    ga = a.apply(g, 1.0, None, jnp.float32)
+    gb = b.apply(g, 1.0, None, jnp.float32)
+    for k in ("w1", "w2"):
+        np.testing.assert_allclose(ga[k], gb[k], rtol=1e-6)
+
+
+def test_engine_nvme_offload(tmp_path):
+    ds.reset_mesh_context()
+    mesh = ds.initialize_mesh(data=-1)
+
+    def model(params, rng, x, y):
+        h = jnp.tanh(x @ params["w1"])
+        return jnp.mean(((h @ params["w2"]) - y) ** 2)
+
+    rs = np.random.RandomState(0)
+    params = {"w1": rs.randn(8, 16).astype(np.float32) * 0.3,
+              "w2": rs.randn(16, 4).astype(np.float32) * 0.3}
+    cfg = {
+        "train_micro_batch_size_per_gpu": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {
+            "stage": 3,
+            "offload_optimizer": {"device": "nvme",
+                                  "nvme_path": str(tmp_path)}},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=cfg,
+                                    model_parameters=params, mesh=mesh)
+    x = rs.randn(16, 8).astype(np.float32)
+    y = rs.randn(16, 4).astype(np.float32)
+    losses = []
+    for _ in range(6):
+        loss = engine.forward(x, y)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert engine.global_steps == 6
+    # states really live on disk
+    import os
+    files = os.listdir(str(tmp_path / "zero_stage_3" / "optimizer"))
+    assert any("exp_avg" in f for f in files)

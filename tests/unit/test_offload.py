@@ -1,0 +1,227 @@
+"""ZeRO-Offload tier tests: native host Adam numerics, fused bf16 copy-out,
+and the engine's offload_optimizer=cpu path (reference test shapes:
+tests/unit/test_zero.py:233 correctness-vs-baseline, test_checkpointing.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.ops.adam import DeepSpeedCPUAdam
+from deepspeed_tpu.ops.adam.cpu_adam import get_native_lib
+from deepspeed_tpu.ops.op_builder import CPUAdamBuilder, op_report
+
+
+def _params(seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "w": rng.randn(33, 17).astype(np.float32),
+        "b": rng.randn(17).astype(np.float32),
+        "step_id": np.array(3, np.int32),  # non-float pass-through leaf
+    }
+
+
+def _grads(seed=1):
+    rng = np.random.RandomState(seed)
+    return {
+        "w": rng.randn(33, 17).astype(np.float32),
+        "b": rng.randn(17).astype(np.float32),
+        "step_id": np.zeros((), np.int32),
+    }
+
+
+def test_native_builds():
+    assert CPUAdamBuilder().is_compatible()
+    lib = get_native_lib()
+    assert lib is not None, "host_adam.cpp must compile in this image"
+    assert lib.ds_adam_num_threads() >= 1
+
+
+def test_native_matches_numpy_fallback():
+    opt_native = DeepSpeedCPUAdam(_params(), lr=1e-2, weight_decay=0.01)
+    opt_np = DeepSpeedCPUAdam(_params(), lr=1e-2, weight_decay=0.01)
+    assert opt_native.using_native
+    opt_np._lib = None  # force the NumPy path
+    for i in range(4):
+        opt_native.step(_grads(i))
+        opt_np.step(_grads(i))
+    for a, b in zip(jax.tree.leaves(opt_native.params),
+                    jax.tree.leaves(opt_np.params)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("adamw", [True, False])
+def test_matches_optax(adamw):
+    import optax
+    params = {k: v for k, v in _params().items() if k != "step_id"}
+    opt = DeepSpeedCPUAdam(params, lr=1e-2, weight_decay=0.01,
+                           adamw_mode=adamw)
+    if adamw:
+        tx = optax.adamw(1e-2, weight_decay=0.01)
+    else:
+        tx = optax.chain(optax.add_decayed_weights(0.01),
+                         optax.adam(1e-2))
+    ref = jax.tree.map(jnp.asarray, params)
+    state = tx.init(ref)
+    for i in range(3):
+        g = {k: v for k, v in _grads(i).items() if k != "step_id"}
+        opt.step(g)
+        updates, state = tx.update(jax.tree.map(jnp.asarray, g), state, ref)
+        ref = optax.apply_updates(ref, updates)
+    for a, b in zip(jax.tree.leaves(opt.params), jax.tree.leaves(ref)):
+        np.testing.assert_allclose(a, np.asarray(b), rtol=2e-5, atol=1e-6)
+
+
+def test_bf16_emit():
+    import ml_dtypes
+    opt = DeepSpeedCPUAdam(_params(), lr=1e-2)
+    out = opt.step(_grads(), emit_bf16=True)
+    assert out["w"].dtype == ml_dtypes.bfloat16
+    assert out["step_id"].dtype == np.int32  # pass-through
+    np.testing.assert_allclose(
+        np.asarray(out["w"], np.float32),
+        opt.params["w"].astype(ml_dtypes.bfloat16).astype(np.float32))
+
+
+def test_op_report():
+    rep = op_report()
+    assert rep["cpu_adam"]["compatible"]
+
+
+def _mk_engine(offload: bool, seed=0):
+    ds.reset_mesh_context()
+    mesh = ds.initialize_mesh(data=-1)
+
+    def model(params, rng, x, y):
+        h = jnp.tanh(x @ params["w1"])
+        pred = h @ params["w2"]
+        return jnp.mean((pred - y) ** 2)
+
+    rs = np.random.RandomState(seed)
+    params = {"w1": rs.randn(8, 16).astype(np.float32) * 0.3,
+              "w2": rs.randn(16, 4).astype(np.float32) * 0.3}
+    cfg = {
+        "train_micro_batch_size_per_gpu": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "steps_per_print": 10 ** 9,
+    }
+    if offload:
+        cfg["zero_optimization"] = {
+            "stage": 2, "offload_optimizer": {"device": "cpu"}}
+    engine, _, _, _ = ds.initialize(model=model, config=cfg,
+                                    model_parameters=params, mesh=mesh)
+    return engine
+
+
+def _batch(seed=0):
+    rs = np.random.RandomState(seed + 100)
+    x = rs.randn(16, 8).astype(np.float32)
+    y = rs.randn(16, 4).astype(np.float32)
+    return x, y
+
+
+def test_engine_offload_matches_device_adam():
+    e_dev = _mk_engine(offload=False)
+    e_off = _mk_engine(offload=True)
+    assert e_off._offload_enabled and not e_dev._offload_enabled
+    for i in range(4):
+        x, y = _batch(i)
+        l1 = e_dev.forward(x, y); e_dev.backward(l1); e_dev.step()
+        l2 = e_off.forward(x, y); e_off.backward(l2); e_off.step()
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-4)
+    for a, b in zip(jax.tree.leaves(e_dev.params),
+                    jax.tree.leaves(e_off.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_engine_offload_loss_decreases():
+    engine = _mk_engine(offload=True)
+    losses = []
+    for i in range(6):
+        x, y = _batch(0)  # same batch -> must strictly improve
+        loss = engine.forward(x, y)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert engine.global_steps == 6
+
+
+def test_engine_offload_checkpoint_roundtrip(tmp_path):
+    engine = _mk_engine(offload=True)
+    for i in range(2):
+        x, y = _batch(i)
+        loss = engine.forward(x, y); engine.backward(loss); engine.step()
+    engine.save_checkpoint(str(tmp_path), tag="t1")
+
+    engine2 = _mk_engine(offload=True, seed=7)
+    engine2.load_checkpoint(str(tmp_path), tag="t1")
+    assert engine2._offload_opt.step_count() == engine._offload_opt.step_count()
+    for a, b in zip(jax.tree.leaves(engine.params),
+                    jax.tree.leaves(engine2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    # training continues from identical state -> identical next step
+    x, y = _batch(9)
+    l1 = engine.forward(x, y); engine.backward(l1); engine.step()
+    l2 = engine2.forward(x, y); engine2.backward(l2); engine2.step()
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+
+
+def test_engine_offload_load_module_only(tmp_path):
+    """Module-only restore must also refresh the host fp32 master — a step
+    after load must start from the restored weights, not the constructor's."""
+    engine = _mk_engine(offload=True)
+    for i in range(3):
+        x, y = _batch(i)
+        loss = engine.forward(x, y); engine.backward(loss); engine.step()
+    engine.save_checkpoint(str(tmp_path), tag="m1")
+    trained = jax.tree.map(np.asarray, engine.params)
+
+    fresh = _mk_engine(offload=True, seed=9)
+    fresh.load_checkpoint(str(tmp_path), tag="m1", load_module_only=True)
+    master = fresh._offload_opt.master_params
+    for a, b in zip(jax.tree.leaves(trained), jax.tree.leaves(master)):
+        np.testing.assert_allclose(a, np.asarray(b), rtol=1e-6)
+    # one step must not clobber the restored weights with stale master math
+    x, y = _batch(5)
+    loss = fresh.forward(x, y); fresh.backward(loss); fresh.step()
+    drift = max(float(np.abs(np.asarray(a) - np.asarray(b)).max())
+                for a, b in zip(jax.tree.leaves(fresh.params),
+                                jax.tree.leaves(trained)))
+    assert drift < 0.1, "post-load step diverged from restored weights"
+
+
+def test_engine_offload_bf16_store():
+    ds.reset_mesh_context()
+    mesh = ds.initialize_mesh(data=-1)
+
+    def model(params, rng, x, y):
+        pred = x @ params["w"]
+        return jnp.mean((pred - y) ** 2)
+
+    params = {"w": np.random.RandomState(0).randn(8, 4).astype(np.float32)}
+    cfg = {
+        "train_micro_batch_size_per_gpu": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2,
+                              "offload_optimizer": {"device": "cpu"}},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=cfg,
+                                    model_parameters=params, mesh=mesh)
+    assert engine.params["w"].dtype == jnp.bfloat16
+    x, y = _batch(0)
+    x = x[:, :8]
+    losses = []
+    for _ in range(5):
+        loss = engine.forward(x, y)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    # master stays fp32 on host
+    assert engine._offload_opt.master_params["w"].dtype == np.float32
