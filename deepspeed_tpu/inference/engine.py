@@ -1,0 +1,257 @@
+"""InferenceEngine — tensor-parallel fused-kernel serving with KV cache.
+
+Reference: deepspeed/inference/engine.py:19 (InferenceEngine:
+_create_model_parallel_group:88, _apply_injection_policy:130, quantized
+checkpoint load :145, broadcast-kwargs forward :190) reached via
+deepspeed.init_inference (__init__.py:232).
+
+TPU-native architecture:
+  - model surgery first: an HF torch model is converted to our stacked-
+    pytree GPT2/BERT via module_inject (no in-place nn.Module swapping);
+  - tensor parallelism is the mesh "model" axis + the model's
+    param_partition_specs — mp_size just sizes that axis; GSPMD inserts
+    the per-layer collectives the reference does inside its CUDA kernels;
+  - generation is two compiled programs: prefill (flash attention over the
+    prompt, emits the KV cache) and a lax.scan'd decode loop (one token per
+    step against a static-shape cache) — single dispatch for the whole
+    generation, no per-token Python;
+  - int8: WeightQuantization rewrites matmul weights to (int8, scale)
+    pairs dequantized in the gemm epilogue (HBM halves, MXU still bf16).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.transformer_inference import (DeepSpeedTransformerInference,
+                                         KVCache)
+from ..parallel import mesh as mesh_mod
+from ..runtime.weight_quantizer import WeightQuantization
+from ..utils.logging import log_dist
+
+
+def _is_torch_module(model) -> bool:
+    return hasattr(model, "named_parameters") and hasattr(model, "children")
+
+
+class InferenceEngine:
+    def __init__(self, model, mp_size: int = 1, mesh=None, checkpoint=None,
+                 dtype=None, injection_policy=None, replace_method="auto",
+                 quantization_setting=None, model_parameters=None,
+                 moe_experts: int = 1, **kwargs):
+        # ---- mesh (mp_size sizes the model axis) ---------------------- #
+        if mesh is not None:
+            ctx = mesh if isinstance(mesh, mesh_mod.MeshContext) else \
+                mesh_mod.MeshContext(mesh)
+            mesh_mod.set_mesh_context(ctx)
+        else:
+            ctx = mesh_mod.get_mesh_context(required=False)
+            if ctx is None:
+                ctx = mesh_mod.initialize_mesh(data=-1, model=mp_size)
+        self.mesh_ctx = ctx
+        self.mp_world_size = ctx.model_parallel_world_size
+        if mp_size > 1 and self.mp_world_size != mp_size:
+            raise ValueError(
+                f"mp_size={mp_size} but the active mesh has a model axis of "
+                f"{self.mp_world_size} — pass a mesh with model={mp_size} or "
+                f"reset the mesh context first")
+
+        # ---- injection (HF torch -> TPU model) ------------------------ #
+        if _is_torch_module(model):
+            from ..module_inject import replace_transformer_layer
+            bf16 = dtype in (None, jnp.bfloat16, "bf16", "bfloat16")
+            model, model_parameters = replace_transformer_layer(
+                model, policy=injection_policy, bf16=bf16)
+        self.module = model
+
+        if model_parameters is None:
+            model_parameters = getattr(model, "params", None)
+        if model_parameters is None and checkpoint is not None:
+            from ..runtime import checkpoint as ckpt_mod
+            template = model.init_params(jax.random.PRNGKey(0))
+            state, _, _ = ckpt_mod.load_checkpoint_state(
+                checkpoint, None, {"module": template}, None)
+            model_parameters = state["module"]
+        if model_parameters is None:
+            raise ValueError("inference needs model weights: pass an HF "
+                             "model, model_parameters=, or checkpoint=")
+
+        # ---- int8 quantization (reference :145) ----------------------- #
+        self.quantization = None
+        if quantization_setting:
+            if isinstance(quantization_setting, tuple):
+                mlp_extra, groups = quantization_setting
+            else:
+                mlp_extra, groups = False, int(quantization_setting)
+            wq = WeightQuantization(mlp_extra_grouping=mlp_extra,
+                                    quantize_groups=groups)
+            model_parameters = dict(model_parameters)
+            model_parameters["h"] = wq.quantize_stacked_layers(
+                model_parameters["h"])
+            self.quantization = wq
+            log_dist(f"int8-quantized layer weights "
+                     f"(groups={groups})", ranks=[0])
+
+        # ---- TP placement --------------------------------------------- #
+        specs = (model.param_partition_specs()
+                 if hasattr(model, "param_partition_specs") else None)
+        self.params = self._place(model_parameters, specs)
+
+        cfg = model.config
+        self.inf_layer = DeepSpeedTransformerInference(cfg.layer_config())
+        self._fwd = jax.jit(self._forward_impl)
+        self._generate_cache = {}
+        log_dist(
+            f"InferenceEngine: {type(model).__name__} mp={self.mp_world_size}"
+            f" dtype={cfg.dtype.__name__}", ranks=[0])
+
+    # ------------------------------------------------------------------ #
+    def _place(self, params, specs):
+        from ..ops.quant import QuantizedWeight
+
+        def place_leaf(leaf, spec):
+            if isinstance(leaf, QuantizedWeight):
+                # int8 payloads replicate (scales are tiny; the qweight
+                # could shard too, but spec trees target the fp layout)
+                return QuantizedWeight(
+                    jax.device_put(leaf.qweight, self.mesh_ctx.replicated()),
+                    jax.device_put(leaf.scale, self.mesh_ctx.replicated()))
+            sharding = (self.mesh_ctx.sharding(*spec) if spec is not None
+                        else self.mesh_ctx.replicated())
+            return jax.device_put(jnp.asarray(leaf), sharding)
+
+        if specs is None:
+            return jax.tree.map(
+                lambda l: place_leaf(l, None), params,
+                is_leaf=lambda x: isinstance(x, QuantizedWeight))
+        # specs is a prefix tree of PartitionSpecs aligned with params
+        flat_p = jax.tree_util.tree_flatten_with_path(
+            params, is_leaf=lambda x: isinstance(x, QuantizedWeight))[0]
+        spec_map = {}
+        for path, spec in jax.tree_util.tree_flatten_with_path(
+                specs, is_leaf=lambda x: x is None or hasattr(x, "index"))[0]:
+            spec_map[jax.tree_util.keystr(path)] = spec
+        out_leaves = []
+        for path, leaf in flat_p:
+            out_leaves.append(place_leaf(
+                leaf, spec_map.get(jax.tree_util.keystr(path))))
+        treedef = jax.tree_util.tree_structure(
+            params, is_leaf=lambda x: isinstance(x, QuantizedWeight))
+        return jax.tree_util.tree_unflatten(treedef, out_leaves)
+
+    # ------------------------------------------------------------------ #
+    def _forward_impl(self, params, *args, **kwargs):
+        if hasattr(self.module, "logits"):
+            return self.module.logits(params, *args, deterministic=True,
+                                      **kwargs)
+        if hasattr(self.module, "hidden_states"):
+            return self.module.hidden_states(params, *args,
+                                             deterministic=True, **kwargs)
+        return self.module(params, None, *args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        """Logits/hidden-states forward (reference engine.py:190)."""
+        return self._fwd(self.params, *args, **kwargs)
+
+    __call__ = forward
+
+    # ------------------------------------------------------------------ #
+    # generation (causal models)
+    # ------------------------------------------------------------------ #
+    def _gen_fn(self, prompt_len: int, max_new: int):
+        key = (prompt_len, max_new)
+        if key in self._generate_cache:
+            return self._generate_cache[key]
+        model = self.module  # causal LM with embed/head_logits (GPT2Model)
+        cfg = model.config
+        layer = self.inf_layer
+        n_layers = cfg.num_layers
+        heads = cfg.num_heads
+        head_dim = cfg.hidden_size // heads
+        max_len = prompt_len + max_new
+        embed = model.embed
+        head_logits = model.head_logits
+
+        def generate(params, input_ids, rng, temperature):
+            b = input_ids.shape[0]
+            caches = KVCache(
+                jnp.zeros((n_layers, b, heads, max_len, head_dim),
+                          cfg.dtype),
+                jnp.zeros((n_layers, b, heads, max_len, head_dim),
+                          cfg.dtype))
+
+            # ---- prefill: scan layers over the whole prompt ---------- #
+            h = embed(params, input_ids, 0)
+
+            def prefill_body(carry, xs):
+                lp, ck, cv = xs
+                out, cache = layer.prefill(
+                    lp, carry, KVCache(ck, cv))
+                return out, (cache.k, cache.v)
+
+            h, (ks, vs) = jax.lax.scan(
+                prefill_body, h, (params["h"], caches.k, caches.v))
+            caches = KVCache(ks, vs)
+            logits = head_logits(params, h[:, -1:, :])
+
+            def sample(logits, r):
+                logits = logits[:, -1, :]
+                return jax.lax.cond(
+                    temperature > 0,
+                    lambda: jax.random.categorical(
+                        r, logits / jnp.maximum(temperature, 1e-6), axis=-1),
+                    lambda: jnp.argmax(logits, axis=-1))
+
+            rng, r0 = jax.random.split(rng)
+            tok0 = sample(logits, r0)
+
+            # ---- decode: scan over new tokens ------------------------ #
+            def decode_step(carry, r):
+                caches, tok, pos = carry
+                x = embed(params, tok[:, None], pos)
+
+                def layer_body(carry_h, xs):
+                    lp, ck, cv = xs
+                    out, cache = layer.decode(
+                        lp, carry_h, KVCache(ck, cv), pos)
+                    return out, (cache.k, cache.v)
+
+                h, (ks, vs) = jax.lax.scan(
+                    layer_body, x, (params["h"], caches.k, caches.v))
+                caches = KVCache(ks, vs)
+                logits = head_logits(params, h)
+                nxt = sample(logits, r)
+                return (caches, nxt, pos + 1), tok
+
+            # tok0 is generated token #1; each of the max_new-1 scan steps
+            # feeds the previous token and samples the next.
+            rs = jax.random.split(rng, max_new - 1)
+            (_, last, _), toks = jax.lax.scan(
+                decode_step, (caches, tok0, jnp.int32(prompt_len)), rs)
+            return jnp.concatenate(
+                [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
+
+        fn = jax.jit(generate)
+        self._generate_cache[key] = fn
+        return fn
+
+    def generate(self, input_ids, max_new_tokens: int = 16,
+                 temperature: float = 0.0, rng=None):
+        """Greedy (temperature=0) or sampled generation.  Returns the
+        generated tokens [B, max_new_tokens] (prompt not included)."""
+        if not hasattr(self.module, "logits") or not getattr(
+                self.module.config, "tie_word_embeddings", True) and \
+                "lm_head" not in self.params:
+            raise ValueError("generate() needs a causal LM model")
+        input_ids = jnp.asarray(input_ids)
+        total = int(input_ids.shape[1]) + int(max_new_tokens)
+        n_pos = getattr(self.module.config, "n_positions", None)
+        if n_pos is not None and total > n_pos:
+            raise ValueError(
+                f"prompt ({input_ids.shape[1]}) + max_new_tokens "
+                f"({max_new_tokens}) = {total} exceeds the model's "
+                f"n_positions ({n_pos})")
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        fn = self._gen_fn(int(input_ids.shape[1]), int(max_new_tokens))
+        return fn(self.params, input_ids, rng,
+                  jnp.float32(temperature))

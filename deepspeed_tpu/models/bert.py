@@ -36,6 +36,7 @@ class BertConfig:
     attn_dropout: float = 0.1
     hidden_dropout: float = 0.1
     layer_norm_eps: float = 1e-12
+    hidden_act: str = "gelu"         # HF BERT default: exact erf gelu
     initializer_range: float = 0.02
     bf16: bool = True
     pre_layer_norm: bool = True      # reference supports both (preln/postln)
@@ -63,6 +64,7 @@ class BertConfig:
             bf16=self.bf16,
             pre_layer_norm=self.pre_layer_norm,
             causal=False,
+            activation=self.hidden_act,
         )
 
     def num_params(self, include_embeddings: bool = True) -> int:

@@ -126,19 +126,37 @@ class GPT2Model:
         return specs
 
     # -- forward ------------------------------------------------------- #
+    def embed(self, params, input_ids, position_offset=0):
+        """Token + position embedding; position_offset supports KV-cache
+        decode (inference engine feeds one token at position `pos`)."""
+        cfg = self.config
+        wte = params["wte"].astype(cfg.dtype)
+        wpe = params["wpe"].astype(cfg.dtype)
+        pos = position_offset + jnp.arange(input_ids.shape[1])
+        return wte[input_ids] + wpe[pos]
+
+    def head_logits(self, params, h):
+        """Final LN + (tied) LM head, fp32 logits."""
+        cfg = self.config
+        h = fused_layer_norm(h, params["ln_f"]["w"], params["ln_f"]["b"],
+                             cfg.layer_norm_eps)
+        if cfg.tie_word_embeddings:
+            head = params["wte"].astype(h.dtype).T
+        else:
+            head = params["lm_head"].astype(h.dtype)
+        return (h @ head).astype(jnp.float32)
+
     def hidden_states(self, params, input_ids, rng=None,
                       deterministic: bool = False):
-        """input_ids [B, S] -> final hidden states [B, S, H]."""
+        """input_ids [B, S] -> pre-head hidden states [B, S, H] (the final
+        LN lives in head_logits so the KV-cache decode path shares it)."""
         cfg = self.config
-        b, s = input_ids.shape
         if rng is None:
             deterministic = True
             rng = jax.random.PRNGKey(0)
         r_embd, r_layers = jax.random.split(rng)
 
-        wte = params["wte"].astype(cfg.dtype)
-        wpe = params["wpe"].astype(cfg.dtype)
-        h = wte[input_ids] + wpe[jnp.arange(s)]
+        h = self.embed(params, input_ids)
         h = dropout(h, cfg.embd_dropout, r_embd, deterministic)
 
         layer_fn = self.layer
@@ -154,16 +172,11 @@ class GPT2Model:
 
         layer_rngs = jax.random.split(r_layers, cfg.num_layers)
         h, _ = jax.lax.scan(body, h, (params["h"], layer_rngs))
-        return fused_layer_norm(h, params["ln_f"]["w"], params["ln_f"]["b"],
-                                cfg.layer_norm_eps)
+        return h
 
     def logits(self, params, input_ids, rng=None, deterministic=False):
         h = self.hidden_states(params, input_ids, rng, deterministic)
-        if self.config.tie_word_embeddings:
-            head = params["wte"].astype(h.dtype).T
-        else:
-            head = params["lm_head"].astype(h.dtype)
-        return h @ head
+        return self.head_logits(params, h)
 
     def loss(self, params, rng, input_ids, labels=None):
         """Next-token cross entropy (fp32 softmax).  When labels is None,

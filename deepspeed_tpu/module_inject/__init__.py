@@ -1,0 +1,4 @@
+from .replace_policy import (HFBertLayerPolicy, HFGPT2LayerPolicy,
+                             HFGPTNEOLayerPolicy, InjectBasePolicy,
+                             replace_policies)
+from .replace_module import replace_transformer_layer

@@ -1,0 +1,138 @@
+"""Model surgery: convert an HF torch model into our TPU model + params.
+
+Reference: deepspeed/module_inject/replace_module.py
+(replace_transformer_layer:89 swapping layers for fused kernels,
+ReplaceWithTensorSlicing:11 sharding weights across mp ranks, generic
+replace_module:383).
+
+TPU recasting: `replace_transformer_layer(hf_model)` walks the source
+module tree, matches each transformer layer against `replace_policies`,
+extracts weights via the policy, stacks them along a leading layer axis
+(the lax.scan layout of models/gpt2.py), and returns
+(tpu_model, params).  Tensor-parallel slicing needs no per-rank loops:
+the returned model's `param_partition_specs()` + `jax.device_put` with a
+NamedSharding ARE the ReplaceWithTensorSlicing step — GSPMD splits qkv/
+inter column-wise and ow/output row-wise exactly like the reference's
+mp_replace.qkv_copy/copy.
+"""
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.logging import log_dist
+from .replace_policy import InjectBasePolicy, replace_policies
+
+
+def _find_layers(module, policy_cls) -> List[Any]:
+    """Depth-first collect source layers matching the policy (the reference
+    walks named_modules the same way, replace_module.py:383)."""
+    found = []
+    for child in module.children():
+        if policy_cls.matches(child):
+            found.append(child)
+        else:
+            found.extend(_find_layers(child, policy_cls))
+    return found
+
+
+def _detect_policy(model, policy: Optional[type]) -> Tuple[type, List[Any]]:
+    if policy is not None:
+        layers = _find_layers(model, policy)
+        if not layers:
+            raise ValueError(
+                f"no layers matching {policy.__name__} in {type(model)}")
+        return policy, layers
+    for cand in replace_policies:
+        layers = _find_layers(model, cand)
+        if layers:
+            return cand, layers
+    raise ValueError(
+        f"no injection policy matches {type(model).__name__} — pass "
+        f"injection_policy= explicitly (reference: replace_module.py:89)")
+
+
+def _stack_layers(layer_param_dicts: List[Dict[str, np.ndarray]]):
+    keys = layer_param_dicts[0].keys()
+    return {k: np.stack([d[k] for d in layer_param_dicts]) for k in keys}
+
+
+def _np(t) -> np.ndarray:
+    return t.detach().cpu().numpy()
+
+
+def replace_transformer_layer(model, policy: Optional[type] = None,
+                              bf16: bool = True):
+    """HF torch model -> (tpu_model, params).
+
+    Supports GPT2LMHeadModel/GPT2Model (-> models.gpt2.GPT2Model) and
+    BertModel/BertForMaskedLM (-> models.bert.BertModel).  Returns our
+    model object (whose param_partition_specs drives TP sharding) and the
+    stacked param pytree.
+    """
+    policy_cls, layers = _detect_policy(model, policy)
+    stacked = _stack_layers(
+        [policy_cls(l).layer_params() for l in layers])
+    name = type(model).__name__
+
+    if not policy_cls.scale_attention:
+        # Our flash attention always scales scores by 1/sqrt(head_dim);
+        # GPT-Neo's source attention does not.  Folding sqrt(head_dim) into
+        # the q projection makes the net scaling identity.
+        heads = getattr(model.config, "num_heads",
+                        getattr(model.config, "n_head", 1))
+        q_cols = stacked["attn_qkvw"].shape[2] // 3  # [L, H, 3H] layout
+        root_d = float(np.sqrt(q_cols // heads))
+        stacked["attn_qkvw"][:, :, :q_cols] *= root_d
+        stacked["attn_qkvb"][:, :q_cols] *= root_d
+
+    if policy_cls.causal:  # GPT-2 / GPT-Neo family
+        from ..models.gpt2 import GPT2Config, GPT2Model
+        base = getattr(model, "transformer", model)
+        wte, wpe = _np(base.wte.weight), _np(base.wpe.weight)
+        h = wte.shape[1]
+        cfg_src = model.config
+        cfg = GPT2Config(
+            vocab_size=wte.shape[0], n_positions=wpe.shape[0],
+            hidden_size=h, num_layers=len(layers),
+            num_heads=getattr(cfg_src, "n_head",
+                              getattr(cfg_src, "num_heads", 12)),
+            intermediate_size=stacked["inter_w"].shape[-1],
+            layer_norm_eps=getattr(cfg_src, "layer_norm_epsilon", 1e-5),
+            embd_dropout=0.0, attn_dropout=0.0, hidden_dropout=0.0,
+            bf16=bf16, tie_word_embeddings=True)
+        params = {
+            "wte": wte, "wpe": wpe, "h": stacked,
+            "ln_f": {"w": _np(base.ln_f.weight), "b": _np(base.ln_f.bias)},
+        }
+        tpu_model = GPT2Model(cfg)
+    else:  # BERT family
+        from ..models.bert import BertConfig, BertModel
+        base = getattr(model, "bert", model)
+        emb = base.embeddings
+        wte = _np(emb.word_embeddings.weight)
+        wpe = _np(emb.position_embeddings.weight)
+        tte = _np(emb.token_type_embeddings.weight)
+        cfg_src = model.config
+        cfg = BertConfig(
+            vocab_size=wte.shape[0], hidden_size=wte.shape[1],
+            num_layers=len(layers),
+            num_heads=getattr(cfg_src, "num_attention_heads", 12),
+            intermediate_size=stacked["inter_w"].shape[-1],
+            max_position_embeddings=wpe.shape[0],
+            type_vocab_size=tte.shape[0],
+            layer_norm_eps=getattr(cfg_src, "layer_norm_eps", 1e-12),
+            hidden_act=getattr(cfg_src, "hidden_act", "gelu"),
+            embd_dropout=0.0, attn_dropout=0.0, hidden_dropout=0.0,
+            bf16=bf16, pre_layer_norm=policy_cls.pre_layer_norm)
+        params = {
+            "wte": wte, "wpe": wpe, "tte": tte,
+            "emb_ln": {"w": _np(emb.LayerNorm.weight),
+                       "b": _np(emb.LayerNorm.bias)},
+            "h": stacked,
+        }
+        tpu_model = BertModel(cfg)
+    log_dist(
+        f"module_inject: {name} -> {type(tpu_model).__name__} "
+        f"({len(layers)} layers, policy={policy_cls.__name__})", ranks=[0])
+    return tpu_model, params

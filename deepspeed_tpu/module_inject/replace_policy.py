@@ -1,0 +1,141 @@
+"""Injection policies — where q,k,v,o,mlp weights live in a source model.
+
+Reference: deepspeed/module_inject/replace_policy.py (HFBertLayerPolicy:43,
+HFGPT2LayerPolicy:195, HFGPTNEOLayerPolicy:102, MegatronLayerPolicy:146,
+replace_policies:234).  A policy reads one source transformer layer and
+returns the weight set; replace_module.py assembles the TPU param trees.
+
+TPU recasting: instead of swapping nn.Modules in place, a policy converts
+an HF *torch* model's weights into the stacked pytree layout that
+GPT2Model/BertModel/DeepSpeedTransformerInference consume — model surgery
+as a checkpoint transform, after which everything is jit/GSPMD-native.
+
+Weight orientation note: our layers compute x @ W with W [in, out].
+HF GPT-2 uses Conv1D ([in, out] already); BERT/GPT-Neo use nn.Linear
+([out, in]) and need a transpose — the same special-casing the reference
+does per policy.
+"""
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+
+def _np(t) -> np.ndarray:
+    return t.detach().cpu().numpy()
+
+
+class InjectBasePolicy:
+    """One source layer -> our DeepSpeedTransformerLayer param dict."""
+
+    # subclasses set these
+    pre_layer_norm: bool = True
+    causal: bool = False
+    scale_attention: bool = True
+
+    def __init__(self, layer):
+        self.layer = layer
+
+    def layer_params(self) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    @classmethod
+    def matches(cls, module) -> bool:
+        return type(module).__name__ in cls.LAYER_CLASS_NAMES
+
+
+class HFGPT2LayerPolicy(InjectBasePolicy):
+    """HF transformers GPT2Block (reference: replace_policy.py:195)."""
+
+    LAYER_CLASS_NAMES = ("GPT2Block", "Block")
+    pre_layer_norm = True
+    causal = True
+
+    def layer_params(self):
+        l = self.layer
+        return {
+            "attn_qkvw": _np(l.attn.c_attn.weight),          # [H, 3H] Conv1D
+            "attn_qkvb": _np(l.attn.c_attn.bias),
+            "attn_ow": _np(l.attn.c_proj.weight),            # [H, H]
+            "attn_ob": _np(l.attn.c_proj.bias),
+            "norm_w": _np(l.ln_1.weight),                    # pre-attn LN
+            "norm_b": _np(l.ln_1.bias),
+            "attn_nw": _np(l.ln_2.weight),                   # pre-MLP LN
+            "attn_nb": _np(l.ln_2.bias),
+            "inter_w": _np(l.mlp.c_fc.weight),               # [H, 4H]
+            "inter_b": _np(l.mlp.c_fc.bias),
+            "output_w": _np(l.mlp.c_proj.weight),            # [4H, H]
+            "output_b": _np(l.mlp.c_proj.bias),
+        }
+
+
+class HFBertLayerPolicy(InjectBasePolicy):
+    """HF transformers BertLayer (reference: replace_policy.py:43)."""
+
+    LAYER_CLASS_NAMES = ("BertLayer", "RobertaLayer")
+    pre_layer_norm = False
+    causal = False
+
+    def layer_params(self):
+        l = self.layer
+        att = l.attention.self
+        qkvw = np.concatenate(
+            [_np(att.query.weight).T, _np(att.key.weight).T,
+             _np(att.value.weight).T], axis=1)               # -> [H, 3H]
+        qkvb = np.concatenate(
+            [_np(att.query.bias), _np(att.key.bias), _np(att.value.bias)])
+        return {
+            "attn_qkvw": qkvw,
+            "attn_qkvb": qkvb,
+            "attn_ow": _np(l.attention.output.dense.weight).T,
+            "attn_ob": _np(l.attention.output.dense.bias),
+            "attn_nw": _np(l.attention.output.LayerNorm.weight),  # post-attn
+            "attn_nb": _np(l.attention.output.LayerNorm.bias),
+            "inter_w": _np(l.intermediate.dense.weight).T,
+            "inter_b": _np(l.intermediate.dense.bias),
+            "output_w": _np(l.output.dense.weight).T,
+            "output_b": _np(l.output.dense.bias),
+            "norm_w": _np(l.output.LayerNorm.weight),            # post-MLP
+            "norm_b": _np(l.output.LayerNorm.bias),
+        }
+
+
+class HFGPTNEOLayerPolicy(InjectBasePolicy):
+    """HF transformers GPTNeoBlock (reference: replace_policy.py:102)."""
+
+    LAYER_CLASS_NAMES = ("GPTNeoBlock",)
+    pre_layer_norm = True
+    causal = True
+    # GPT-Neo attention applies NO 1/sqrt(d) scaling; replace_module folds
+    # the compensating sqrt(d) into the q projection.
+    scale_attention = False
+
+    def layer_params(self):
+        l = self.layer
+        att = l.attn.attention
+        h = _np(att.q_proj.weight).shape[1]
+        qkvw = np.concatenate(
+            [_np(att.q_proj.weight).T, _np(att.k_proj.weight).T,
+             _np(att.v_proj.weight).T], axis=1)
+        zeros = np.zeros((h,), np.float32)
+
+        def bias_of(lin):
+            return _np(lin.bias) if lin.bias is not None else zeros
+        return {
+            "attn_qkvw": qkvw,
+            "attn_qkvb": np.concatenate(
+                [bias_of(att.q_proj), bias_of(att.k_proj),
+                 bias_of(att.v_proj)]),
+            "attn_ow": _np(att.out_proj.weight).T,
+            "attn_ob": bias_of(att.out_proj),
+            "norm_w": _np(l.ln_1.weight), "norm_b": _np(l.ln_1.bias),
+            "attn_nw": _np(l.ln_2.weight), "attn_nb": _np(l.ln_2.bias),
+            "inter_w": _np(l.mlp.c_fc.weight).T,
+            "inter_b": _np(l.mlp.c_fc.bias),
+            "output_w": _np(l.mlp.c_proj.weight).T,
+            "output_b": _np(l.mlp.c_proj.bias),
+        }
+
+
+replace_policies: List[type] = [HFGPT2LayerPolicy, HFBertLayerPolicy,
+                                HFGPTNEOLayerPolicy]

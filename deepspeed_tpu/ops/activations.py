@@ -23,9 +23,17 @@ def gelu(x):
     return out.astype(x.dtype)
 
 
-def bias_gelu(x, bias):
+def gelu_exact(x):
+    """Exact (erf) gelu — HF BERT's default hidden_act="gelu"."""
+    xf = x.astype(jnp.float32)
+    return (xf * 0.5 * (1.0 + jax.lax.erf(
+        xf / jnp.sqrt(jnp.float32(2.0))))).astype(x.dtype)
+
+
+def bias_gelu(x, bias, approximate: bool = True):
     """Fused bias-add + gelu (gelu_kernels.cu fused_bias_gelu)."""
-    return gelu(x + bias)
+    y = x + bias
+    return gelu(y) if approximate else gelu_exact(y)
 
 
 def dropout(x, rate: float, rng, deterministic: bool = False):

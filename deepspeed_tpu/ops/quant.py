@@ -1,0 +1,42 @@
+"""Quantized-weight carrier shared by the training and inference layers.
+
+Reference: the int8 weight path of
+csrc/transformer/inference/csrc/dequantize.cu + pt_binding.cpp (vector_matmul
+int8 variants): weights live in HBM as int8 with per-group fp scales and are
+dequantized into the gemm.  On TPU the dequant-multiply fuses into the
+matmul epilogue under XLA, so this is a NamedTuple + one helper rather than
+a kernel.
+"""
+
+from typing import Any, NamedTuple
+
+import jax.numpy as jnp
+
+
+class QuantizedWeight(NamedTuple):
+    """Per-group symmetric int8 weight (reference: weight_quantizer.py:5).
+
+    scale groups split the leading (input) dimension; scale shape is
+    [groups, 1] (per layer) or [L, groups, 1] when layers are stacked."""
+    qweight: jnp.ndarray
+    scale: jnp.ndarray
+
+    @property
+    def shape(self):
+        return self.qweight.shape
+
+    @property
+    def dtype(self):
+        return self.qweight.dtype
+
+
+def matmul_maybe_int8(x: jnp.ndarray, w: Any) -> jnp.ndarray:
+    """x @ w with just-in-time dequantization for QuantizedWeight."""
+    if isinstance(w, QuantizedWeight):
+        rows = w.qweight.shape[0]
+        groups = w.scale.shape[0]
+        qw = w.qweight.reshape(groups, rows // groups, -1)
+        deq = (qw.astype(x.dtype) *
+               w.scale.astype(x.dtype)[:, :, None]).reshape(rows, -1)
+        return x @ deq
+    return x @ w.astype(x.dtype)

@@ -24,6 +24,7 @@ from ..parallel.mesh import MODEL_AXIS
 from .activations import bias_gelu, bias_dropout_residual, dropout
 from .flash_attention import flash_attention
 from .normalize import fused_layer_norm
+from .quant import matmul_maybe_int8
 
 
 @dataclass
@@ -48,6 +49,19 @@ class DeepSpeedTransformerConfig:
     causal: bool = False
     block_q: int = 128
     block_k: int = 128
+    # "gelu_new"/"gelu_pytorch_tanh" = tanh approx (the reference kernel's
+    # flavor, gelu_kernels.cu:10); "gelu" = exact erf (HF BERT default)
+    activation: str = "gelu_new"
+
+    @property
+    def gelu_approximate(self) -> bool:
+        if self.activation in ("gelu_new", "gelu_pytorch_tanh",
+                               "gelu_python", "gelu_fast"):
+            return True
+        if self.activation == "gelu":
+            return False
+        raise ValueError(f"unsupported activation {self.activation!r} — "
+                         f"gelu variants only (reference kernel parity)")
 
     def __post_init__(self):
         if self.intermediate_size == -1 and self.hidden_size != -1:
@@ -147,7 +161,7 @@ class DeepSpeedTransformerLayer:
         else:
             attn_in = x
 
-        qkv = attn_in @ params["attn_qkvw"].astype(attn_in.dtype) + \
+        qkv = matmul_maybe_int8(attn_in, params["attn_qkvw"]) + \
             params["attn_qkvb"].astype(attn_in.dtype)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
@@ -160,7 +174,7 @@ class DeepSpeedTransformerLayer:
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h)
         ctx = dropout(ctx, cfg.attn_dropout_ratio, r_attn, deterministic)
 
-        attn_out = ctx @ params["attn_ow"].astype(ctx.dtype)
+        attn_out = matmul_maybe_int8(ctx, params["attn_ow"])
         attn_out = bias_dropout_residual(
             attn_out, params["attn_ob"].astype(attn_out.dtype), residual,
             cfg.hidden_dropout_ratio, r_hid1, deterministic)
@@ -175,9 +189,10 @@ class DeepSpeedTransformerLayer:
             mlp_in = attn_out
             mlp_residual = attn_out
 
-        inter = bias_gelu(mlp_in @ params["inter_w"].astype(mlp_in.dtype),
-                          params["inter_b"].astype(mlp_in.dtype))
-        out = inter @ params["output_w"].astype(inter.dtype)
+        inter = bias_gelu(matmul_maybe_int8(mlp_in, params["inter_w"]),
+                          params["inter_b"].astype(mlp_in.dtype),
+                          approximate=cfg.gelu_approximate)
+        out = matmul_maybe_int8(inter, params["output_w"])
         out = bias_dropout_residual(
             out, params["output_b"].astype(out.dtype), mlp_residual,
             cfg.hidden_dropout_ratio, r_hid2, deterministic)

@@ -1,0 +1,132 @@
+"""Fused inference transformer layer with KV cache.
+
+Reference: deepspeed/ops/transformer/inference/transformer_inference.py
+(DeepSpeedSelfAttentionFunction/DeepSpeedMLPFunction/
+DeepSpeedTransformerInference with `layer_past`), backed by the CUDA
+kernels of csrc/transformer/inference/ (softmax.cu, gelu.cu, normalize.cu,
+dequantize.cu).
+
+TPU-native: prefill runs the training layer's flash path on the full
+prompt and emits the K/V cache; decode is a single-token step whose
+attention reads a static-shape cache updated in place with
+`lax.dynamic_update_slice` (jit-stable: position is a traced scalar, shapes
+never change).  Int8 weights ride as (int8, per-group scale) pairs and are
+dequantized at the matmul (the dequantize.cu role); XLA fuses the
+dequant-multiply into the gemm epilogue.
+
+Weight layout is identical to DeepSpeedTransformerLayer (ops/transformer.py)
+so training checkpoints serve directly.
+"""
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .flash_attention import DEFAULT_MASK_VALUE, flash_attention
+from .normalize import fused_layer_norm
+from .activations import bias_gelu
+from .quant import QuantizedWeight, matmul_maybe_int8
+from .transformer import DeepSpeedTransformerConfig
+
+
+class KVCache(NamedTuple):
+    k: jnp.ndarray  # [B, heads, max_len, head_dim]
+    v: jnp.ndarray
+
+
+def init_kv_cache(batch: int, heads: int, max_len: int, head_dim: int,
+                  dtype=jnp.bfloat16) -> KVCache:
+    shape = (batch, heads, max_len, head_dim)
+    return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+class DeepSpeedTransformerInference:
+    """Inference twin of DeepSpeedTransformerLayer: same params, plus KV
+    cache plumbing (reference transformer_inference.py:647 layer_past)."""
+
+    def __init__(self, config: DeepSpeedTransformerConfig):
+        self.config = config
+
+    # -- shared blocks -------------------------------------------------- #
+    def _attn_proj(self, params, x):
+        cfg = self.config
+        b, s, _ = x.shape
+        qkv = matmul_maybe_int8(x, params["attn_qkvw"]) + \
+            params["attn_qkvb"].astype(x.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def to_heads(t):
+            return t.reshape(b, s, cfg.heads, -1).transpose(0, 2, 1, 3)
+        return to_heads(q), to_heads(k), to_heads(v)
+
+    def _mlp(self, params, x, residual):
+        cfg = self.config
+        mlp_in = fused_layer_norm(x, params["attn_nw"], params["attn_nb"],
+                                  cfg.layer_norm_eps)
+        inter = bias_gelu(matmul_maybe_int8(mlp_in, params["inter_w"]),
+                          params["inter_b"].astype(mlp_in.dtype),
+                          approximate=cfg.gelu_approximate)
+        out = matmul_maybe_int8(inter, params["output_w"]) + \
+            params["output_b"].astype(inter.dtype)
+        return out + residual
+
+    # -- prefill -------------------------------------------------------- #
+    def prefill(self, params, x, cache: KVCache,
+                attn_mask: Optional[jnp.ndarray] = None
+                ) -> Tuple[jnp.ndarray, KVCache]:
+        """Full-prompt forward.  x: [B, S, H]; returns (out, cache) with
+        K/V written at positions [0, S)."""
+        cfg = self.config
+        x = x.astype(cfg.dtype)
+        residual = x
+        attn_in = fused_layer_norm(x, params["norm_w"], params["norm_b"],
+                                   cfg.layer_norm_eps)
+        q, k, v = self._attn_proj(params, attn_in)
+        ctx = flash_attention(q, k, v, causal=cfg.causal, bias=attn_mask,
+                              block_q=cfg.block_q, block_k=cfg.block_k)
+        b, heads, s, d = ctx.shape
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, heads * d)
+        attn_out = matmul_maybe_int8(ctx, params["attn_ow"]) + \
+            params["attn_ob"].astype(ctx.dtype)
+        attn_out = attn_out + residual
+        out = self._mlp(params, attn_out, attn_out)
+        cache = KVCache(
+            jax.lax.dynamic_update_slice(
+                cache.k, k.astype(cache.k.dtype), (0, 0, 0, 0)),
+            jax.lax.dynamic_update_slice(
+                cache.v, v.astype(cache.v.dtype), (0, 0, 0, 0)))
+        return out, cache
+
+    # -- decode --------------------------------------------------------- #
+    def decode(self, params, x, cache: KVCache, pos
+               ) -> Tuple[jnp.ndarray, KVCache]:
+        """One-token step.  x: [B, 1, H]; pos: traced scalar index of this
+        token.  Attention reads cache[0..pos] with a static-shape mask."""
+        cfg = self.config
+        x = x.astype(cfg.dtype)
+        residual = x
+        attn_in = fused_layer_norm(x, params["norm_w"], params["norm_b"],
+                                   cfg.layer_norm_eps)
+        q, k, v = self._attn_proj(params, attn_in)  # [B, heads, 1, d]
+        cache = KVCache(
+            jax.lax.dynamic_update_slice(
+                cache.k, k.astype(cache.k.dtype), (0, 0, pos, 0)),
+            jax.lax.dynamic_update_slice(
+                cache.v, v.astype(cache.v.dtype), (0, 0, pos, 0)))
+        d = q.shape[-1]
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                       cache.k.astype(jnp.float32)) / jnp.sqrt(
+                           jnp.float32(d))
+        max_len = cache.k.shape[2]
+        valid = jnp.arange(max_len) <= pos
+        s = jnp.where(valid[None, None, None, :], s, DEFAULT_MASK_VALUE)
+        p = jax.nn.softmax(s, axis=-1).astype(cache.v.dtype)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", p, cache.v)
+        b, heads, _, _ = ctx.shape
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(b, 1, heads * d)
+        attn_out = matmul_maybe_int8(ctx, params["attn_ow"]) + \
+            params["attn_ob"].astype(ctx.dtype)
+        attn_out = attn_out + residual
+        out = self._mlp(params, attn_out, attn_out)
+        return out, cache

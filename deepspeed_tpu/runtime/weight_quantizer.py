@@ -1,0 +1,94 @@
+"""Int8 weight quantization for inference checkpoints.
+
+Reference: deepspeed/runtime/weight_quantizer.py:5 (WeightQuantization —
+per-group symmetric int8 with fp scales, applied at checkpoint load by the
+inference engine, inference/engine.py:145) and the CUDA dequantizer
+csrc/transformer/inference/csrc/dequantize.cu.
+
+TPU-native: the quantized weight is carried as
+ops.transformer_inference.QuantizedWeight; dequantization happens in the
+matmul epilogue (XLA fusion), so HBM holds int8 while the MXU still sees
+bf16 operands.
+"""
+
+from typing import Any, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.quant import QuantizedWeight
+
+
+def quantize_weight(w, num_groups: int = 1) -> QuantizedWeight:
+    """Symmetric per-group int8 quantization along the first (row) axis."""
+    w = np.asarray(w, dtype=np.float32)
+    if w.ndim != 2:
+        raise ValueError(f"only 2-D weights quantize, got shape {w.shape}")
+    rows = w.shape[0]
+    if rows % num_groups != 0:
+        from ..utils.logging import logger
+        logger.warning(
+            f"quantize groups {num_groups} does not divide {rows} rows — "
+            f"falling back to a single scale group for this weight")
+        num_groups = 1
+    grouped = w.reshape(num_groups, rows // num_groups, -1)
+    scale = np.abs(grouped).max(axis=(1, 2), keepdims=True) / 127.0
+    scale = np.maximum(scale, 1e-12)
+    q = np.clip(np.round(grouped / scale), -127, 127).astype(np.int8)
+    return QuantizedWeight(
+        jnp.asarray(q.reshape(rows, -1)),
+        jnp.asarray(scale.reshape(num_groups, 1).astype(np.float32)))
+
+
+def dequantize_weight(qw: QuantizedWeight) -> jnp.ndarray:
+    rows = qw.qweight.shape[0]
+    groups = qw.scale.shape[0]
+    q = qw.qweight.reshape(groups, rows // groups, -1).astype(jnp.float32)
+    return (q * qw.scale[:, :, None]).reshape(rows, -1)
+
+
+class WeightQuantization:
+    """Quantize the matmul weights of a transformer param tree
+    (reference WeightQuantization.model_quantize)."""
+
+    # the per-layer matmul weights worth quantizing (bias/LN stay fp)
+    LAYER_TARGETS = ("attn_qkvw", "attn_ow", "inter_w", "output_w")
+
+    def __init__(self, mlp_extra_grouping: bool = False,
+                 quantize_groups: int = 1):
+        self.quantize_groups = quantize_groups
+        self.mlp_extra_grouping = mlp_extra_grouping
+        self.quantized_names: List[str] = []
+
+    def _groups_for(self, name: str) -> int:
+        if self.mlp_extra_grouping and name in ("inter_w", "output_w"):
+            return self.quantize_groups * 2
+        return self.quantize_groups
+
+    def quantize_layer_params(self, layer_params: dict) -> dict:
+        out = dict(layer_params)
+        for name in self.LAYER_TARGETS:
+            if name in out:
+                out[name] = quantize_weight(out[name],
+                                            self._groups_for(name))
+                self.quantized_names.append(name)
+        return out
+
+    def quantize_stacked_layers(self, stacked: dict) -> dict:
+        """Quantize a [L, ...]-stacked layer tree (models store layers
+        stacked for lax.scan) — per-layer scales kept along axis 0."""
+        out = dict(stacked)
+        for name in self.LAYER_TARGETS:
+            if name not in out:
+                continue
+            w = np.asarray(out[name], np.float32)
+            qs, ss = [], []
+            for layer_w in w:
+                qw = quantize_weight(layer_w, self._groups_for(name))
+                qs.append(np.asarray(qw.qweight))
+                ss.append(np.asarray(qw.scale))
+            out[name] = QuantizedWeight(jnp.asarray(np.stack(qs)),
+                                        jnp.asarray(np.stack(ss)))
+            self.quantized_names.append(name)
+        return out
