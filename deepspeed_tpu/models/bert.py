@@ -41,6 +41,7 @@ class BertConfig:
     bf16: bool = True
     pre_layer_norm: bool = True      # reference supports both (preln/postln)
     activation_checkpointing: bool = False
+    sparse_attention: Optional[object] = None  # a SparsityConfig
     ignore_index: int = -100
 
     def __post_init__(self):
@@ -65,6 +66,7 @@ class BertConfig:
             pre_layer_norm=self.pre_layer_norm,
             causal=False,
             activation=self.hidden_act,
+            sparsity_config=self.sparse_attention,
         )
 
     def num_params(self, include_embeddings: bool = True) -> int:

@@ -42,6 +42,7 @@ class GPT2Config:
     initializer_range: float = 0.02
     bf16: bool = True
     activation_checkpointing: bool = False
+    sparse_attention: Optional[object] = None  # a SparsityConfig
     tie_word_embeddings: bool = True
 
     def __post_init__(self):
@@ -65,6 +66,7 @@ class GPT2Config:
             bf16=self.bf16,
             pre_layer_norm=True,
             causal=True,
+            sparsity_config=self.sparse_attention,
         )
 
     def num_params(self, include_embeddings: bool = True) -> int:
@@ -147,48 +149,73 @@ class GPT2Model:
         return (h @ head).astype(jnp.float32)
 
     def hidden_states(self, params, input_ids, rng=None,
-                      deterministic: bool = False):
+                      deterministic: bool = False, pld_theta=None):
         """input_ids [B, S] -> pre-head hidden states [B, S, H] (the final
-        LN lives in head_logits so the KV-cache decode path shares it)."""
+        LN lives in head_logits so the KV-cache decode path shares it).
+
+        pld_theta: progressive-layer-drop keep probability theta(t)
+        (reference: runtime/progressive_layer_drop.py injected via
+        engine.py:1236).  Layer i keeps its residual branch with
+        p_i = 1 - (i/L)(1 - theta) — deeper layers drop more (PLD paper's
+        depth schedule) — gated per step inside the scan."""
         cfg = self.config
         if rng is None:
             deterministic = True
             rng = jax.random.PRNGKey(0)
-        r_embd, r_layers = jax.random.split(rng)
+        r_embd, r_layers, r_pld = jax.random.split(rng, 3)
 
         h = self.embed(params, input_ids)
         h = dropout(h, cfg.embd_dropout, r_embd, deterministic)
 
         layer_fn = self.layer
+        use_pld = pld_theta is not None and not deterministic
+        n = cfg.num_layers
+        if use_pld:
+            keep_probs = 1.0 - (jnp.arange(n, dtype=jnp.float32) / n) * \
+                (1.0 - jnp.float32(pld_theta))
+            pld_keys = jax.random.split(r_pld, n)
 
         def body(carry, xs):
-            layer_params, layer_rng = xs
+            if use_pld:
+                layer_params, layer_rng, keep_p, pld_key = xs
+            else:
+                layer_params, layer_rng = xs
             out = layer_fn(layer_params, carry, rng=layer_rng,
                            deterministic=deterministic)
+            if use_pld:
+                keep = jax.random.bernoulli(pld_key, keep_p)
+                out = jnp.where(keep, out, carry)
             return out, None
 
         if cfg.activation_checkpointing:
             body = jax.checkpoint(body)
 
-        layer_rngs = jax.random.split(r_layers, cfg.num_layers)
-        h, _ = jax.lax.scan(body, h, (params["h"], layer_rngs))
+        layer_rngs = jax.random.split(r_layers, n)
+        xs = ((params["h"], layer_rngs, keep_probs, pld_keys) if use_pld
+              else (params["h"], layer_rngs))
+        h, _ = jax.lax.scan(body, h, xs)
         return h
 
-    def logits(self, params, input_ids, rng=None, deterministic=False):
-        h = self.hidden_states(params, input_ids, rng, deterministic)
+    def logits(self, params, input_ids, rng=None, deterministic=False,
+               pld_theta=None):
+        h = self.hidden_states(params, input_ids, rng, deterministic,
+                               pld_theta)
         return self.head_logits(params, h)
 
-    def loss(self, params, rng, input_ids, labels=None):
+    def loss(self, params, rng, input_ids, labels=None, pld_theta=None):
         """Next-token cross entropy (fp32 softmax).  When labels is None,
-        input_ids[:, 1:] serve as targets."""
+        input_ids[:, 1:] serve as targets; the model runs on the FULL
+        sequence and the last logit column is dropped (keeps the attention
+        length unchanged, e.g. divisible by a sparse-attention block)."""
+        logits = self.logits(params, input_ids, rng,
+                             deterministic=rng is None,
+                             pld_theta=pld_theta).astype(jnp.float32)
         if labels is None:
             labels = input_ids[:, 1:]
-            input_ids = input_ids[:, :-1]
-        logits = self.logits(params, input_ids, rng,
-                             deterministic=rng is None).astype(jnp.float32)
+            logits = logits[:, :-1]
         return optax.softmax_cross_entropy_with_integer_labels(
             logits, labels).mean()
 
     # engine entry point: model(params, rng, batch...) -> loss
-    def __call__(self, params, rng, input_ids, labels=None):
-        return self.loss(params, rng, input_ids, labels)
+    def __call__(self, params, rng, input_ids, labels=None, pld_theta=None):
+        return self.loss(params, rng, input_ids, labels, pld_theta)

@@ -1,0 +1,8 @@
+from .sparsity_config import (BigBirdSparsityConfig,
+                              BSLongformerSparsityConfig,
+                              DenseSparsityConfig, FixedSparsityConfig,
+                              SparsityConfig, VariableSparsityConfig)
+from .sparse_self_attention import (SparseSelfAttention,
+                                    layout_to_gather_indices)
+from .sparse_attention_utils import (pad_to_block_size,
+                                     unpad_sequence_output)

@@ -1,0 +1,41 @@
+"""Sparse-attention integration helpers.
+
+Reference: deepspeed/ops/sparse_attention/sparse_attention_utils.py:225 —
+pad/unpad sequences to the block size and wire SparseSelfAttention into
+BERT-style models (bert_sparse_self_attention.py:78).
+
+TPU integration point: DeepSpeedTransformerConfig.sparsity_config makes
+DeepSpeedTransformerLayer route its attention through SparseSelfAttention
+(ops/transformer.py), so any model built on the layer — BertModel,
+GPT2Model — becomes block-sparse by config alone.
+"""
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from .sparse_self_attention import SparseSelfAttention
+from .sparsity_config import SparsityConfig
+
+
+def pad_to_block_size(block: int, input_ids, pad_token_id: int,
+                      attention_mask=None):
+    """Right-pad [B, S] ids (and mask) so S divides the block size; returns
+    (pad_len, ids, mask) like the reference's pad_to_block_size."""
+    seq_len = input_ids.shape[1]
+    pad_len = (block - seq_len % block) % block
+    if pad_len == 0:
+        return 0, input_ids, attention_mask
+    ids = jnp.pad(input_ids, ((0, 0), (0, pad_len)),
+                  constant_values=pad_token_id)
+    if attention_mask is not None:
+        attention_mask = jnp.pad(attention_mask, ((0, 0), (0, pad_len)),
+                                 constant_values=0)
+    return pad_len, ids, attention_mask
+
+
+def unpad_sequence_output(pad_len: int, sequence_output):
+    """Drop the padding added by pad_to_block_size."""
+    if pad_len == 0:
+        return sequence_output
+    return sequence_output[:, :-pad_len]

@@ -1,0 +1,128 @@
+"""Block-sparse self-attention over a SparsityConfig layout.
+
+Reference: deepspeed/ops/sparse_attention/sparse_self_attention.py:14
+(QK^T -> masked block softmax -> ·V over the layout) built on Triton
+block-sparse SDD/DSD/DDS matmuls (matmul.py:749) and block softmax
+(softmax.py:315).
+
+TPU-native: the layout is static at trace time, so it compiles into gather
+indices — for every (head, q-block) the set of allowed k-blocks, padded to
+the layout's max degree.  Attention then runs as dense einsums over the
+gathered [max_deg * block] keys: compute and memory are O(S · w) like the
+Triton kernels (w = max_deg · block), but everything is static-shape XLA
+that tiles straight onto the MXU; no scalar-indexed DMA needed.  Rows pad
+with `valid=False` entries masked to DEFAULT_MASK_VALUE before the fp32
+softmax.
+"""
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..flash_attention import DEFAULT_MASK_VALUE
+from .sparsity_config import SparsityConfig
+
+
+def layout_to_gather_indices(layout: np.ndarray
+                             ) -> Tuple[np.ndarray, np.ndarray]:
+    """[H, nb, nb] bool -> (idx [H, nb, max_deg] int32, valid bool).
+
+    idx[h, i, j] is the j-th allowed k-block of q-block i (padded with 0
+    where valid is False)."""
+    h, nb, _ = layout.shape
+    degrees = layout.sum(-1)
+    if (degrees == 0).any():
+        raise ValueError("layout has a query block with no allowed k-blocks")
+    max_deg = int(degrees.max())
+    idx = np.zeros((h, nb, max_deg), np.int32)
+    valid = np.zeros((h, nb, max_deg), bool)
+    for hh in range(h):
+        for i in range(nb):
+            cols = np.nonzero(layout[hh, i])[0]
+            idx[hh, i, :len(cols)] = cols
+            valid[hh, i, :len(cols)] = True
+    return idx, valid
+
+
+@functools.partial(jax.jit, static_argnames=("block", "causal", "sm_scale"))
+def _sparse_attention_impl(q, k, v, idx, valid, block: int,
+                           causal: bool, sm_scale: Optional[float]):
+    b, h, s, d = q.shape
+    nb = s // block
+    max_deg = idx.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+
+    qb = q.reshape(b, h, nb, block, d)
+    kb = k.reshape(b, h, nb, block, d)
+    vb = v.reshape(b, h, nb, block, d)
+    heads = jnp.arange(h)[:, None, None]
+    kg = kb[:, heads, idx]                    # [B, H, nb, max_deg, block, d]
+    vg = vb[:, heads, idx]
+
+    scores = jnp.einsum("bhiqd,bhijkd->bhiqjk", qb.astype(jnp.float32),
+                        kg.astype(jnp.float32),
+                        preferred_element_type=jnp.float32) * scale
+
+    mask = valid[:, :, None, :, None]         # [H, nb, 1, max_deg, 1]
+    if causal:
+        q_pos = (jnp.arange(nb)[:, None] * block +
+                 jnp.arange(block)[None, :])             # [nb, block]
+        k_pos = (idx[..., None] * block +
+                 jnp.arange(block))                      # [H, nb, deg, blk]
+        causal_ok = (k_pos[:, :, None, :, :] <=
+                     q_pos[None, :, :, None, None])      # [H,nb,blk,deg,blk]
+        mask = mask & causal_ok
+    mask = jnp.broadcast_to(mask, (h, nb, block, max_deg, block))
+    scores = jnp.where(mask[None], scores, DEFAULT_MASK_VALUE)
+
+    flat = scores.reshape(b, h, nb, block, max_deg * block)
+    m = jnp.max(flat, axis=-1, keepdims=True)
+    p = jnp.exp(flat - m)
+    p = p * mask.reshape(1, h, nb, block, max_deg * block)
+    l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    p = (p / l).reshape(b, h, nb, block, max_deg, block)
+
+    out = jnp.einsum("bhiqjk,bhijkd->bhiqd", p.astype(v.dtype), vg)
+    return out.reshape(b, h, s, d)
+
+
+class SparseSelfAttention:
+    """Layout-driven attention module (reference:
+    sparse_self_attention.py:14).  Layout/gather indices are cached per
+    sequence length."""
+
+    def __init__(self, sparsity_config: SparsityConfig,
+                 attn_mask_mode: str = "add"):
+        self.sparsity_config = sparsity_config
+        self.attn_mask_mode = attn_mask_mode
+        self._cache = {}
+
+    def layout_for(self, seq_len: int):
+        if seq_len not in self._cache:
+            layout = self.sparsity_config.make_layout(seq_len)
+            idx, valid = layout_to_gather_indices(layout)
+            self._cache[seq_len] = (layout, jnp.asarray(idx),
+                                    jnp.asarray(valid))
+        return self._cache[seq_len]
+
+    def density(self, seq_len: int) -> float:
+        layout, _, _ = self.layout_for(seq_len)
+        return float(layout.mean())
+
+    def __call__(self, q, k, v, causal: bool = False,
+                 sm_scale: Optional[float] = None):
+        """q, k, v: [B, H, S, D] -> [B, H, S, D]."""
+        s = q.shape[2]
+        block = self.sparsity_config.block
+        _, idx, valid = self.layout_for(s)
+        if q.shape[1] != self.sparsity_config.num_heads:
+            raise ValueError(
+                f"q has {q.shape[1]} heads, layout built for "
+                f"{self.sparsity_config.num_heads}")
+        return _sparse_attention_impl(q, k, v, idx, valid, block, causal,
+                                      sm_scale)

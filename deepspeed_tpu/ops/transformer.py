@@ -52,6 +52,10 @@ class DeepSpeedTransformerConfig:
     # "gelu_new"/"gelu_pytorch_tanh" = tanh approx (the reference kernel's
     # flavor, gelu_kernels.cu:10); "gelu" = exact erf (HF BERT default)
     activation: str = "gelu_new"
+    # block-sparse attention: a SparsityConfig routes the layer's attention
+    # through SparseSelfAttention (the reference wires this via
+    # bert_sparse_self_attention.py:78; here it's one config field)
+    sparsity_config: Optional[object] = None
 
     @property
     def gelu_approximate(self) -> bool:
@@ -87,6 +91,10 @@ class DeepSpeedTransformerLayer:
 
     def __init__(self, config: DeepSpeedTransformerConfig):
         self.config = config
+        self._sparse_attn = None
+        if config.sparsity_config is not None:
+            from .sparse_attention import SparseSelfAttention
+            self._sparse_attn = SparseSelfAttention(config.sparsity_config)
 
     # -- parameters ---------------------------------------------------- #
     def init_params(self, rng):
@@ -169,8 +177,15 @@ class DeepSpeedTransformerLayer:
             return t.reshape(b, s, heads, d).transpose(0, 2, 1, 3)
 
         q, k, v = to_heads(q), to_heads(k), to_heads(v)
-        ctx = flash_attention(q, k, v, causal=cfg.causal, bias=attn_mask,
-                              block_q=cfg.block_q, block_k=cfg.block_k)
+        if self._sparse_attn is not None:
+            if attn_mask is not None:
+                raise NotImplementedError(
+                    "sparse attention with an additive attn_mask is not "
+                    "supported — fold padding into the layout instead")
+            ctx = self._sparse_attn(q, k, v, causal=cfg.causal)
+        else:
+            ctx = flash_attention(q, k, v, causal=cfg.causal, bias=attn_mask,
+                                  block_q=cfg.block_q, block_k=cfg.block_k)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h)
         ctx = dropout(ctx, cfg.attn_dropout_ratio, r_attn, deterministic)
 

@@ -1,0 +1,3 @@
+from .checkpointing import (CheckpointFunction, checkpoint, configure,
+                            get_partition_policy, is_configured,
+                            model_parallel_rng, reset)

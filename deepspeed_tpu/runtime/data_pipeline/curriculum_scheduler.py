@@ -1,0 +1,87 @@
+"""Curriculum learning scheduler (sequence-length curriculum).
+
+Reference: deepspeed/runtime/data_pipeline/curriculum_scheduler.py:8 —
+difficulty (seqlen) grows from min to max by a fixed_linear / fixed_root /
+fixed_discrete schedule; the engine injects `curriculum_seqlen` into the
+model forward (engine.py:1239-1245).  TPU note: difficulty steps are
+rounded to `difficulty_step` multiples to keep shapes bucketed (8-multiples
+recommended on GPU for Tensor Cores — reference docstring; 128-multiples
+are the natural TPU lane width).
+"""
+
+import math
+from typing import Any, Dict
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+
+
+class CurriculumScheduler:
+    def __init__(self, config: Dict[str, Any]):
+        self.state = {}
+        assert "curriculum_type" in config, \
+            "curriculum learning requires curriculum_type"
+        assert "min_difficulty" in config and "max_difficulty" in config
+        ctype = config["curriculum_type"]
+        self.state["schedule_type"] = ctype
+        self.state["min_difficulty"] = config["min_difficulty"]
+        self.state["max_difficulty"] = config["max_difficulty"]
+        self.state["current_difficulty"] = config["min_difficulty"]
+        sched = config.get("schedule_config", {})
+        if ctype in (FIXED_LINEAR, FIXED_ROOT):
+            assert "total_curriculum_step" in sched
+            self.state["total_curriculum_step"] = \
+                sched["total_curriculum_step"]
+            self.state["difficulty_step"] = sched.get("difficulty_step", 8)
+            if self.state["difficulty_step"] % 8 != 0:
+                # reference warns for Tensor Cores; TPU lanes want 128
+                pass
+            self.state["root_degree"] = sched.get(
+                "root_degree", 1 if ctype == FIXED_LINEAR else 2)
+        elif ctype == FIXED_DISCRETE:
+            assert "difficulty" in sched and "max_step" in sched
+            assert len(sched["difficulty"]) == len(sched["max_step"]) + 1
+            self.state["difficulty"] = sched["difficulty"]
+            self.state["max_step"] = sched["max_step"]
+        else:
+            raise ValueError(f"unknown curriculum_type {ctype!r}")
+
+    # ------------------------------------------------------------------ #
+    def _fixed_root_difficulty(self, global_steps: int) -> int:
+        s = self.state
+        frac = min(1.0, global_steps / s["total_curriculum_step"])
+        frac = frac ** (1.0 / s["root_degree"])
+        diff = s["min_difficulty"] + frac * (
+            s["max_difficulty"] - s["min_difficulty"])
+        step = s["difficulty_step"]
+        diff = int(diff / step) * step
+        return max(s["min_difficulty"], min(s["max_difficulty"], diff))
+
+    def _fixed_discrete_difficulty(self, global_steps: int) -> int:
+        s = self.state
+        for diff, until in zip(s["difficulty"], s["max_step"]):
+            if global_steps <= until:
+                return diff
+        return s["difficulty"][-1]
+
+    def update_difficulty(self, global_steps: int) -> int:
+        if self.state["schedule_type"] in (FIXED_LINEAR, FIXED_ROOT):
+            cur = self._fixed_root_difficulty(global_steps)
+        else:
+            cur = self._fixed_discrete_difficulty(global_steps)
+        self.state["current_difficulty"] = cur
+        return cur
+
+    def get_current_difficulty(self) -> int:
+        return self.state["current_difficulty"]
+
+    def get_difficulty(self, global_steps: int) -> int:
+        return self.update_difficulty(global_steps)
+
+    # -- checkpoint ----------------------------------------------------- #
+    def state_dict(self) -> Dict[str, Any]:
+        return dict(self.state)
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.state.update(sd)

@@ -229,6 +229,26 @@ class DeepSpeedEngine:
             training_data, collate_fn)
         self._rng = rng if rng is not None else jax.random.PRNGKey(42)
 
+        # ---- training-dynamics subsystems ---------------------------- #
+        # PLD (reference engine.py:1236,1487), curriculum seqlen
+        # (engine.py:1239-1245), MoQ post-step quantization
+        # (engine.py:1427-1434).
+        self.progressive_layer_drop = None
+        if self.config.pld_config.enabled:
+            from .progressive_layer_drop import ProgressiveLayerDrop
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=self.config.pld_config.theta,
+                gamma=self.config.pld_config.gamma)
+        self.curriculum_scheduler = None
+        if self.config.curriculum_config.enabled:
+            from .data_pipeline import CurriculumScheduler
+            self.curriculum_scheduler = CurriculumScheduler(
+                self.config.curriculum_config.params)
+        self.quantizer = None
+        if self.config.quantize_training_enabled:
+            from .quantize import Quantizer
+            self.quantizer = Quantizer(self.config.quantize_training_config)
+
         # ---- bookkeeping --------------------------------------------- #
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
@@ -307,6 +327,20 @@ class DeepSpeedEngine:
                   if getattr(x, "dtype", None) == jnp.int32 and
                   getattr(x, "ndim", None) == 0]
         return int(counts[0]) if counts else self.global_steps
+
+    def pld_enabled(self) -> bool:
+        return self.progressive_layer_drop is not None
+
+    def pld_theta(self) -> float:
+        return (self.progressive_layer_drop.get_theta()
+                if self.progressive_layer_drop is not None else 1.0)
+
+    def curriculum_enabled(self) -> bool:
+        return self.curriculum_scheduler is not None
+
+    def curriculum_seqlen(self) -> Optional[int]:
+        return (self.curriculum_scheduler.get_current_difficulty()
+                if self.curriculum_scheduler is not None else None)
 
     def is_gradient_accumulation_boundary(self) -> bool:
         return self.micro_steps % self.gradient_accumulation_steps() == 0
@@ -517,6 +551,32 @@ class DeepSpeedEngine:
             self.timers(FORWARD_MICRO_TIMER).start()
         if self._is_train_mode:
             self.tput_timer.start()
+        if self.curriculum_scheduler is not None:
+            # Truncate every sequence-sized axis to the current difficulty
+            # (reference: engine.py:1239-1245 curriculum_seqlen injection).
+            # "Sequence-sized" = equal to the batch's full sequence length,
+            # so labels [B,S] and masks [B,1,1,S]/[B,1,S,S] shrink together.
+            seqlen = self.curriculum_scheduler.update_difficulty(
+                self.global_steps + 1)
+            arrays = [a for a in jax.tree.leaves((args, kwargs))
+                      if getattr(a, "ndim", 0) >= 2]
+            full_len = max((a.shape[1] for a in arrays), default=0)
+
+            def _trunc(a):
+                if getattr(a, "ndim", 0) < 2 or full_len <= seqlen:
+                    return a
+                sl = tuple(
+                    slice(0, seqlen) if ax >= 1 and a.shape[ax] == full_len
+                    else slice(None) for ax in range(a.ndim))
+                return a[sl]
+            args, kwargs = jax.tree.map(_trunc, (args, kwargs))
+        if self.progressive_layer_drop is not None and self._is_train_mode:
+            # Inject theta into the model forward (reference engine.py:1236
+            # kwargs.update(pld.get_state())); models supporting PLD accept
+            # a pld_theta kwarg (GPT2Model stochastic depth).
+            kwargs = dict(kwargs)
+            kwargs["pld_theta"] = jnp.float32(
+                self.progressive_layer_drop.get_theta())
         batch = self._shard_batch((args, kwargs))
         args, kwargs = batch
         loss, grads = self._grad_fn(self.params, self.scaler_state,
@@ -566,18 +626,30 @@ class DeepSpeedEngine:
         self._grad_acc = None
         self._last_overflow = overflow
         self.global_steps += 1
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self.global_steps)
         # fp16 dynamic scaling: fetch the overflow flag (the reference's
         # overflow check is a blocking allreduce anyway — stage2.py:1801) so
         # skipped_steps and the python-side scheduler stay faithful.  bf16/
         # fp32 paths keep fully-async dispatch: overflow is (near-)impossible
         # and the on-device cond still protects the weights.
+        step_skipped = False
         if self.scaler_cfg.dynamic:
             if bool(overflow):
+                step_skipped = True
                 self.skipped_steps += 1
             elif self.lr_scheduler is not None:
                 self.lr_scheduler.step(**(lr_kwargs or {}))
         elif self.lr_scheduler is not None:
             self.lr_scheduler.step(**(lr_kwargs or {}))
+        if self.quantizer is not None and not step_skipped:
+            # MoQ post-step fake-quantization (reference engine.py:1427):
+            # compiled with the params' own shardings so no resharding or
+            # host sync sneaks in.
+            bits = self.quantizer.update_bits(self.global_steps)
+            if bits < 16:
+                self.params = self._quantize_fn(bits)(
+                    self.params, self._next_rng())
         self.tput_timer.stop(global_step=True)
 
         if self.global_steps % self.steps_per_print() == 0:
@@ -596,6 +668,19 @@ class DeepSpeedEngine:
                                             self.global_steps)
         if self.wall_clock_breakdown():
             self.timers(STEP_MICRO_TIMER).stop()
+
+    def _quantize_fn(self, bits: int):
+        """Per-bit-width compiled fake-quantization preserving the engine's
+        param shardings (donated in, same sharding out)."""
+        cache = getattr(self, "_quantize_fn_cache", None)
+        if cache is None:
+            cache = self._quantize_fn_cache = {}
+        if bits not in cache:
+            qz = self.quantizer
+            cache[bits] = jax.jit(
+                lambda p, rng: qz.apply_tree(p, bits, rng),
+                out_shardings=self.param_shardings, donate_argnums=(0,))
+        return cache[bits]
 
     def _offload_step(self) -> bool:
         """Host-side optimizer step (ZeRO-Offload/-Infinity path)."""
@@ -682,6 +767,11 @@ class DeepSpeedEngine:
                                 self.train_micro_batch_size_per_gpu(),
                                 self.gradient_accumulation_steps()],
             "dp_world_size": self.world_size,
+            "quantizer": (self.quantizer.state_dict()
+                          if self.quantizer is not None else None),
+            "curriculum": (self.curriculum_scheduler.state_dict()
+                           if self.curriculum_scheduler is not None
+                           else None),
         })
         path = ckpt_mod.save_checkpoint_state(
             save_dir, tag, module_state={"module": self.params},
@@ -718,6 +808,12 @@ class DeepSpeedEngine:
             self.global_steps = client.get("global_steps", 0)
             self.micro_steps = client.get("micro_steps", 0)
             self.skipped_steps = client.get("skipped_steps", 0)
+            if self.quantizer is not None and client.get("quantizer"):
+                self.quantizer.load_state_dict(client["quantizer"])
+            if self.curriculum_scheduler is not None and client.get(
+                    "curriculum"):
+                self.curriculum_scheduler.load_state_dict(
+                    client["curriculum"])
         load_path = os.path.join(load_dir, str(
             tag or ckpt_mod.read_latest_tag(load_dir)))
         log_dist(f"loaded checkpoint {load_path}", ranks=[0])

@@ -1,0 +1,36 @@
+"""Progressive Layer Drop (PLD).
+
+Reference: deepspeed/runtime/progressive_layer_drop.py:5 — keep probability
+theta(t) = (1 - theta) * exp(-gamma * t) + theta decays toward `theta`;
+the engine computes the current value each step and passes it into the
+model forward as `progressive_layer_drop` kwargs (engine.py:1236, 1487).
+
+Model side: a scan-based transformer stack applies stochastic depth with
+per-layer keep probability p_i = 1 - (i/L) * (1 - theta(t)) (deeper layers
+drop more), gating each layer's residual branch on a bernoulli draw —
+exactly expressible inside lax.scan with a per-layer key.
+"""
+
+import math
+from typing import Dict
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_state(self) -> Dict[str, object]:
+        return {"progressive_layer_drop": True,
+                "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        def _prob(x):
+            return (1.0 - self.theta) * math.exp(-self.gamma * x) + \
+                self.theta
+        self.current_theta = _prob(global_step)
+        return self.current_theta

@@ -1,0 +1,127 @@
+"""Quantization-aware training (MoQ — Mixture of Quantization).
+
+Reference: deepspeed/runtime/quantize.py:12 (Quantizer: target/start bits,
+quantize_period doubling, symmetric/asymmetric, stochastic rounding via the
+CUDA quantizer kernel csrc/quantization/quantizer.cu), applied after each
+optimizer step (runtime/engine.py:1427-1434), optionally schedule-driven by
+eigenvalue curvature (runtime/eigenvalue.py feeding engine.py:1478-1485).
+
+TPU-native: fake-quantization (quantize→dequantize) is pure jnp — XLA fuses
+it into the post-step param update; stochastic rounding uses the counter-
+based JAX PRNG instead of curand.  Config comes from the existing
+DeepSpeedConfig "quantize_training" section (config.py QuantizeConfig).
+"""
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import log_dist
+
+
+def quantize_dequantize(x, bits: int, groups: int = 1,
+                        symmetric: bool = True,
+                        stochastic_round: bool = False, rng=None):
+    """Fake-quantize x to `bits` with per-group scales (group = equal slices
+    of the flattened tensor, the reference kernel's group-wise layout)."""
+    orig_shape, orig_dtype = x.shape, x.dtype
+    x32 = x.astype(jnp.float32).reshape(-1)
+    if x32.size % groups != 0:
+        groups = 1
+    flat = x32.reshape(groups, -1)
+    qmax = float(2 ** (bits - 1) - 1)
+    if symmetric:
+        scale = jnp.maximum(jnp.abs(flat).max(axis=1, keepdims=True),
+                            1e-12) / qmax
+        zero = 0.0
+        q = flat / scale
+    else:
+        lo = flat.min(axis=1, keepdims=True)
+        hi = flat.max(axis=1, keepdims=True)
+        scale = jnp.maximum(hi - lo, 1e-12) / (2 * qmax)
+        zero = lo
+        q = (flat - zero) / scale
+    if stochastic_round:
+        if rng is None:
+            raise ValueError("stochastic rounding needs an rng")
+        q = jnp.floor(q + jax.random.uniform(rng, q.shape))
+    else:
+        q = jnp.round(q)
+    if symmetric:
+        out = jnp.clip(q, -qmax, qmax) * scale
+    else:
+        out = jnp.clip(q, 0, 2 * qmax) * scale + zero
+    return out.reshape(orig_shape).astype(orig_dtype)
+
+
+class Quantizer:
+    """Gradual precision decrease during training (reference Quantizer:12):
+    current bits halve from start_bits toward target_bits every
+    `quantize_period` steps after `schedule_offset`, the period doubling at
+    each drop (reference's quantize_period *= 2).
+
+    config: the DeepSpeedConfig QuantizeConfig section (config.py:422)."""
+
+    def __init__(self, config):
+        self.config = config
+        self.cur_bits = int(config.start_bits)
+        self.period = int(config.quantize_period)
+        self.offset = int(getattr(config, "schedule_offset", 0))
+        self.last_drop_step = self.offset
+        self.symmetric = int(getattr(config, "quantize_type", 0)) == 0
+        self.stochastic = int(getattr(config, "rounding", 0)) == 1
+
+    def update_bits(self, step: int) -> int:
+        cfg = self.config
+        if step < self.offset:
+            return self.cur_bits
+        if (self.cur_bits > cfg.target_bits and
+                step - self.last_drop_step >= self.period):
+            self.cur_bits = max(self.cur_bits // 2, int(cfg.target_bits))
+            self.last_drop_step = step
+            self.period *= 2
+            if cfg.quantize_verbose:
+                log_dist(f"MoQ: step {step} -> {self.cur_bits} bits",
+                         ranks=[0])
+        return self.cur_bits
+
+    def apply_tree(self, params: Any, bits: int,
+                   rng: Optional[jax.Array] = None) -> Any:
+        """Pure fake-quantization of every 2D+ float leaf (embedding/matmul
+        weights); biases/LN stay fp, like the reference's kernel targets.
+        jit-friendly: `bits` is static, call under jax.jit with the engine's
+        param out_shardings."""
+        cfg = self.config
+        flat, treedef = jax.tree_util.tree_flatten(params)
+        keys = (jax.random.split(rng, len(flat))
+                if (self.stochastic and rng is not None) else [None] * len(
+                    flat))
+        out = []
+        for leaf, key in zip(flat, keys):
+            arr = jnp.asarray(leaf)
+            if arr.ndim < 2 or not jnp.issubdtype(arr.dtype, jnp.floating):
+                out.append(leaf)
+                continue
+            out.append(quantize_dequantize(
+                arr, bits, int(cfg.quantize_groups), self.symmetric,
+                self.stochastic, key))
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def quantize_params(self, params: Any, step: int,
+                        rng: Optional[jax.Array] = None) -> Any:
+        """Schedule update + fake-quantize (un-jitted convenience path)."""
+        bits = self.update_bits(step)
+        if bits >= 16:
+            return params
+        return self.apply_tree(params, bits, rng)
+
+    # -- checkpoint: the annealing trajectory must survive resume -------- #
+    def state_dict(self):
+        return {"cur_bits": self.cur_bits, "period": self.period,
+                "last_drop_step": self.last_drop_step}
+
+    def load_state_dict(self, sd):
+        self.cur_bits = int(sd["cur_bits"])
+        self.period = int(sd["period"])
+        self.last_drop_step = int(sd["last_drop_step"])

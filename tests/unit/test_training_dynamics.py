@@ -1,0 +1,273 @@
+"""MoQ quantizer, eigenvalue, curriculum, PLD, CSR, activation
+checkpointing (reference tests: test_lr_schedulers/test_pld-style unit
+coverage; activation ckpt equivalence mirrors
+test_activation_checkpointing.py:289)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.runtime.csr_tensor import CSRTensor
+from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler
+from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+from deepspeed_tpu.runtime.quantize import quantize_dequantize
+from deepspeed_tpu.runtime import activation_checkpointing as ac
+
+
+# ---------------------------------------------------------------------- #
+# quantize
+# ---------------------------------------------------------------------- #
+def test_quantize_dequantize_error_bounded():
+    x = jax.random.normal(jax.random.PRNGKey(0), (64, 64))
+    for bits in (8, 4):
+        q = quantize_dequantize(x, bits, groups=4)
+        step = (2 * float(jnp.abs(x).max())) / (2 ** bits - 2)
+        assert float(jnp.abs(q - x).max()) <= step
+
+    asym = quantize_dequantize(x, 8, groups=2, symmetric=False)
+    assert float(jnp.abs(asym - x).max()) < 0.05
+
+
+def test_quantizer_schedule():
+    cfg = ds.DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 1,
+        "quantize_training": {
+            "enabled": True,
+            "quantize_schedule": {
+                "quantize_period": 10,
+                "schedule_offset": 0},
+            "quantize_groups": 2,
+            "quantize_bits": {"start_bits": 16, "target_bits": 4},
+            "quantize_verbose": False,
+        },
+    })
+    from deepspeed_tpu.runtime.quantize import Quantizer
+    qz = Quantizer(cfg.quantize_training_config)
+    bits = [qz.update_bits(s) for s in range(0, 80, 5)]
+    assert bits[0] == 16
+    assert min(bits) == 4
+    assert sorted(set(bits), reverse=True) == [16, 8, 4]
+
+
+def test_engine_moq_integration():
+    ds.reset_mesh_context()
+    mesh = ds.initialize_mesh(data=-1)
+
+    def model(params, rng, x, y):
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    params = {"w": np.random.RandomState(0).randn(8, 4).astype(np.float32)}
+    cfg = {
+        "train_micro_batch_size_per_gpu": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "quantize_training": {
+            "enabled": True,
+            "quantize_schedule": {"quantize_period": 1,
+                                  "schedule_offset": 0},
+            "quantize_bits": {"start_bits": 8, "target_bits": 8},
+        },
+        "steps_per_print": 10 ** 9,
+    }
+    eng, _, _, _ = ds.initialize(model=model, config=cfg,
+                                 model_parameters=params, mesh=mesh)
+    assert eng.quantizer is not None
+    rs = np.random.RandomState(1)
+    x, y = rs.randn(8, 8).astype(np.float32), rs.randn(8, 4).astype(
+        np.float32)
+    for _ in range(3):
+        loss = eng.forward(x, y); eng.backward(loss); eng.step()
+    # post-step weights live on an 8-bit grid
+    w = np.asarray(eng.params["w"], np.float64)
+    scale = np.abs(w).max() / 127.0
+    np.testing.assert_allclose(w / scale, np.round(w / scale), atol=1e-3)
+
+
+# ---------------------------------------------------------------------- #
+# eigenvalue
+# ---------------------------------------------------------------------- #
+def test_eigenvalue_quadratic():
+    """For loss = x^T A x / 2, the Hessian is A — power iteration must find
+    its dominant eigenvalue."""
+    evals = np.array([5.0, 2.0, 1.0], np.float32)
+    a = np.diag(evals)
+
+    def loss(params):
+        x = params["x"]
+        return 0.5 * x @ jnp.asarray(a) @ x
+
+    est, vec = Eigenvalue(max_iter=50, tol=1e-4).compute_eigenvalue(
+        loss, {"x": jnp.ones((3,), jnp.float32)}, jax.random.PRNGKey(0))
+    assert abs(est - 5.0) < 0.1
+    v = np.abs(np.asarray(vec["x"]))
+    assert v[0] > 0.99  # eigenvector along the dominant axis
+
+
+# ---------------------------------------------------------------------- #
+# curriculum
+# ---------------------------------------------------------------------- #
+def test_curriculum_fixed_linear():
+    sch = CurriculumScheduler({
+        "curriculum_type": "fixed_linear",
+        "min_difficulty": 8, "max_difficulty": 64,
+        "schedule_config": {"total_curriculum_step": 100,
+                            "difficulty_step": 8}})
+    assert sch.update_difficulty(0) == 8
+    assert sch.update_difficulty(50) == 32
+    assert sch.update_difficulty(100) == 64
+    assert sch.update_difficulty(500) == 64
+
+
+def test_curriculum_fixed_discrete():
+    sch = CurriculumScheduler({
+        "curriculum_type": "fixed_discrete",
+        "min_difficulty": 4, "max_difficulty": 16,
+        "schedule_config": {"difficulty": [4, 8, 16],
+                            "max_step": [10, 20]}})
+    assert sch.update_difficulty(5) == 4
+    assert sch.update_difficulty(15) == 8
+    assert sch.update_difficulty(25) == 16
+
+
+def test_engine_curriculum_truncates():
+    ds.reset_mesh_context()
+    mesh = ds.initialize_mesh(data=-1)
+    seen = []
+
+    def model(params, rng, ids):
+        seen.append(ids.shape)
+        return jnp.mean((params["w"][ids]) ** 2)
+
+    params = {"w": np.random.RandomState(0).randn(32, 4).astype(np.float32)}
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "curriculum_learning": {
+            "enabled": True,
+            "curriculum_type": "fixed_linear",
+            "min_difficulty": 8, "max_difficulty": 16,
+            "schedule_config": {"total_curriculum_step": 4,
+                                "difficulty_step": 8}},
+        "steps_per_print": 10 ** 9,
+    }
+    eng, _, _, _ = ds.initialize(model=model, config=cfg,
+                                 model_parameters=params, mesh=mesh)
+    ids = np.zeros((2, 16), np.int32)
+    for _ in range(5):
+        loss = eng.forward(ids); eng.backward(loss); eng.step()
+    lens = sorted({s[1] for s in seen})
+    assert lens[0] == 8 and lens[-1] == 16  # grew with difficulty
+
+
+# ---------------------------------------------------------------------- #
+# PLD
+# ---------------------------------------------------------------------- #
+def test_pld_theta_decay():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    assert pld.get_theta() == 1.0
+    thetas = [pld.update_state(s) for s in (0, 100, 1000, 10 ** 6)]
+    assert thetas[0] == pytest.approx(1.0)
+    assert all(a >= b for a, b in zip(thetas, thetas[1:]))
+    assert thetas[-1] == pytest.approx(0.5, abs=1e-3)
+    assert pld.get_state()["progressive_layer_drop"] is True
+
+
+def test_engine_pld_injected_into_gpt2():
+    from deepspeed_tpu.models import GPT2Config, GPT2Model
+    ds.reset_mesh_context()
+    mesh = ds.initialize_mesh(data=-1)
+    cfg = GPT2Config(vocab_size=64, n_positions=32, hidden_size=32,
+                     num_layers=4, num_heads=4, bf16=False,
+                     embd_dropout=0.0, attn_dropout=0.0, hidden_dropout=0.0)
+    model = GPT2Model(cfg)
+    conf = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "progressive_layer_drop": {"enabled": True, "theta": 0.1,
+                                   "gamma": 0.001},
+        "steps_per_print": 10 ** 9,
+    }
+    eng, _, _, _ = ds.initialize(
+        model=model, config=conf,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)),
+        mesh=mesh)
+    assert eng.pld_enabled()
+    ids = np.asarray(jax.random.randint(jax.random.PRNGKey(1), (2, 16),
+                                        0, 64), np.int32)
+    for _ in range(2):
+        loss = eng.forward(ids); eng.backward(loss); eng.step()
+    assert eng.pld_theta() < 1.0 or eng.global_steps == 2
+    # with theta=1.0 (keep everything) the PLD path must equal the plain one
+    p0 = model.init_params(jax.random.PRNGKey(0))
+    r = jax.random.PRNGKey(2)
+    plain = model.loss(p0, r, ids)
+    pld1 = model.loss(p0, r, ids, pld_theta=1.0)
+    np.testing.assert_allclose(float(plain), float(pld1), rtol=1e-6)
+    # theta near 0 drops deep layers -> different loss
+    pld0 = model.loss(p0, r, ids, pld_theta=0.01)
+    assert abs(float(pld0) - float(plain)) > 1e-6
+
+
+# ---------------------------------------------------------------------- #
+# CSR
+# ---------------------------------------------------------------------- #
+def test_csr_roundtrip_and_add():
+    dense = np.zeros((10, 4), np.float32)
+    dense[2] = 1.0
+    dense[7] = 2.0
+    csr = CSRTensor.from_dense(jnp.asarray(dense))
+    assert csr.nnz_rows == 2
+    assert csr.sparsity() == pytest.approx(0.8)
+    np.testing.assert_array_equal(np.asarray(csr.to_dense()), dense)
+
+    other = np.zeros((10, 4), np.float32)
+    other[7] = 3.0
+    total = csr.add(CSRTensor.from_dense(jnp.asarray(other)))
+    np.testing.assert_allclose(np.asarray(total.to_dense())[7], 5.0)
+
+
+# ---------------------------------------------------------------------- #
+# activation checkpointing
+# ---------------------------------------------------------------------- #
+def test_checkpoint_equivalence():
+    """Remat must not change values or gradients (reference:
+    test_activation_checkpointing.py:289)."""
+    ac.reset()
+    ac.configure(partition_activations=False)
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (16, 16))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 16))
+
+    def block(w, x):
+        return jnp.tanh(x @ w) @ w.T
+
+    def loss_plain(w):
+        return jnp.sum(block(w, x) ** 2)
+
+    def loss_ckpt(w):
+        return jnp.sum(ac.checkpoint(block, w, x) ** 2)
+
+    np.testing.assert_allclose(float(loss_plain(w)), float(loss_ckpt(w)),
+                               rtol=1e-6)
+    g1 = jax.grad(loss_plain)(w)
+    g2 = jax.grad(loss_ckpt)(w)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-6)
+    assert ac.is_configured()
+    ac.reset()
+
+
+def test_checkpoint_policies_selectable():
+    ac.reset()
+    ac.configure(partition_activations=True)
+    assert ac.get_partition_policy() is jax.checkpoint_policies.dots_saveable
+    ac.configure(checkpoint_in_cpu=True)
+    assert ac.get_partition_policy() is not None
+    ac.reset()
+    ac.configure(deepspeed_config={
+        "activation_checkpointing": {"partition_activations": True,
+                                     "contiguous_memory_optimization": True}})
+    assert ac.get_partition_policy() is jax.checkpoint_policies.dots_saveable
+    ac.reset()
