@@ -1,0 +1,57 @@
+"""ds_report analog — environment / op-compatibility report.
+
+Reference: deepspeed/env_report.py (used by bin/ds_report): op build status
+table + version/compat summary.
+
+Run:  python -m deepspeed_tpu.env_report
+"""
+
+import sys
+
+
+def get_report_lines():
+    import jax
+    import jaxlib
+
+    from . import version
+    from .ops.op_builder import ALL_OPS, op_report
+
+    lines = ["-" * 64,
+             "deepspeed_tpu environment report (ds_report analog)",
+             "-" * 64,
+             f"deepspeed_tpu ........ {version.__version__}",
+             f"jax .................. {jax.__version__}",
+             f"jaxlib ............... {jaxlib.__version__}",
+             f"python ............... {sys.version.split()[0]}",
+             f"default backend ...... {jax.default_backend()}",
+             f"device count ......... {jax.device_count()} "
+             f"({jax.local_device_count()} local)",
+             f"devices .............. "
+             f"{[d.device_kind for d in jax.devices()][:4]}",
+             "-" * 64,
+             f"{'native op':<20}{'compatible':<14}{'built'}"]
+    for name, status in op_report().items():
+        lines.append(f"{name:<20}"
+                     f"{'[YES]' if status['compatible'] else '[NO]':<14}"
+                     f"{'[YES]' if status['built'] else '[NO]'}")
+    lines.append("-" * 64)
+    try:
+        import flax
+        lines.append(f"flax ................. {flax.__version__}")
+    except ImportError:
+        pass
+    try:
+        import optax
+        lines.append(f"optax ................ {optax.__version__}")
+    except ImportError:
+        pass
+    return lines
+
+
+def cli_main() -> int:
+    print("\n".join(get_report_lines()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(cli_main())

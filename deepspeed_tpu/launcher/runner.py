@@ -1,0 +1,187 @@
+"""Multi-host launcher — the `deepspeed` CLI analog for TPU pods.
+
+Reference: deepspeed/launcher/runner.py:259 (main: hostfile parse :120,
+--include/--exclude resource filtering, base64 world-info, runner choice)
+and launcher/launch.py:67 (per-node fork of one process per GPU with
+RANK/LOCAL_RANK/WORLD_SIZE env).
+
+TPU recasting: a TPU host runs ONE process that owns all local chips
+(multi-controller JAX), so "one proc per GPU" becomes "one proc per host".
+The launcher resolves the host list (hostfile or --num_nodes), filters with
+--include/--exclude (same syntax: "host1@host2" / "host1:0,1"), exports
+DS_COORDINATOR/DS_NUM_PROCESSES/DS_PROCESS_ID consumed by
+init_distributed() -> jax.distributed.initialize, and runs the script via
+ssh (multi-node) or exec (single node).
+
+Usage:  dslaunch --hostfile hosts.txt train.py --deepspeed_config ds.json
+"""
+
+import argparse
+import base64
+import json
+import os
+import shlex
+import subprocess
+import sys
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from ..utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+EXPORT_ENVS = ("PYTHONPATH", "PATH", "LD_LIBRARY_PATH", "TPU_NAME",
+               "JAX_PLATFORMS", "XLA_FLAGS")
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="deepspeed_tpu multi-host launcher")
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="hostfile: one 'hostname slots=N' per line "
+                             "(reference runner.py:120)")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help='e.g. "host1@host2" or "host1:0@host2:0,1"')
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="inverse of --include")
+    parser.add_argument("--num_nodes", type=int, default=-1)
+    parser.add_argument("--master_addr", type=str, default="")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--ssh_port", type=int, default=None)
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("--dry_run", action="store_true",
+                        help="print the per-host commands, launch nothing")
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args)
+
+
+def fetch_hostfile(hostfile_path: str) -> "OrderedDict[str, int]":
+    """Parse 'hostname slots=N' lines (reference: runner.py:120)."""
+    resources: "OrderedDict[str, int]" = OrderedDict()
+    if not os.path.isfile(hostfile_path):
+        return resources
+    with open(hostfile_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                host, slots = line.split()
+                _, count = slots.split("=")
+                resources[host] = int(count)
+            except ValueError:
+                raise ValueError(
+                    f"hostfile line not of form 'host slots=n': {line!r}")
+    return resources
+
+
+def _parse_inclusion(spec: str) -> Dict[str, Optional[List[int]]]:
+    out: Dict[str, Optional[List[int]]] = {}
+    for part in spec.split("@"):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            host, slots = part.split(":")
+            out[host] = [int(s) for s in slots.split(",")]
+        else:
+            out[part] = None
+    return out
+
+
+def parse_resource_filter(resources: "OrderedDict[str, int]",
+                          include_str: str = "", exclude_str: str = ""
+                          ) -> "OrderedDict[str, List[int]]":
+    """--include/--exclude slot filtering (reference: runner.py:137)."""
+    if include_str and exclude_str:
+        raise ValueError("--include and --exclude are mutually exclusive")
+    full = OrderedDict(
+        (h, list(range(n))) for h, n in resources.items())
+    if include_str:
+        keep = _parse_inclusion(include_str)
+        out = OrderedDict()
+        for host, slots in keep.items():
+            if host not in full:
+                raise ValueError(f"included host {host!r} not in hostfile")
+            out[host] = slots if slots is not None else full[host]
+        return out
+    if exclude_str:
+        drop = _parse_inclusion(exclude_str)
+        out = OrderedDict()
+        for host, slots in full.items():
+            if host in drop:
+                if drop[host] is None:
+                    continue
+                remaining = [s for s in slots if s not in drop[host]]
+                if remaining:
+                    out[host] = remaining
+            else:
+                out[host] = slots
+        return out
+    return full
+
+
+def encode_world_info(resources: Dict[str, List[int]]) -> str:
+    return base64.urlsafe_b64encode(
+        json.dumps(resources).encode()).decode()
+
+
+def decode_world_info(encoded: str) -> Dict[str, List[int]]:
+    return json.loads(base64.urlsafe_b64decode(encoded.encode()).decode())
+
+
+def build_host_commands(resources: "OrderedDict[str, List[int]]",
+                        args) -> List[List[str]]:
+    """One command per host: ssh + env + python script (one JAX process per
+    host owns all its chips)."""
+    hosts = list(resources.keys())
+    master = args.master_addr or hosts[0]
+    coordinator = f"{master}:{args.master_port}"
+    n = len(hosts)
+    cmds = []
+    exports = [f"{k}={shlex.quote(os.environ[k])}"
+               for k in EXPORT_ENVS if k in os.environ]
+    for pid, host in enumerate(hosts):
+        env = exports + [f"DS_COORDINATOR={coordinator}",
+                         f"DS_NUM_PROCESSES={n}",
+                         f"DS_PROCESS_ID={pid}",
+                         f"DS_LOCAL_CHIPS="
+                         f"{','.join(map(str, resources[host]))}"]
+        inner = (["env"] + env + [sys.executable, "-u", args.user_script] +
+                 args.user_args)
+        if n == 1 and not args.force_multi:
+            cmds.append(inner)
+        else:
+            ssh = ["ssh", "-o", "StrictHostKeyChecking=no"]
+            if args.ssh_port:
+                ssh += ["-p", str(args.ssh_port)]
+            cmds.append(ssh + [host, " ".join(map(shlex.quote, inner))])
+    return cmds
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    resources = fetch_hostfile(args.hostfile)
+    if not resources:
+        if args.num_nodes > 1:
+            raise ValueError("multi-node launch needs a hostfile")
+        resources = OrderedDict(localhost=1)
+    if args.num_nodes > 0:
+        resources = OrderedDict(list(resources.items())[:args.num_nodes])
+    active = parse_resource_filter(resources, args.include, args.exclude)
+    logger.info(f"dslaunch world: { {h: s for h, s in active.items()} }")
+    cmds = build_host_commands(active, args)
+    if args.dry_run:
+        for c in cmds:
+            print(" ".join(map(shlex.quote, c)))
+        return 0
+    procs = [subprocess.Popen(c) for c in cmds]
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,6 +60,14 @@ def configure(mpu_=None, deepspeed_config=None,
                       None) or (deepspeed_config.get(
                           "activation_checkpointing")
                           if isinstance(deepspeed_config, dict) else None)
+    if cfg is not None and not isinstance(cfg, dict):
+        import dataclasses
+        if dataclasses.is_dataclass(cfg):
+            cfg = dataclasses.asdict(cfg)
+        else:
+            cfg = {k: getattr(cfg, k) for k in dir(cfg)
+                   if not k.startswith("_") and not callable(
+                       getattr(cfg, k))}
     if isinstance(cfg, dict):
         _CONFIG["partition_activations"] = bool(
             cfg.get("partition_activations", False))

@@ -1,0 +1,65 @@
+"""Error-feedback sign compression — the 1-bit Adam/LAMB comm primitive.
+
+Reference: deepspeed/runtime/comm/nccl.py:47 (NcclBackend.
+compressed_allreduce): worker-side error compensation, sign+scale
+compression, igather+allgather of compressed chunks, server-side error
+feedback.
+
+TPU recasting: inside `shard_map` over the data axis the same algorithm is
+three lines — compensate, compress to sign·scale, `lax.psum` the compressed
+tensor (ICI does the reduction; the wire format is the sign tensor, which
+XLA keeps in bf16/int8-width lanes).  Two error-feedback states (worker +
+server in the reference) collapse into one because psum has no gather/
+scatter asymmetry.
+
+Honest perf note (measured stance of SURVEY.md §7): on ICI the dense psum
+is rarely the bottleneck, so compression mainly pays on DCN-spanning
+meshes; the API exists for parity and for multi-pod data parallelism.
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...parallel.mesh import DATA_AXIS
+
+
+def compressed_allreduce_inner(x: jnp.ndarray, error: jnp.ndarray,
+                               axis_name: str = DATA_AXIS
+                               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One error-compensated 1-bit allreduce step; call inside shard_map.
+
+    x: this worker's tensor (e.g. local momentum update);
+    error: carried compensation state (same shape).
+    Returns (averaged_decompressed, new_error).
+    """
+    world = lax.psum(1, axis_name)
+    compensated = x + error
+    # per-worker scale: mean magnitude preserves E[|x|] under sign compression
+    # (reference uses norm/sqrt(numel) — same estimator family)
+    scale = jnp.mean(jnp.abs(compensated))
+    compressed = scale * jnp.sign(compensated)
+    new_error = compensated - compressed
+    reduced = lax.psum(compressed, axis_name) / world
+    return reduced, new_error
+
+
+def compressed_allreduce(x_stacked, error_stacked, mesh_ctx=None,
+                         axis_name: str = DATA_AXIS):
+    """Worker-stacked wrapper: x_stacked [W, ...] holds worker i's tensor in
+    row i (sharded over the data axis).  Returns (reduced [W, ...] — every
+    row identical — and the new per-worker error stack)."""
+    from ...parallel.mesh import get_mesh_context
+    from jax.sharding import PartitionSpec as P
+    ctx = mesh_ctx or get_mesh_context()
+    spec = P(axis_name)
+
+    def inner(a, b):
+        r, e = compressed_allreduce_inner(a[0], b[0], axis_name)
+        return r[None], e[None]
+
+    fn = jax.shard_map(inner, mesh=ctx.mesh, in_specs=(spec, spec),
+                       out_specs=(spec, spec), check_vma=False)
+    return fn(x_stacked, error_stacked)

@@ -1,27 +1,149 @@
-"""1-bit (communication-compressed) optimizers — placeholder wiring.
+"""1-bit (communication-compressed) optimizers.
 
-Reference: deepspeed/runtime/fp16/onebit/adam.py:14 (OnebitAdam),
-onebit/lamb.py:471 (OnebitLamb), runtime/comm/nccl.py:47
-(compressed_allreduce = sign compression + error feedback).
+Reference: deepspeed/runtime/fp16/onebit/adam.py:14 (OnebitAdam) and
+onebit/lamb.py:471 (OnebitLamb): full-precision Adam/LAMB "warmup" until
+`freeze_step`, then the variance freezes and the momentum is synchronized
+through an error-compensated 1-bit allreduce
+(runtime/comm/nccl.py:47 compressed_allreduce).
 
-The full TPU implementation (sign-compressed psum with error feedback inside
-shard_map over the data axis) lands with the comm subsystem; until then the
-optimizer math falls back to uncompressed Adam/LAMB so configs referencing
-OneBitAdam still train correctly (warmup behavior == full-precision stage).
+TPU recasting: the engine's gradients arrive already data-parallel-reduced
+(XLA collective inside the compiled grad program), so the optimizer keeps
+the *numerics* of the compressed stage — sign·scale momentum with error
+feedback, frozen variance — as an optax transformation; the wire-level
+compressed collective itself lives in comm/compressed.py
+(compressed_allreduce_inner) for shard_map training loops that want the
+DCN bandwidth win.  On ICI-bound meshes the dense psum is typically faster
+— benchmark before enabling (SURVEY.md §7 honesty note).
 """
 
-from ...utils.logging import logger
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ...utils.logging import log_dist
+
+
+class OnebitState(NamedTuple):
+    count: jnp.ndarray
+    m: optax.Updates
+    v: optax.Updates
+    error: optax.Updates
+
+
+def _sign_compress(m, error):
+    comp = m + error
+    scale = jnp.mean(jnp.abs(comp))
+    cm = scale * jnp.sign(comp)
+    return cm, comp - cm
+
+
+def onebit_adam(learning_rate, b1: float = 0.9, b2: float = 0.999,
+                eps: float = 1e-8, weight_decay: float = 0.0,
+                freeze_step: int = 100) -> optax.GradientTransformation:
+    """OnebitAdam (reference onebit/adam.py:14) as an optax transform."""
+
+    def init(params):
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        return OnebitState(jnp.zeros((), jnp.int32), zeros,
+                           jax.tree.map(jnp.zeros_like, params), zeros)
+
+    def update(grads, state, params=None):
+        count = state.count + 1
+        in_warmup = count <= freeze_step
+
+        m_raw = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g,
+                             state.m, grads)
+
+        def warm(mr, err):
+            return mr, err
+
+        compressed = jax.tree.map(
+            lambda mr, err: jax.lax.cond(in_warmup, warm, _sign_compress,
+                                         mr, err),
+            m_raw, state.error)
+        m_new = jax.tree.map(lambda t: t[0], compressed,
+                             is_leaf=lambda t: isinstance(t, tuple))
+        err_new = jax.tree.map(lambda t: t[1], compressed,
+                               is_leaf=lambda t: isinstance(t, tuple))
+
+        # variance freezes after warmup (reference: exp_avg_sq stops
+        # updating once compression starts)
+        v_new = jax.tree.map(
+            lambda v, g: jnp.where(in_warmup, b2 * v + (1 - b2) * g * g, v),
+            state.v, grads)
+
+        lr = (learning_rate(count - 1) if callable(learning_rate)
+              else learning_rate)
+        bias1 = 1 - b1 ** count.astype(jnp.float32)
+        bias2 = 1 - b2 ** jnp.minimum(
+            count, freeze_step).astype(jnp.float32)
+
+        def upd(m, v, p):
+            step = (m / bias1) / (jnp.sqrt(v / bias2) + eps)
+            if weight_decay > 0 and p is not None:
+                step = step + weight_decay * p
+            return -lr * step
+
+        updates = (jax.tree.map(upd, m_new, v_new, params)
+                   if params is not None else
+                   jax.tree.map(lambda m, v: upd(m, v, None), m_new, v_new))
+        return updates, OnebitState(count, m_new, v_new, err_new)
+
+    return optax.GradientTransformation(init, update)
+
+
+def onebit_lamb(learning_rate, b1: float = 0.9, b2: float = 0.999,
+                eps: float = 1e-6, weight_decay: float = 0.0,
+                freeze_step: int = 100,
+                min_trust: float = 0.01, max_trust: float = 10.0
+                ) -> optax.GradientTransformation:
+    """OnebitLamb (reference onebit/lamb.py:471): onebit_adam step scaled by
+    the per-leaf LAMB trust ratio."""
+    base = onebit_adam(learning_rate, b1, b2, eps, 0.0, freeze_step)
+
+    def init(params):
+        return base.init(params)
+
+    def update(grads, state, params=None):
+        updates, new_state = base.update(grads, state, params)
+
+        def trust(u, p):
+            p_norm = jnp.linalg.norm(p.reshape(-1))
+            u_norm = jnp.linalg.norm(u.reshape(-1))
+            ratio = jnp.where(
+                (p_norm > 0) & (u_norm > 0),
+                jnp.clip(p_norm / u_norm, min_trust, max_trust), 1.0)
+            return u * ratio
+        if params is not None:
+            if weight_decay > 0:
+                # decoupled decay enters before the trust ratio (LAMB):
+                # update = -lr*(adam_step + wd*p); base holds -lr*adam_step
+                lr = (learning_rate(state.count)
+                      if callable(learning_rate) else learning_rate)
+                updates = jax.tree.map(
+                    lambda u, p: u - lr * weight_decay * p, updates, params)
+            updates = jax.tree.map(trust, updates, params)
+        return updates, new_state
+
+    return optax.GradientTransformation(init, update)
 
 
 def build_onebit_optimizer(name, cfg, lr):
-    import optax
-    logger.warning(
-        f"{name}: compressed-communication stage not yet wired; running the "
-        f"full-precision (warmup-equivalent) path")
     betas = cfg.get("betas", (0.9, 0.999))
+    freeze = int(cfg.get("freeze_step", 100))
+    log_dist(
+        f"{name}: warmup(full-precision) for {freeze} steps, then "
+        f"error-feedback 1-bit momentum with frozen variance", ranks=[0])
     if "lamb" in name:
-        from ..optimizers import _lamb
-        return _lamb(lr, b1=betas[0], b2=betas[1],
-                     eps=cfg.get("eps", 1e-6),
-                     weight_decay=cfg.get("weight_decay", 0.0))
-    return optax.adam(lr, b1=betas[0], b2=betas[1], eps=cfg.get("eps", 1e-8))
+        return onebit_lamb(lr, b1=betas[0], b2=betas[1],
+                           eps=cfg.get("eps", 1e-6),
+                           weight_decay=cfg.get("weight_decay", 0.0),
+                           freeze_step=freeze,
+                           min_trust=cfg.get("min_coeff", 0.01),
+                           max_trust=cfg.get("max_coeff", 10.0))
+    return onebit_adam(lr, b1=betas[0], b2=betas[1],
+                       eps=cfg.get("eps", 1e-8),
+                       weight_decay=cfg.get("weight_decay", 0.0),
+                       freeze_step=freeze)

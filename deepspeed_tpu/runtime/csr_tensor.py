@@ -62,14 +62,27 @@ class CSRTensor:
 def sparse_allreduce(csr: CSRTensor) -> CSRTensor:
     """Sum a row-compressed gradient across processes
     (reference: engine.py:1729 csr_allreduce — allgather indices+values).
-    Single-process: identity."""
+    Single-process: identity.
+
+    Per-process nnz counts differ, and process_allgather needs uniform
+    shapes — so rows are padded to the global max count with a -1 index
+    sentinel before the gather (the reference pads to max_size the same
+    way, engine.py:1739)."""
     if jax.process_count() <= 1:
         return csr
     from jax.experimental import multihost_utils
-    idx = multihost_utils.process_allgather(np.asarray(csr.indices))
-    vals = multihost_utils.process_allgather(np.asarray(csr.values))
-    dense = np.zeros(csr.dense_size, np.asarray(csr.values).dtype)
-    for i, v in zip(np.concatenate(idx), np.concatenate(
-            vals.reshape(-1, vals.shape[-1]))):
-        dense[int(i)] += v
+    idx_local = np.asarray(csr.indices)
+    val_local = np.asarray(csr.values)
+    counts = np.asarray(multihost_utils.process_allgather(
+        np.asarray(idx_local.shape[0])))
+    max_n = int(counts.max())
+    pad = max_n - idx_local.shape[0]
+    idx_p = np.pad(idx_local, (0, pad), constant_values=-1)
+    val_p = np.pad(val_local, ((0, pad), (0, 0)))
+    idx = np.asarray(multihost_utils.process_allgather(idx_p)).reshape(-1)
+    vals = np.asarray(multihost_utils.process_allgather(val_p)).reshape(
+        -1, val_local.shape[-1])
+    dense = np.zeros(csr.dense_size, val_local.dtype)
+    keep = idx >= 0
+    np.add.at(dense, idx[keep], vals[keep])
     return CSRTensor.from_dense(jnp.asarray(dense))

@@ -35,8 +35,12 @@ class CurriculumScheduler:
                 sched["total_curriculum_step"]
             self.state["difficulty_step"] = sched.get("difficulty_step", 8)
             if self.state["difficulty_step"] % 8 != 0:
-                # reference warns for Tensor Cores; TPU lanes want 128
-                pass
+                from ...utils.logging import logger
+                logger.warning(
+                    f"curriculum difficulty_step "
+                    f"{self.state['difficulty_step']} is not a multiple of "
+                    f"8 — every new difficulty is a fresh XLA compilation; "
+                    f"multiples of 128 bucket best on TPU lanes")
             self.state["root_degree"] = sched.get(
                 "root_degree", 1 if ctype == FIXED_LINEAR else 2)
         elif ctype == FIXED_DISCRETE:

@@ -42,11 +42,11 @@ class Eigenvalue:
             return jax.jvp(grad_fn, (params,), (v,))[1]
 
         hvp = jax.jit(hvp)
-        v = jax.tree.map(
-            lambda l: jax.random.normal(
-                jax.random.fold_in(rng, hash(l.shape) % (2 ** 31)),
-                l.shape, jnp.float32),
-            params)
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        v = jax.tree_util.tree_unflatten(treedef, [
+            jax.random.normal(jax.random.fold_in(rng, i), l.shape,
+                              jnp.float32)
+            for i, l in enumerate(leaves)])
         v, _ = _normalize(v)
         eig = jnp.asarray(0.0)
         for _ in range(self.max_iter):
@@ -66,14 +66,18 @@ class Eigenvalue:
             rng) -> Dict[str, float]:
         """Per-top-level-block eigenvalues (the reference's per-layer map
         used to modulate each layer's quantize period)."""
+        import zlib
         out = {}
         for key in params:
             def block_loss(block, key=key):
                 merged = dict(params)
                 merged[key] = block
                 return loss_fn(merged)
+            # crc32 is stable across processes (hash() is salted per
+            # process and would desync multi-host schedules)
             eig, _ = self.compute_eigenvalue(
-                block_loss, params[key], jax.random.fold_in(
-                    rng, hash(key) % (2 ** 31)))
+                block_loss, params[key],
+                jax.random.fold_in(rng, zlib.crc32(str(key).encode())
+                                   & 0x7FFFFFFF))
             out[key] = eig
         return out

@@ -235,7 +235,22 @@ class DeepSpeedEngine:
         # (engine.py:1427-1434).
         self.progressive_layer_drop = None
         if self.config.pld_config.enabled:
+            import inspect
             from .progressive_layer_drop import ProgressiveLayerDrop
+            target = (model.__call__ if hasattr(model, "__call__") and not
+                      inspect.isfunction(model) else model)
+            try:
+                sig = inspect.signature(target)
+                accepts = ("pld_theta" in sig.parameters or any(
+                    p.kind == inspect.Parameter.VAR_KEYWORD
+                    for p in sig.parameters.values()))
+            except (TypeError, ValueError):
+                accepts = True  # can't introspect; let the call decide
+            if not accepts:
+                raise ValueError(
+                    "progressive_layer_drop is enabled but the model does "
+                    "not accept a pld_theta kwarg (GPT2Model does; add the "
+                    "kwarg to custom models to opt in)")
             self.progressive_layer_drop = ProgressiveLayerDrop(
                 theta=self.config.pld_config.theta,
                 gamma=self.config.pld_config.gamma)
@@ -551,16 +566,17 @@ class DeepSpeedEngine:
             self.timers(FORWARD_MICRO_TIMER).start()
         if self._is_train_mode:
             self.tput_timer.start()
-        if self.curriculum_scheduler is not None:
+        if self.curriculum_scheduler is not None and self._is_train_mode:
             # Truncate every sequence-sized axis to the current difficulty
             # (reference: engine.py:1239-1245 curriculum_seqlen injection).
-            # "Sequence-sized" = equal to the batch's full sequence length,
-            # so labels [B,S] and masks [B,1,1,S]/[B,1,S,S] shrink together.
+            # The sequence length is read from the FIRST batch array (the
+            # input_ids convention); every axis equal to it — labels [B,S],
+            # masks [B,1,1,S]/[B,1,S,S] — shrinks together.
             seqlen = self.curriculum_scheduler.update_difficulty(
                 self.global_steps + 1)
             arrays = [a for a in jax.tree.leaves((args, kwargs))
                       if getattr(a, "ndim", 0) >= 2]
-            full_len = max((a.shape[1] for a in arrays), default=0)
+            full_len = arrays[0].shape[1] if arrays else 0
 
             def _trunc(a):
                 if getattr(a, "ndim", 0) < 2 or full_len <= seqlen:
@@ -579,8 +595,31 @@ class DeepSpeedEngine:
                 self.progressive_layer_drop.get_theta())
         batch = self._shard_batch((args, kwargs))
         args, kwargs = batch
+        rng = self._next_rng()
+        fp_cfg = self.config.flops_profiler_config
+        profile_now = (fp_cfg.enabled and self._is_train_mode and
+                       self.global_steps == fp_cfg.profile_step and
+                       not getattr(self, "_flops_profiled", False))
+        if profile_now:
+            # reference: FlopsProfiler armed from forward at profile_step
+            # (engine.py:1231); here one jaxpr walk of the fused loss+grad
+            # program counts the whole step exactly.
+            from ..profiling import FlopsProfiler
+            prof = FlopsProfiler(config=fp_cfg)
+            prof.set_params(self.params)
+            prof.start_profile()
+            prof.profile_fn(self._grad_fn, self.params, self.scaler_state,
+                            rng, *args, **kwargs)
         loss, grads = self._grad_fn(self.params, self.scaler_state,
-                                    self._next_rng(), *args, **kwargs)
+                                    rng, *args, **kwargs)
+        if profile_now:
+            jax.block_until_ready(loss)
+            prof.stop_profile()
+            prof.print_model_profile(profile_step=fp_cfg.profile_step,
+                                     detailed=fp_cfg.detailed,
+                                     output_file=fp_cfg.output_file)
+            self._flops_profiled = True
+            self.flops_profiler = prof
         self._cached_grads = grads
         self._last_loss = loss
         if self.wall_clock_breakdown():

@@ -94,9 +94,10 @@ class Quantizer:
         param out_shardings."""
         cfg = self.config
         flat, treedef = jax.tree_util.tree_flatten(params)
-        keys = (jax.random.split(rng, len(flat))
-                if (self.stochastic and rng is not None) else [None] * len(
-                    flat))
+        # without an rng, stochastic rounding falls back to nearest
+        stochastic = self.stochastic and rng is not None
+        keys = (jax.random.split(rng, len(flat)) if stochastic
+                else [None] * len(flat))
         out = []
         for leaf, key in zip(flat, keys):
             arr = jnp.asarray(leaf)
@@ -105,7 +106,7 @@ class Quantizer:
                 continue
             out.append(quantize_dequantize(
                 arr, bits, int(cfg.quantize_groups), self.symmetric,
-                self.stochastic, key))
+                stochastic, key))
         return jax.tree_util.tree_unflatten(treedef, out)
 
     def quantize_params(self, params: Any, step: int,

@@ -1,0 +1,180 @@
+"""Checkpoint shard merge/split for tensor-parallel resize.
+
+Reference: deepspeed/runtime/state_dict_factory.py — SDLoaderFactory:17,
+MegatronSDLoader:199 (merge or split mp_rank_XX shards to match a new
+mp_size, with qkv special-casing for interleaved layouts and transposed
+weights).
+
+TPU context: single-controller checkpoints save consolidated arrays
+(runtime/checkpoint.py gathers on np.asarray), so an in-framework TP resize
+is free — reload with new shardings.  This module covers the remaining real
+cases: importing *per-rank* checkpoints (Megatron-style exports, multi-
+controller per-host saves) at a different mp degree, and exporting our
+consolidated trees as per-rank shards.  Merge/split direction per weight
+comes from the model's PartitionSpec tree — the same source of truth GSPMD
+shards by — instead of the reference's per-policy axis guesswork; qkv gets
+the reference's special casing (the fused [H, 3H] axis must be split
+per-projection, not naively, when heads are interleaved across ranks).
+"""
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+
+from ..parallel.mesh import MODEL_AXIS
+from ..utils.logging import log_dist
+
+
+def _spec_tp_axis(spec) -> Optional[int]:
+    """Index of the dimension sharded over the model axis, if any."""
+    if spec is None:
+        return None
+    for i, entry in enumerate(spec):
+        if entry == MODEL_AXIS or (
+                isinstance(entry, (tuple, list)) and MODEL_AXIS in entry):
+            return i
+    return None
+
+
+def split_qkv(qkvw: np.ndarray, mp: int, num_splits: int = 3,
+              axis: int = -1) -> List[np.ndarray]:
+    """Split a fused qkv weight [..., 3H] into mp shards, each carrying its
+    rank's slice OF EACH of q, k, v — the reference's qkv special case
+    (state_dict_factory.py:199 MegatronSDLoader merge/split qkv)."""
+    parts = np.split(qkvw, num_splits, axis=axis)      # q, k, v
+    rank_shards = []
+    for r in range(mp):
+        pieces = [np.split(p, mp, axis=axis)[r] for p in parts]
+        rank_shards.append(np.concatenate(pieces, axis=axis))
+    return rank_shards
+
+
+def merge_qkv(shards: Sequence[np.ndarray], num_splits: int = 3,
+              axis: int = -1) -> np.ndarray:
+    """Inverse of split_qkv."""
+    per_rank = [np.split(s, num_splits, axis=axis) for s in shards]
+    merged_parts = [np.concatenate([pr[i] for pr in per_rank], axis=axis)
+                    for i in range(num_splits)]
+    return np.concatenate(merged_parts, axis=axis)
+
+
+_QKV_KEYS = ("attn_qkvw", "attn_qkvb")
+
+
+def split_state_dict(params: Any, specs: Any, mp_size: int
+                     ) -> List[Any]:
+    """Consolidated param tree -> mp_size per-rank trees, split along each
+    leaf's model-axis dim (qkv keys get interleave-aware splitting)."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    spec_map = {jax.tree_util.keystr(p): s for p, s in
+                jax.tree_util.tree_flatten_with_path(
+                    specs, is_leaf=lambda x: x is None or
+                    hasattr(x, "index"))[0]}
+    rank_leaves: List[List[np.ndarray]] = [[] for _ in range(mp_size)]
+    for path, leaf in flat:
+        key = jax.tree_util.keystr(path)
+        arr = np.asarray(leaf)
+        axis = _spec_tp_axis(spec_map.get(key))
+        if axis is None or arr.shape[axis] % mp_size != 0:
+            for r in range(mp_size):
+                rank_leaves[r].append(arr)
+            continue
+        if any(k in key for k in _QKV_KEYS):
+            shards = split_qkv(arr, mp_size, axis=axis)
+        else:
+            shards = np.split(arr, mp_size, axis=axis)
+        for r in range(mp_size):
+            rank_leaves[r].append(shards[r])
+    leaves_only_def = jax.tree_util.tree_structure(params)
+    return [jax.tree_util.tree_unflatten(leaves_only_def, rl)
+            for rl in rank_leaves]
+
+
+def merge_state_dicts(rank_params: Sequence[Any], specs: Any) -> Any:
+    """mp-rank param trees -> one consolidated tree (inverse of
+    split_state_dict; the MegatronSDLoader merge path)."""
+    mp = len(rank_params)
+    if mp == 1:
+        return jax.tree.map(np.asarray, rank_params[0])
+    flats = [jax.tree_util.tree_flatten_with_path(p)[0]
+             for p in rank_params]
+    spec_map = {jax.tree_util.keystr(p): s for p, s in
+                jax.tree_util.tree_flatten_with_path(
+                    specs, is_leaf=lambda x: x is None or
+                    hasattr(x, "index"))[0]}
+    merged = []
+    for i, (path, _) in enumerate(flats[0]):
+        key = jax.tree_util.keystr(path)
+        arrs = [np.asarray(f[i][1]) for f in flats]
+        axis = _spec_tp_axis(spec_map.get(key))
+        if axis is None:
+            merged.append(arrs[0])  # replicated leaf
+        elif any(k in key for k in _QKV_KEYS):
+            merged.append(merge_qkv(arrs, axis=axis))
+        else:
+            merged.append(np.concatenate(arrs, axis=axis))
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(rank_params[0]), merged)
+
+
+class SDLoaderFactory:
+    """Reference: state_dict_factory.py:17 — picks a loader for a
+    checkpoint list; here all sharded imports resolve to MegatronSDLoader
+    semantics (merge/split by spec)."""
+
+    @staticmethod
+    def get_sd_loader(ckpt_list: Sequence[str], version=None,
+                      sd_type: str = "Megatron"):
+        return MegatronSDLoader(list(ckpt_list), version)
+
+
+class MegatronSDLoader:
+    """Load N per-rank .npz checkpoints and serve them at any mp_size
+    (reference MegatronSDLoader:199)."""
+
+    def __init__(self, ckpt_list: List[str], version=None):
+        self.ckpt_list = ckpt_list
+        self.version = version
+
+    def _load_all(self) -> List[Dict[str, np.ndarray]]:
+        out = []
+        for path in self.ckpt_list:
+            with np.load(path, allow_pickle=False) as z:
+                out.append({k: z[k] for k in z.files})
+        return out
+
+    def load(self, mp_world_size: int, mp_rank: int, specs: Any,
+             template: Any) -> Any:
+        """Return the param tree for (mp_world_size, mp_rank): merges the
+        stored shards to consolidated form, then splits for the target
+        degree (resize = merge ∘ split, reference :199)."""
+        raw = self._load_all()
+        trees = []
+        for flat in raw:
+            leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+            tree_leaves = [flat[jax.tree_util.keystr(p)] for p, _ in leaves]
+            trees.append(jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(template), tree_leaves))
+        consolidated = merge_state_dicts(trees, specs)
+        if mp_world_size == 1:
+            return consolidated
+        shards = split_state_dict(consolidated, specs, mp_world_size)
+        log_dist(f"MegatronSDLoader: {len(self.ckpt_list)} shards -> "
+                 f"mp={mp_world_size}", ranks=[0])
+        return shards[mp_rank]
+
+    @staticmethod
+    def save_shards(params: Any, specs: Any, mp_size: int,
+                    path_fmt: str) -> List[str]:
+        """Export a consolidated tree as per-rank files
+        (path_fmt.format(rank))."""
+        paths = []
+        for r, tree in enumerate(split_state_dict(params, specs, mp_size)):
+            flat = {jax.tree_util.keystr(p): np.asarray(l) for p, l in
+                    jax.tree_util.tree_flatten_with_path(tree)[0]}
+            path = path_fmt.format(r)
+            np.savez(path, **flat)
+            paths.append(path)
+        return paths

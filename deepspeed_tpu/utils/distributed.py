@@ -1,0 +1,74 @@
+"""Multi-controller bootstrap — the init_distributed analog.
+
+Reference: deepspeed/utils/distributed.py:12 (init_distributed: env-var /
+MPI rank discovery, then torch.distributed.init_process_group(nccl)).
+
+TPU recasting: discovery order is (1) dslaunch's DS_* env, (2) torch-style
+MASTER_ADDR/RANK/WORLD_SIZE env, (3) OMPI_COMM_WORLD_* (mpirun), then
+`jax.distributed.initialize` wires the coordinator.  On Cloud TPU with no
+env at all, jax.distributed.initialize() autodetects from metadata — the
+AzureML-patch role of the reference (:108).
+"""
+
+import os
+from typing import Optional
+
+import jax
+
+from .logging import logger
+
+_INITIALIZED = False
+
+
+def mpi_discovery() -> Optional[dict]:
+    """OpenMPI env discovery (reference: distributed.py:54)."""
+    if "OMPI_COMM_WORLD_SIZE" not in os.environ:
+        return None
+    return {
+        "num_processes": int(os.environ["OMPI_COMM_WORLD_SIZE"]),
+        "process_id": int(os.environ["OMPI_COMM_WORLD_RANK"]),
+        "coordinator_address": os.environ.get("MASTER_ADDR", "") and
+        f"{os.environ['MASTER_ADDR']}:"
+        f"{os.environ.get('MASTER_PORT', 29500)}",
+    }
+
+
+def init_distributed(dist_backend: str = "xla", auto_mpi_discovery=True,
+                     init_method: Optional[str] = None, rank: int = -1,
+                     world_size: int = -1) -> None:
+    """Initialize multi-controller JAX from launcher/MPI/torch-style env."""
+    global _INITIALIZED
+    if _INITIALIZED or jax.process_count() > 1:
+        _INITIALIZED = True
+        return
+
+    coordinator = num = pid = None
+    if "DS_COORDINATOR" in os.environ:  # dslaunch
+        coordinator = os.environ["DS_COORDINATOR"]
+        num = int(os.environ["DS_NUM_PROCESSES"])
+        pid = int(os.environ["DS_PROCESS_ID"])
+    elif "MASTER_ADDR" in os.environ and "RANK" in os.environ:
+        coordinator = (f"{os.environ['MASTER_ADDR']}:"
+                       f"{os.environ.get('MASTER_PORT', 29500)}")
+        num = int(os.environ.get("WORLD_SIZE", world_size))
+        pid = int(os.environ["RANK"])
+    elif auto_mpi_discovery:
+        found = mpi_discovery()
+        if found and found["coordinator_address"]:
+            coordinator = found["coordinator_address"]
+            num, pid = found["num_processes"], found["process_id"]
+
+    if rank >= 0:
+        pid = rank
+    if world_size > 0:
+        num = world_size
+
+    if coordinator is None or num is None or num <= 1:
+        logger.info("init_distributed: single-process (no coordinator env)")
+        _INITIALIZED = True
+        return
+    logger.info(f"init_distributed: coordinator={coordinator} "
+                f"process {pid}/{num}")
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num, process_id=pid)
+    _INITIALIZED = True
