@@ -44,8 +44,9 @@ def main():
                                     model_parameters=params)
 
     rng = np.random.RandomState(0)
-    ids = rng.randint(0, cfg.vocab_size, size=(batch, seq + 1)).astype(
-        np.int32)
+    # loss() runs attention on the full length and shifts on logits, so the
+    # input length IS the attention length (keep it = n_positions)
+    ids = rng.randint(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32)
 
     def step():
         loss = engine.forward(ids)

@@ -14,7 +14,9 @@ from .parallel import (MeshContext, get_mesh_context, initialize_mesh,
                        reset_mesh_context)
 from .parallel import groups
 from .utils import logger, log_dist
+from .utils.distributed import init_distributed
 from . import moe
+from .runtime import zero  # deepspeed.zero.Init / GatheredParameters parity
 
 
 def initialize(args=None, model=None, config=None, config_params=None,

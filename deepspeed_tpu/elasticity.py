@@ -167,3 +167,29 @@ def apply_elasticity(param_dict: Dict, world_size: int) -> None:
     param_dict[C.TRAIN_BATCH_SIZE] = final_batch_size
     param_dict[C.TRAIN_MICRO_BATCH_SIZE_PER_GPU] = micro
     param_dict[C.GRADIENT_ACCUMULATION_STEPS] = gas
+
+
+def cli_main(argv=None) -> int:
+    """ds_elastic analog (reference: bin/ds_elastic): show the elastic
+    batch/chip-count compatibility solve for a config file."""
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(description="deepspeed_tpu elasticity")
+    parser.add_argument("-c", "--config", required=True,
+                        help="DeepSpeed config json with an elasticity block")
+    parser.add_argument("-w", "--world-size", type=int, default=0)
+    args = parser.parse_args(argv)
+    with open(args.config) as f:
+        ds_config = json.load(f)
+    result = compute_elastic_config(ds_config, world_size=args.world_size)
+    out = {"final_batch_size": result[0], "valid_chip_counts": result[1]}
+    if len(result) == 3:
+        out["micro_batch_per_chip"] = result[2]
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(cli_main())

@@ -1,0 +1,186 @@
+"""Shared runtime helpers — grad-norm clipping, memory telemetry,
+partitioning math, ZeRO memory estimators.
+
+Reference: deepspeed/runtime/utils.py — clip_grad_norm_:257 (model-parallel-
+aware global norm), partition_uniform:562 / partition_balanced,
+see_memory_usage:798; memory estimators stage2.py:2141 /
+stage3 estimate_zero3_model_states_mem_needs.
+"""
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+# ---------------------------------------------------------------------- #
+# gradient clipping
+# ---------------------------------------------------------------------- #
+def global_grad_norm(grads: Any, axis_name: Optional[str] = None):
+    """L2 norm over a grad pytree; inside shard_map pass axis_name to psum
+    partial norms across model-parallel shards (the mp-awareness of
+    clip_grad_norm_:257 — each rank only holds its slice)."""
+    sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+             for g in jax.tree.leaves(grads))
+    if axis_name is not None:
+        sq = lax.psum(sq, axis_name)
+    return jnp.sqrt(sq)
+
+
+def clip_grad_norm_(grads: Any, max_norm: float,
+                    axis_name: Optional[str] = None) -> Tuple[Any, Any]:
+    """Scale grads so the global norm is <= max_norm; returns
+    (clipped_grads, pre_clip_norm)."""
+    norm = global_grad_norm(grads, axis_name)
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree.map(lambda g: (g.astype(jnp.float32) * scale
+                                   ).astype(g.dtype), grads), norm
+
+
+# ---------------------------------------------------------------------- #
+# layer partitioning math (pipeline stage assignment)
+# ---------------------------------------------------------------------- #
+def partition_uniform(num_items: int, num_parts: int) -> List[int]:
+    """Boundaries of a near-uniform split (reference partition_uniform:562):
+    returns num_parts+1 offsets."""
+    parts = [0] * (num_parts + 1)
+    chunk = num_items // num_parts
+    extra = num_items % num_parts
+    for p in range(num_parts):
+        parts[p + 1] = parts[p] + chunk + (1 if p < extra else 0)
+    return parts
+
+
+def prefix_sum_inc(weights: Sequence[float]) -> List[float]:
+    out = list(weights)
+    for i in range(1, len(out)):
+        out[i] += out[i - 1]
+    return out
+
+
+def partition_balanced(weights: Sequence[float], num_parts: int
+                       ) -> List[int]:
+    """Weighted boundaries minimizing the heaviest part (binary search over
+    the bottleneck, the role of the reference's partition_balanced)."""
+    n = len(weights)
+    if num_parts >= n:
+        return list(range(n + 1))
+    prefix = [0.0] + prefix_sum_inc(weights)
+
+    def parts_needed(cap: float) -> Optional[List[int]]:
+        bounds = [0]
+        start = 0
+        for _ in range(num_parts):
+            # furthest end with weight(start..end) <= cap
+            end = start
+            while end < n and prefix[end + 1] - prefix[start] <= cap:
+                end += 1
+            if end == start:
+                return None  # one item exceeds cap
+            bounds.append(end)
+            start = end
+            if end == n:
+                break
+        if bounds[-1] != n:
+            return None
+        while len(bounds) < num_parts + 1:
+            bounds.append(n)
+        return bounds
+
+    lo = max(weights)
+    hi = prefix[-1]
+    best = parts_needed(hi)
+    for _ in range(50):
+        mid = (lo + hi) / 2
+        cand = parts_needed(mid)
+        if cand is not None:
+            best, hi = cand, mid
+        else:
+            lo = mid
+    return best
+
+
+# ---------------------------------------------------------------------- #
+# memory telemetry
+# ---------------------------------------------------------------------- #
+def see_memory_usage(message: str, force: bool = False) -> dict:
+    """Device + host memory snapshot (reference see_memory_usage:798 prints
+    torch.cuda allocator stats; here per-device XLA memory stats)."""
+    from ..utils.logging import logger
+    stats = {}
+    try:
+        dev = jax.devices()[0]
+        ms = dev.memory_stats() or {}
+        stats["bytes_in_use"] = ms.get("bytes_in_use", 0)
+        stats["peak_bytes_in_use"] = ms.get("peak_bytes_in_use", 0)
+        stats["bytes_limit"] = ms.get("bytes_limit", 0)
+    except Exception:
+        pass
+    try:
+        import resource
+        stats["host_max_rss_mb"] = resource.getrusage(
+            resource.RUSAGE_SELF).ru_maxrss // 1024
+    except Exception:
+        pass
+    gb = 1024 ** 3
+    logger.info(
+        f"{message} | device {stats.get('bytes_in_use', 0) / gb:.2f}GB "
+        f"(peak {stats.get('peak_bytes_in_use', 0) / gb:.2f}GB / "
+        f"limit {stats.get('bytes_limit', 0) / gb:.2f}GB) | "
+        f"host rss {stats.get('host_max_rss_mb', 0) / 1024:.2f}GB")
+    return stats
+
+
+# ---------------------------------------------------------------------- #
+# ZeRO memory estimators (reference stage2.py:2141, stage3 equivalents)
+# ---------------------------------------------------------------------- #
+def estimate_zero_model_states_mem_needs(
+        total_params: int, num_chips: int = 1, stage: int = 2,
+        offload_optimizer: bool = False, bf16: bool = True,
+        additional_buffer_factor: float = 1.5) -> dict:
+    """Per-chip HBM + host bytes for model states under each ZeRO stage.
+
+    Accounting (per parameter): compute copy 2B (bf16) or 4B; fp32 master
+    4B; Adam moments 8B.  Stage 1/2 shard optimizer(+grad) states over
+    chips; stage 3 shards everything; offload moves master+moments to host.
+    """
+    comp = 2 if bf16 else 4
+    grads = comp
+    master_opt = 12  # fp32 master + exp_avg + exp_avg_sq
+
+    if stage >= 3:
+        hbm_params = comp * total_params / num_chips
+        hbm_grads = grads * total_params / num_chips
+    else:
+        hbm_params = comp * total_params
+        hbm_grads = (grads * total_params if stage < 2
+                     else grads * total_params / max(1, num_chips))
+    if stage >= 1:
+        opt_each = master_opt * total_params / num_chips
+    else:
+        opt_each = master_opt * total_params
+    host = 0.0
+    if offload_optimizer:
+        host = opt_each
+        opt_each = 0.0
+    hbm = (hbm_params + hbm_grads + opt_each) * additional_buffer_factor
+    return {"per_chip_hbm_bytes": int(hbm),
+            "per_chip_host_bytes": int(host * additional_buffer_factor),
+            "stage": stage, "num_chips": num_chips}
+
+
+def estimate_zero2_model_states_mem_needs(total_params, num_chips=1,
+                                          cpu_offload=False, **kw):
+    return estimate_zero_model_states_mem_needs(
+        total_params, num_chips, stage=2, offload_optimizer=cpu_offload,
+        **kw)
+
+
+def estimate_zero3_model_states_mem_needs(total_params, num_chips=1,
+                                          cpu_offload=False, **kw):
+    return estimate_zero_model_states_mem_needs(
+        total_params, num_chips, stage=3, offload_optimizer=cpu_offload,
+        **kw)

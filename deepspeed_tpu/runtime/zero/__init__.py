@@ -1,1 +1,4 @@
 from .partition import ZeroPartitioner, zero_partition_spec
+from .api import GatheredParameters, Init
+from .offload import HostOffloadOptimizer
+from .tiling import TiledLinear

@@ -1,0 +1,121 @@
+"""User-facing ZeRO-3 construction API — zero.Init and GatheredParameters.
+
+Reference: deepspeed/runtime/zero/partition_parameters.py — Init:339
+(subclass-init interception so a 100B model never materializes unsharded)
+and GatheredParameters:1079 (context manager that allgathers partitioned
+params for code needing full tensors).
+
+TPU recasting: JAX params are explicit pytrees, so no class interception is
+needed — `Init` is a context manager under which `materialize(init_fn,
+rng)` builds each shard directly into its ZeRO placement: the weights are
+created via `jax.jit(init_fn, out_shardings=...)`, so every device only
+ever materializes its own partition (the eval_shape + sharded-init recipe
+of SURVEY.md §7 step 4).  `GatheredParameters` produces a temporarily
+replicated (fully-gathered) copy for host-side surgery and scatters edits
+back on exit.
+"""
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...parallel.mesh import MeshContext, get_mesh_context
+from ...utils.logging import log_dist
+from .partition import ZeroPartitioner
+
+
+class Init:
+    """Sharded-from-birth parameter construction (reference Init:339).
+
+    Usage:
+        with zero.Init(config=ds_config, mesh_ctx=ctx) as zinit:
+            params = zinit.materialize(model.init_params, rng,
+                                       base_specs=model.param_partition_specs())
+
+    Every leaf is produced by a compiled init whose out_sharding is its
+    ZeRO partition — peak per-device memory is the shard size, never the
+    full parameter (the reference's whole reason for intercepting
+    __init__).
+    """
+
+    def __init__(self, config=None, mesh_ctx: Optional[MeshContext] = None,
+                 stage: int = 3, dtype=jnp.float32):
+        if config is not None:
+            stage = config.zero_optimization_stage
+        self.stage = stage
+        self.dtype = dtype
+        self.mesh_ctx = mesh_ctx
+        self._partitioner = None
+
+    def __enter__(self):
+        ctx = self.mesh_ctx or get_mesh_context()
+        self.mesh_ctx = ctx
+        self._partitioner = ZeroPartitioner(ctx, self.stage)
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def materialize(self, init_fn: Callable, rng, *args,
+                    base_specs: Any = None) -> Any:
+        """Run init_fn(rng, *args) with ZeRO out_shardings — XLA builds each
+        leaf directly as its shard."""
+        shapes = jax.eval_shape(init_fn, rng, *args)
+        shardings = self._partitioner.param_shardings(shapes, base_specs)
+        params = jax.jit(init_fn, out_shardings=shardings)(rng, *args)
+        n = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+        log_dist(f"zero.Init: materialized {n} params sharded at stage "
+                 f"{self.stage}", ranks=[0])
+        return params
+
+    def shard_existing(self, params: Any, base_specs: Any = None) -> Any:
+        """Scatter an already-materialized tree (the convert-after-load
+        path, reference _convert_to_deepspeed_param:527)."""
+        shardings = self._partitioner.param_shardings(params, base_specs)
+        return jax.tree.map(jax.device_put, params, shardings)
+
+
+class GatheredParameters:
+    """Temporarily gather sharded params to full (replicated) arrays
+    (reference GatheredParameters:1079).
+
+    with GatheredParameters(params, modifier_rank=0) as full:
+        full["w"] = new_value        # host-side surgery
+    # on exit, edits are re-scattered into the original shardings via
+    # .updated (or in place if a setter callback was given)
+    """
+
+    def __init__(self, params: Any, modifier_rank: Optional[int] = None,
+                 mesh_ctx: Optional[MeshContext] = None,
+                 on_exit: Optional[Callable[[Any], None]] = None):
+        self.params = params
+        self.modifier_rank = modifier_rank
+        self.mesh_ctx = mesh_ctx or get_mesh_context()
+        self.on_exit = on_exit
+        self.updated: Optional[Any] = None
+        self._full = None
+
+    def __enter__(self):
+        # np.array on a sharded jax.Array performs the gather; copy=True
+        # yields writable host buffers for in-place surgery
+        self._full = jax.tree.map(
+            lambda l: np.array(l) if isinstance(l, jax.Array) else l,
+            self.params)
+        return self._full
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            return False
+        if self.modifier_rank is not None:
+            # re-scatter (possibly modified) values into original shardings
+            self.updated = jax.tree.map(
+                lambda full, orig: jax.device_put(
+                    jnp.asarray(full, dtype=orig.dtype), orig.sharding)
+                if isinstance(orig, jax.Array) else full,
+                self._full, self.params)
+            if self.on_exit is not None:
+                self.on_exit(self.updated)
+        return False
