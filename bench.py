@@ -9,10 +9,20 @@ vs_baseline: achieved model TFLOPS/chip divided by the reference's best
 published single-device number — BERT-large pretrain at 64 TFLOPS on 1xV100
 (BASELINE.md).  >1.0 means this framework extracts more absolute model FLOPs
 from one TPU chip than reference DeepSpeed did from one V100.
+
+Hardened per round-1 failure (BENCH_r01 rc=1 at first dispatch): backend init
+is retried with backoff, and ANY failure still emits a single diagnostic JSON
+line instead of a bare traceback.
+
+Ladder: `python bench.py --config {gpt2|bert_z2|decode}` selects other
+BASELINE.md anchor points; default is the flagship gpt2.
 """
 
+import argparse
 import json
+import sys
 import time
+import traceback
 
 import numpy as np
 
@@ -21,7 +31,48 @@ PEAK_TFLOPS = {"v5 lite": 197.0, "v5e": 197.0, "v4": 275.0, "v5p": 459.0,
                "v6e": 918.0}
 
 
-def main():
+def _init_backend(retries=4, delay=10.0):
+    """Initialize the JAX backend with retries (TPU tunnel can be flaky)."""
+    import jax
+
+    last = None
+    for attempt in range(retries):
+        try:
+            devs = jax.devices()
+            # force a real dispatch so 'backend up' means 'backend usable'
+            float(jax.jit(lambda x: x + 1)(jax.numpy.float32(1.0)))
+            return devs
+        except Exception as e:  # noqa: BLE001 — diagnose, retry
+            last = e
+            if attempt < retries - 1:
+                time.sleep(delay * (attempt + 1))
+    raise RuntimeError(f"backend init failed after {retries} tries: {last}")
+
+
+def _emit(payload):
+    print(json.dumps(payload))
+    sys.stdout.flush()
+
+
+def _peak_tflops():
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    return next((v for k, v in PEAK_TFLOPS.items() if k in kind), 197.0)
+
+
+def _time_steps(step, warmup=3, iters=30):
+    for _ in range(warmup):
+        loss = step()
+    float(loss)  # scalar fetch — the only reliable sync through the tunnel
+    t0 = time.time()
+    for _ in range(iters):
+        loss = step()
+    final_loss = float(loss)  # forces the whole dependent chain
+    return time.time() - t0, final_loss, iters
+
+
+def bench_gpt2():
     import jax
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import GPT2Config, GPT2Model
@@ -54,23 +105,11 @@ def main():
         engine.step()
         return loss
 
-    for _ in range(3):  # compile + warm up
-        loss = step()
-    float(loss)  # scalar fetch — the only reliable sync through the tunnel
-
-    n = 30
-    t0 = time.time()
-    for _ in range(n):
-        loss = step()
-    final_loss = float(loss)  # forces the whole dependent chain
-    dt = time.time() - t0
-
+    dt, final_loss, n = _time_steps(step)
     tokens_per_sec = n * batch * seq / dt
     tflops = tokens_per_sec * cfg.flops_per_token() / 1e12
-    kind = jax.devices()[0].device_kind.lower()
-    peak = next((v for k, v in PEAK_TFLOPS.items() if k in kind), 197.0)
-
-    print(json.dumps({
+    peak = _peak_tflops()
+    return {
         "metric": "gpt2_124m_train_tokens_per_sec_1chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
@@ -78,7 +117,117 @@ def main():
         "tflops_per_chip": round(tflops, 2),
         "mfu": round(tflops / peak, 4),
         "final_loss": round(final_loss, 4),
-    }))
+    }
+
+
+def bench_bert_z2():
+    """BERT-large-class encoder, ZeRO-2, seq128 — BASELINE.md anchor row."""
+    import jax
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import BertConfig, BertModel
+
+    batch, seq = 32, 128
+    cfg = BertConfig(max_position_embeddings=seq, hidden_size=1024,
+                     num_layers=24, num_heads=16, bf16=True)
+    model = BertModel(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+
+    config = {
+        "train_micro_batch_size_per_gpu": batch,
+        "optimizer": {"type": "Lamb", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config,
+                                    model_parameters=params)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32)
+    labels = ids  # full-position MLM — throughput accounting only
+
+    def step():
+        loss = engine.forward(ids, labels)
+        engine.backward(loss)
+        engine.step()
+        return loss
+
+    dt, final_loss, n = _time_steps(step)
+    samples_per_sec = n * batch / dt
+    tflops = n * batch * seq * cfg.flops_per_token(seq) / dt / 1e12
+    return {
+        "metric": "bert_large_z2_samples_per_sec_1chip",
+        "value": round(samples_per_sec, 1),
+        "unit": "samples/s",
+        "vs_baseline": round(samples_per_sec / 272.0, 3),  # ref: 272 s/s V100
+        "tflops_per_chip": round(tflops, 2),
+        "mfu": round(tflops / _peak_tflops(), 4),
+        "final_loss": round(final_loss, 4),
+    }
+
+
+def bench_decode():
+    """Inference decode tokens/s on GPT-2 124M (KV-cache scan decode)."""
+    import jax
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT2Config, GPT2Model
+
+    batch, prompt, gen = 8, 128, 128
+    cfg = GPT2Config(n_positions=prompt + gen, bf16=True)
+    model = GPT2Model(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    engine = ds.init_inference(model=model, model_parameters=params,
+                               dtype="bf16")
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, size=(batch, prompt)).astype(np.int32)
+
+    out = engine.generate(ids, max_new_tokens=gen)  # compile
+    np.asarray(out)
+    t0 = time.time()
+    iters = 5
+    for _ in range(iters):
+        out = engine.generate(ids, max_new_tokens=gen)
+    np.asarray(out)
+    dt = time.time() - t0
+    tps = iters * batch * gen / dt
+    return {
+        "metric": "gpt2_124m_decode_tokens_per_sec_1chip",
+        "value": round(tps, 1),
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,  # no reference decode anchor on this hw class
+        "batch": batch, "prompt": prompt, "gen": gen,
+    }
+
+
+BENCHES = {"gpt2": bench_gpt2, "bert_z2": bench_bert_z2,
+           "decode": bench_decode}
+METRIC_NAMES = {  # error-path metric must match the success-path name
+    "gpt2": ("gpt2_124m_train_tokens_per_sec_1chip", "tokens/s"),
+    "bert_z2": ("bert_large_z2_samples_per_sec_1chip", "samples/s"),
+    "decode": ("gpt2_124m_decode_tokens_per_sec_1chip", "tokens/s"),
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="gpt2", choices=sorted(BENCHES))
+    args = ap.parse_args()
+    try:
+        devs = _init_backend()
+        payload = BENCHES[args.config]()
+        payload["platform"] = devs[0].platform
+        payload["device_kind"] = devs[0].device_kind
+        _emit(payload)
+    except Exception as e:  # noqa: BLE001 — contract: always one JSON line
+        metric, unit = METRIC_NAMES[args.config]
+        _emit({
+            "metric": metric,
+            "value": 0.0,
+            "unit": unit,
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}",
+            "traceback_tail": traceback.format_exc()[-2000:],
+        })
+        sys.exit(0)  # diagnostic JSON emitted; don't mask it with rc!=0
 
 
 if __name__ == "__main__":

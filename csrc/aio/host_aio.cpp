@@ -117,7 +117,8 @@ class AioHandle {
   int Wait() {
     std::unique_lock<std::mutex> lk(mu_);
     done_cv_.wait(lk, [this] { return inflight_ == 0; });
-    int rc = first_error_.load();
+    int rc = first_error_.exchange(0);  // clear: one failed batch must not
+                                        // poison every later Wait()
     int completed = completed_requests_;
     completed_requests_ = 0;
     inflight_requests_ = 0;

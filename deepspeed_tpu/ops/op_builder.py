@@ -16,11 +16,24 @@ the filename makes staleness detection automatic.
 import ctypes
 import hashlib
 import os
+import platform
 import subprocess
 import tempfile
 from typing import Dict, List, Optional
 
 from ..utils.logging import logger
+
+def _cpu_identity() -> str:
+    """CPU model + ISA flags (what -march=native actually binds to)."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("model name", "flags", "Features")):
+                    return line.strip()
+    except OSError:
+        pass
+    return platform.processor() or "unknown-cpu"
+
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -72,6 +85,10 @@ class OpBuilder:
             with open(src, "rb") as f:
                 h.update(f.read())
         h.update(" ".join(self.cxx_flags() + self.ldflags()).encode())
+        # -march=native makes the artifact CPU-specific: key it on the CPU
+        # identity so a binary built elsewhere is never loaded (SIGILL risk)
+        h.update(platform.machine().encode())
+        h.update(_cpu_identity().encode())
         return h.hexdigest()[:16]
 
     def lib_path(self) -> str:

@@ -108,23 +108,29 @@ def onebit_lamb(learning_rate, b1: float = 0.9, b2: float = 0.999,
 
     def update(grads, state, params=None):
         updates, new_state = base.update(grads, state, params)
+        if params is None:
+            return updates, new_state
+        lr = (learning_rate(state.count)
+              if callable(learning_rate) else learning_rate)
+        lr = jnp.asarray(lr, jnp.float32)
+        if weight_decay > 0:
+            # decoupled decay enters before the trust ratio (LAMB):
+            # update = -lr*(adam_step + wd*p); base holds -lr*adam_step
+            updates = jax.tree.map(
+                lambda u, p: u - lr * weight_decay * p, updates, params)
 
         def trust(u, p):
+            # The trust ratio is defined on the RAW Adam step (reference
+            # onebit/lamb.py:232-249) — u holds -lr*step, so divide lr back
+            # out of the norm or lr cancels out of the update entirely.
             p_norm = jnp.linalg.norm(p.reshape(-1))
-            u_norm = jnp.linalg.norm(u.reshape(-1))
+            raw_norm = (jnp.linalg.norm(u.reshape(-1)) /
+                        jnp.maximum(lr, 1e-30))
             ratio = jnp.where(
-                (p_norm > 0) & (u_norm > 0),
-                jnp.clip(p_norm / u_norm, min_trust, max_trust), 1.0)
+                (p_norm > 0) & (raw_norm > 0),
+                jnp.clip(p_norm / raw_norm, min_trust, max_trust), 1.0)
             return u * ratio
-        if params is not None:
-            if weight_decay > 0:
-                # decoupled decay enters before the trust ratio (LAMB):
-                # update = -lr*(adam_step + wd*p); base holds -lr*adam_step
-                lr = (learning_rate(state.count)
-                      if callable(learning_rate) else learning_rate)
-                updates = jax.tree.map(
-                    lambda u, p: u - lr * weight_decay * p, updates, params)
-            updates = jax.tree.map(trust, updates, params)
+        updates = jax.tree.map(trust, updates, params)
         return updates, new_state
 
     return optax.GradientTransformation(init, update)

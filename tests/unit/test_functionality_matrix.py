@@ -14,6 +14,9 @@ import deepspeed_tpu as ds
 from deepspeed_tpu.models import GPT2Config, GPT2Model
 
 
+GLOBAL_BATCH = 8  # fixed across every cell — tp changes dp, never the data
+
+
 def _train(zero_stage: int, tp: int, offload: bool, steps: int = 3):
     ds.reset_mesh_context()
     mesh = ds.initialize_mesh(data=-1, model=tp)
@@ -21,8 +24,13 @@ def _train(zero_stage: int, tp: int, offload: bool, steps: int = 3):
                      num_layers=2, num_heads=4, bf16=False, embd_dropout=0.0,
                      attn_dropout=0.0, hidden_dropout=0.0)
     model = GPT2Model(cfg)
+    dp = mesh.data_parallel_world_size
+    assert GLOBAL_BATCH % dp == 0
     conf = {
-        "train_micro_batch_size_per_gpu": 1,
+        # hold the GLOBAL batch constant so every matrix cell trains on
+        # identical data (round-1 bug: per-chip batch was held fixed, so
+        # tp=2 cells saw a different batch and diverged from the baseline)
+        "train_micro_batch_size_per_gpu": GLOBAL_BATCH // dp,
         "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
         "zero_optimization": {"stage": zero_stage},
         "steps_per_print": 10 ** 9,
@@ -33,9 +41,8 @@ def _train(zero_stage: int, tp: int, offload: bool, steps: int = 3):
         model=model, config=conf,
         model_parameters=model.init_params(jax.random.PRNGKey(0)),
         mesh=mesh, rng=jax.random.PRNGKey(42))
-    dp = mesh.data_parallel_world_size
-    ids = np.asarray(jax.random.randint(jax.random.PRNGKey(1), (dp, 32),
-                                        0, 128), np.int32)
+    ids = np.asarray(jax.random.randint(jax.random.PRNGKey(1),
+                                        (GLOBAL_BATCH, 32), 0, 128), np.int32)
     losses = []
     for _ in range(steps):
         loss = engine.forward(ids)
@@ -49,6 +56,7 @@ def _train(zero_stage: int, tp: int, offload: bool, steps: int = 3):
 
 MATRIX = [
     (0, 1, False), (1, 1, False), (2, 1, False), (3, 1, False),
+    (0, 2, False),  # pure TP vs TP=1 — validates TP is math-preserving
     (2, 2, False), (3, 2, False), (2, 1, True), (3, 2, True),
 ]
 

@@ -106,8 +106,9 @@ def test_memory_estimators():
     assert z3["per_chip_hbm_bytes"] < z2["per_chip_hbm_bytes"]
     assert z3_off["per_chip_hbm_bytes"] < z3["per_chip_hbm_bytes"]
     assert z3_off["per_chip_host_bytes"] > 0
-    # stage-3 at 8 chips: everything ~1/8th => well under 2*params bytes
-    assert z3["per_chip_hbm_bytes"] < 2 * n
+    # stage-3 at 8 chips: 16 bytes/param / 8 chips * 1.5 buffer factor
+    # (additional_buffer_factor, runtime/utils.py) = 3.0 bytes/param
+    assert z3["per_chip_hbm_bytes"] < 2 * n * 1.5 + 1
 
 
 def test_see_memory_usage_runs():
