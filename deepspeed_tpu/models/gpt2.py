@@ -90,6 +90,14 @@ class GPT2Model:
     def __init__(self, config: GPT2Config):
         self.config = config
         self.layer = DeepSpeedTransformerLayer(config.layer_config())
+        self._zero3_stream = None
+
+    def install_zero3_streaming(self, stream_ctx) -> None:
+        """Engine hook: route the layer-stack scan through the explicit
+        ZeRO-3 gather/prefetch executor (runtime/zero/stage3_streaming.py —
+        the stage3_max_live_parameters / stage3_prefetch_bucket_size
+        consumer; reference stage3.py:294 PartitionedParameterCoordinator)."""
+        self._zero3_stream = stream_ctx
 
     # -- parameters ---------------------------------------------------- #
     def init_params(self, rng):
@@ -175,11 +183,25 @@ class GPT2Model:
                 (1.0 - jnp.float32(pld_theta))
             pld_keys = jax.random.split(r_pld, n)
 
+        stream = self._zero3_stream
+        # _usable also covers the post-engine life of the model object
+        # (stale mesh, batch-1 decode) — must agree with stream.scan's own
+        # gate because the body folds lax.axis_index only inside the manual
+        # region.
+        streaming = stream is not None and stream._usable(h, 0)
+
         def body(carry, xs):
             if use_pld:
                 layer_params, layer_rng, keep_p, pld_key = xs
             else:
                 layer_params, layer_rng = xs
+            if streaming and not deterministic:
+                # Inside the manual ZeRO region every shard sees the same
+                # layer rng; fold in the shard index so dropout masks stay
+                # independent across the batch shards.
+                for ax in sorted(stream.manual):
+                    layer_rng = jax.random.fold_in(
+                        layer_rng, jax.lax.axis_index(ax))
             out = layer_fn(layer_params, carry, rng=layer_rng,
                            deterministic=deterministic)
             if use_pld:
@@ -191,9 +213,13 @@ class GPT2Model:
             body = jax.checkpoint(body)
 
         layer_rngs = jax.random.split(r_layers, n)
-        xs = ((params["h"], layer_rngs, keep_probs, pld_keys) if use_pld
-              else (params["h"], layer_rngs))
-        h, _ = jax.lax.scan(body, h, xs)
+        extras = ((layer_rngs, keep_probs, pld_keys) if use_pld
+                  else (layer_rngs,))
+        if streaming:
+            h = stream.scan(body, h, params["h"], extras,
+                            param_tp_specs=self.param_partition_specs()["h"])
+        else:
+            h, _ = jax.lax.scan(body, h, (params["h"],) + extras)
         return h
 
     def logits(self, params, input_ids, rng=None, deterministic=False,

@@ -146,6 +146,21 @@ class DeepSpeedEngine:
         self.grad_shardings = self.zero_partitioner.grad_shardings(
             model_parameters, self.param_specs)
 
+        # ZeRO-3 explicit streaming: stacked-layer models route their layer
+        # scan through the gather/prefetch executor so
+        # stage3_max_live_parameters / stage3_prefetch_bucket_size are
+        # consumed for real (reference: stage3.py:294
+        # PartitionedParameterCoordinator; see zero/stage3_streaming.py).
+        self._zero3_stream = None
+        if stage >= 3 and hasattr(model, "install_zero3_streaming"):
+            from .zero.stage3_streaming import Zero3StreamContext
+            self._zero3_stream = Zero3StreamContext(
+                self.mesh_ctx,
+                self.config.zero_config.max_live_parameters,
+                self.config.zero_config.prefetch_bucket_size,
+                self.config.zero_config.param_persistence_threshold)
+            model.install_zero3_streaming(self._zero3_stream)
+
         # ZeRO-Offload: optimizer states (and the fp32 master) live in host
         # DRAM, stepped by the native host Adam; the device holds only
         # compute-dtype params (reference: stage2.py:976-1125 cpu_offload).

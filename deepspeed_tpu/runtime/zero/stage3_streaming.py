@@ -1,0 +1,319 @@
+"""ZeRO-3 explicit parameter streaming — gather-at-use with live-set control.
+
+Reference semantics: stage3.py's PartitionedParameterCoordinator (:294) keeps
+at most ``stage3_max_live_parameters`` gathered at once, prefetches the next
+``stage3_prefetch_bucket_size`` elements ahead of use (PrefetchCoordinator
+:169), and releases each submodule's params after use (:460).  The reference
+implements this with per-module torch hooks and hand-scheduled NCCL
+all-gathers.
+
+TPU recasting: for stacked-layer models (leaves ``[L, ...]`` scanned with
+``lax.scan``), the live-set control is a *program structure*, not a hook
+protocol.  The layer stack runs inside a partial-manual ``jax.shard_map``
+over the ZeRO ("data","expert") axes:
+
+  - each scan step ``lax.all_gather``\\ s exactly one layer group's shards
+    (tiled) — the gather-at-use of stage3.py:522 ``_all_gather``;
+  - when the scan step ends, XLA frees the gathered buffer — the release of
+    stage3.py:460 ``release_sub_module``;
+  - the group size is chosen so ``layers_per_step × params_per_layer ≤
+    stage3_max_live_parameters`` — max-live honored by construction;
+  - with prefetch enabled (``stage3_prefetch_bucket_size > 0``) the scan
+    carries a double buffer: the gather for group ``i+1`` is issued before
+    group ``i``'s compute, so XLA's latency-hiding scheduler overlaps
+    communication with the MXU work — the role of PrefetchCoordinator's
+    trace-based lookahead, without needing a trace (the scan order IS the
+    trace);
+  - the backward of a tiled all-gather over the ZeRO axes is a
+    psum-scatter: layer gradients leave the region already reduce-scattered
+    to their owner shard (stage3.py:1908 grad partitioning, for free).
+
+Tensor-parallel ("model") and any other non-ZeRO axes stay *automatic*
+(GSPMD) inside the region — explicit ZeRO streaming composes with
+declarative TP.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+from jax import lax
+from jax.ad_checkpoint import checkpoint_name
+from jax.sharding import PartitionSpec
+
+from ...parallel.mesh import MeshContext, ZERO_AXES
+from ...utils.logging import log_dist
+from .partition import zero_partition_spec
+
+
+@dataclass(frozen=True)
+class StreamPlan:
+    """How the layer stack is grouped and prefetched."""
+    layers_per_step: int
+    prefetch: bool
+    num_layers: int
+    params_per_layer: int
+
+    @property
+    def live_parameters(self) -> int:
+        """Worst-case simultaneously-gathered parameter count."""
+        mult = 2 if self.prefetch else 1
+        return mult * self.layers_per_step * self.params_per_layer
+
+
+def _largest_divisor_at_most(n: int, bound: int) -> int:
+    bound = max(1, min(n, bound))
+    for g in range(bound, 0, -1):
+        if n % g == 0:
+            return g
+    return 1
+
+
+def plan_layer_streaming(num_layers: int, params_per_layer: int,
+                         max_live_parameters: int,
+                         prefetch_bucket_size: int) -> StreamPlan:
+    """Consume the stage-3 knobs into a concrete (group, prefetch) plan.
+
+    ``stage3_max_live_parameters`` bounds the gathered set (reference
+    zero/config.py ``max_live_parameters``); ``stage3_prefetch_bucket_size``
+    enables lookahead when it covers at least one more layer group.
+    """
+    per_group_budget = max(1, int(max_live_parameters) // max(
+        1, params_per_layer))
+    prefetch = int(prefetch_bucket_size) >= params_per_layer
+    if prefetch:
+        if per_group_budget < 2:
+            # budget can't hold current + prefetched group: honoring
+            # max_live wins over lookahead
+            prefetch = False
+        else:
+            per_group_budget //= 2  # live set holds current + prefetched
+    g = _largest_divisor_at_most(num_layers, per_group_budget)
+    if prefetch and num_layers // g < 2:
+        prefetch = False  # nothing left to look ahead to
+    return StreamPlan(layers_per_step=g, prefetch=prefetch,
+                      num_layers=num_layers, params_per_layer=params_per_layer)
+
+
+def _restrict_to_manual(spec: PartitionSpec, manual: frozenset
+                        ) -> PartitionSpec:
+    """Strip non-manual axes from a spec (shard_map in_specs may only name
+    manual axes; auto axes ride along on the array sharding)."""
+    parts = []
+    for entry in spec:
+        if entry is None:
+            parts.append(None)
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        kept = tuple(a for a in axes if a in manual)
+        parts.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+    return PartitionSpec(*parts)
+
+
+def _gather_dims(spec: PartitionSpec, manual: frozenset):
+    """[(dim, (axes...)), ...] — where tiled all-gathers must run."""
+    out = []
+    for dim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        kept = tuple(a for a in axes if a in manual)
+        if kept:
+            out.append((dim, kept))
+    return out
+
+
+class Zero3StreamContext:
+    """Installable streaming executor for stacked-layer models.
+
+    The engine builds one of these when zero stage 3 runs with explicit
+    gathering, and hands it to the model via ``install_zero3_streaming``.
+    The model then calls :meth:`scan` instead of ``lax.scan`` for its layer
+    stack; everything else about the model is unchanged.
+    """
+
+    def __init__(self, mesh_ctx: MeshContext, max_live_parameters: int,
+                 prefetch_bucket_size: int,
+                 persistence_threshold: int = 0):
+        self.ctx = mesh_ctx
+        self.max_live_parameters = int(max_live_parameters)
+        self.prefetch_bucket_size = int(prefetch_bucket_size)
+        self.persistence_threshold = int(persistence_threshold)
+        self.axis_sizes = {a: mesh_ctx.axis_size(a) for a in ZERO_AXES}
+        self.manual = frozenset(
+            a for a in ZERO_AXES if mesh_ctx.axis_size(a) > 1)
+        self._plan_logged = False
+
+    @property
+    def active(self) -> bool:
+        """Streaming is a no-op on a 1-way ZeRO mesh."""
+        return bool(self.manual)
+
+    def _usable(self, init_carry, carry_batch_dim: int) -> bool:
+        """Fall back to a plain scan when streaming cannot apply: 1-way
+        ZeRO mesh, the global mesh has moved on since install (the model
+        object outlives the engine — e.g. reused for inference), or the
+        batch doesn't divide the ZeRO world (batch-1 decode)."""
+        if not self.active:
+            return False
+        from ...parallel import mesh as mesh_mod
+        cur = mesh_mod.get_mesh_context(required=False)
+        if cur is None or cur.mesh is not self.ctx.mesh:
+            return False
+        zero_world = int(np.prod([self.axis_sizes[a] for a in self.manual]))
+        for leaf in jax.tree.leaves(init_carry):
+            shape = getattr(leaf, "shape", ())
+            if len(shape) <= carry_batch_dim or \
+                    shape[carry_batch_dim] % zero_world != 0:
+                return False
+        return True
+
+    # ------------------------------------------------------------------ #
+    def _per_layer_zero_spec(self, leaf, tp_spec: Optional[PartitionSpec]
+                             ) -> PartitionSpec:
+        """ZeRO spec of ONE layer's slice (shape ``leaf.shape[1:]``) — the
+        same decision function as ZeroPartitioner (partition.py), applied
+        per-layer so the stream always shards within a layer and never
+        across the layer axis (a layer-axis shard could not be gathered
+        one group at a time).  When the engine's stacked-tree placement
+        picked a different dim, shard_map simply reshards at entry."""
+        tp_inner = (PartitionSpec(*list(tp_spec)[1:])
+                    if tp_spec is not None else None)
+        return zero_partition_spec(tuple(leaf.shape[1:]), self.axis_sizes,
+                                   self.persistence_threshold, tp_inner)
+
+    def plan_for(self, stacked_params: Any) -> StreamPlan:
+        leaves = jax.tree.leaves(stacked_params)
+        num_layers = int(leaves[0].shape[0])
+        per_layer = sum(
+            int(np.prod(l.shape[1:])) for l in leaves)
+        return plan_layer_streaming(num_layers, per_layer,
+                                    self.max_live_parameters,
+                                    self.prefetch_bucket_size)
+
+    # ------------------------------------------------------------------ #
+    def scan(self, body, init_carry, stacked_params: Any, extra_xs: Any,
+             param_tp_specs: Any = None, carry_batch_dim: int = 0):
+        """Drop-in for ``lax.scan(body, init, (params, *extras))`` where
+        ``body(carry, (layer_params, *layer_extras)) -> (carry, None)``.
+
+        stacked_params: pytree of ``[L, ...]`` leaves to ZeRO-stream.
+        extra_xs: pytree of ``[L, ...]`` leaves passed through replicated
+        (layer RNGs, PLD keep-probabilities, ...).
+        param_tp_specs: optional matching tree of tensor-parallel
+        PartitionSpecs for the stacked leaves (layer axis included).
+        carry_batch_dim: dimension of each carry leaf sharded over the ZeRO
+        axes (the batch dimension).
+        """
+        if not self._usable(init_carry, carry_batch_dim):
+            carry, _ = lax.scan(
+                lambda c, xs: body(c, xs),
+                init_carry, (stacked_params,) + tuple(extra_xs))
+            return carry
+
+        plan = self.plan_for(stacked_params)
+        if not self._plan_logged:
+            log_dist(
+                f"ZeRO-3 streaming: {plan.num_layers} layers in groups of "
+                f"{plan.layers_per_step}, prefetch={plan.prefetch}, "
+                f"live<= {plan.live_parameters:,} params "
+                f"(max_live={self.max_live_parameters:,})", ranks=[0])
+            self._plan_logged = True
+
+        mesh = self.ctx.mesh
+        manual = self.manual
+        g = plan.layers_per_step
+        steps = plan.num_layers // g
+
+        # -- sharding specs for every shard_map operand ----------------- #
+        if param_tp_specs is None:
+            param_tp_specs = jax.tree.map(lambda _: None, stacked_params)
+        tp_list = jax.tree.leaves(
+            param_tp_specs,
+            is_leaf=lambda x: x is None or isinstance(x, PartitionSpec))
+        p_leaves, p_tree = jax.tree_util.tree_flatten(stacked_params)
+        if len(tp_list) != len(p_leaves):
+            raise ValueError("param_tp_specs must mirror stacked_params")
+        inner_specs = [self._per_layer_zero_spec(l, s)
+                       for l, s in zip(p_leaves, tp_list)]
+        in_param_specs = [
+            PartitionSpec(None, *list(_restrict_to_manual(s, manual)))
+            for s in inner_specs]
+        gathers = [_gather_dims(s, manual) for s in inner_specs]
+
+        def group_leaf(leaf):
+            return leaf.reshape((steps, g) + tuple(leaf.shape[1:]))
+
+        grouped_params = [group_leaf(l) for l in p_leaves]
+        grouped_extras = jax.tree.map(group_leaf, extra_xs)
+        # the group reshape shifts every dim by one: shift specs too
+        def shift(spec):
+            return PartitionSpec(None, *list(spec))
+        in_specs_params = [shift(s) for s in in_param_specs]
+
+        carry_spec = jax.tree.map(
+            lambda c: PartitionSpec(
+                *([None] * carry_batch_dim),
+                tuple(sorted(manual, key=ZERO_AXES.index))),
+            init_carry)
+        extras_specs = jax.tree.map(lambda _: PartitionSpec(), grouped_extras)
+
+        def gather_group(shards):
+            """all-gather one layer group's param shards into full arrays.
+            The +1 dim shift accounts for the group dimension.  Gathered
+            values are checkpoint-named so the step's remat policy DROPS
+            them from the saved residuals: without this, lax.scan's VJP
+            would stack every step's gathered group — the full unsharded
+            model — as a residual, defeating max_live entirely.  Backward
+            re-gathers instead (exactly the reference's backward re-fetch,
+            stage3.py:546 PreBackwardFunction)."""
+            full = []
+            for leaf, dims in zip(shards, gathers):
+                for dim, axes in dims:
+                    leaf = lax.all_gather(leaf, axes, axis=dim + 1,
+                                          tiled=True)
+                full.append(checkpoint_name(leaf, "zero3_gathered"))
+            return full
+
+        def run_group(carry, full_group, extras_group):
+            """Unrolled pass over the g layers inside one gathered group."""
+            for j in range(g):
+                layer = p_tree.unflatten(
+                    [l[j] for l in full_group])
+                extras_j = jax.tree.map(lambda e: e[j], extras_group)
+                carry, _ = body(carry, (layer,) + tuple(extras_j))
+            return carry
+
+        def step(c, xs):
+            shards, extras_g = xs
+            full = gather_group(shards)
+            return run_group(c, full, extras_g), None
+
+        # Save every intermediate EXCEPT the gathered params: activations
+        # are stored as usual (no recompute tax), only the all-gathers rerun
+        # in backward.
+        step = jax.checkpoint(
+            step, policy=jax.checkpoint_policies.save_anything_except_these_names(
+                "zero3_gathered"))
+
+        # Prefetch = unroll-2 over groups: the two gathers in the unrolled
+        # loop body are independent of each other's compute, so XLA
+        # schedules gather(i+1) alongside compute(i) — the
+        # PrefetchCoordinator's lookahead (stage3.py:169) as a loop
+        # structure.  (A carried double buffer would re-introduce the full
+        # gathered stack as a scan residual.)
+        unroll = 2 if plan.prefetch and steps % 2 == 0 else 1
+
+        def region_fn(carry, params_grouped, extras_grouped):
+            carry, _ = lax.scan(
+                step, carry, (params_grouped, extras_grouped),
+                unroll=unroll)
+            return carry
+
+        streamed = jax.shard_map(
+            region_fn, mesh=mesh,
+            in_specs=(carry_spec, in_specs_params, extras_specs),
+            out_specs=carry_spec, axis_names=set(manual))
+        return streamed(init_carry, grouped_params, grouped_extras)
