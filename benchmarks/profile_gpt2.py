@@ -4,7 +4,11 @@ Times isolated variants of the flagship bench to locate the bottleneck:
 full engine step vs no-dropout vs no-LM-head vs matmul roofline.
 """
 
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -41,8 +45,6 @@ def main():
     tx = optax.adamw(6e-4, weight_decay=0.1)
     opt_state = tx.init(params)
 
-    def cast(p):
-        return jax.tree.map(lambda x: x.astype(jnp.bfloat16), p)
 
     # --- full train step, with dropout (bench equivalent) -------------- #
     @jax.jit
@@ -100,12 +102,12 @@ def main():
 
     @jax.jit
     def mm(a, b):
-        for _ in range(64):
+        for _ in range(8):
             a = jax.lax.dot(a, b)
         return a
 
-    t = timeit("matmul roofline (64x 8192x4096x4096)", mm, a, b)
-    tf = 64 * 2 * 8192 * 4096 * 4096 / t / 1e12
+    t = timeit("matmul roofline (8x 8192x4096x4096)", mm, a, b)
+    tf = 8 * 2 * 8192 * 4096 * 4096 / t / 1e12
     print(f"    -> {tf:.1f} TFLOPS achievable")
 
     flops = BATCH * SEQ * cfg.flops_per_token()

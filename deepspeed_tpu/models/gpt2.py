@@ -184,11 +184,11 @@ class GPT2Model:
             pld_keys = jax.random.split(r_pld, n)
 
         stream = self._zero3_stream
-        # _usable also covers the post-engine life of the model object
-        # (stale mesh, batch-1 decode) — must agree with stream.scan's own
-        # gate because the body folds lax.axis_index only inside the manual
+        # usable() also covers the post-engine life of the model object
+        # (stale mesh, batch-1 decode); it is the same predicate scan gates
+        # on internally, so the fold below only runs inside the manual
         # region.
-        streaming = stream is not None and stream._usable(h, 0)
+        streaming = stream is not None and stream.usable(h)
 
         def body(carry, xs):
             if use_pld:
@@ -199,9 +199,7 @@ class GPT2Model:
                 # Inside the manual ZeRO region every shard sees the same
                 # layer rng; fold in the shard index so dropout masks stay
                 # independent across the batch shards.
-                for ax in sorted(stream.manual):
-                    layer_rng = jax.random.fold_in(
-                        layer_rng, jax.lax.axis_index(ax))
+                layer_rng = stream.fold_shard_index(layer_rng)
             out = layer_fn(layer_params, carry, rng=layer_rng,
                            deterministic=deterministic)
             if use_pld:

@@ -471,11 +471,26 @@ class DeepSpeedEngine:
         prescale = self.config.prescale_gradients
         predivide = self.config.gradient_predivide_factor
 
+        custom_grad_program = getattr(self, "_custom_grad_program", None)
+
         def loss_and_grads(params, scaler_state, rng, *args, **kwargs):
             # inputs follow the compute dtype too — otherwise f32 activations
             # silently promote every matmul back to f32 and the MXU runs fp32
             args = _tree_cast(args, compute_dtype)
             kwargs = _tree_cast(kwargs, compute_dtype)
+
+            if custom_grad_program is not None:
+                # Hand-scheduled differentiation (1F1B pipeline executor):
+                # the program computes loss AND grads itself — fwd/bwd are
+                # interleaved per tick and cannot be split into jax's
+                # forward-then-backward phases without losing the 1F1B
+                # memory bound.
+                cp = _tree_cast(params, compute_dtype)
+                loss, grads = custom_grad_program(
+                    cp, scaler_state.loss_scale, rng, *args, **kwargs)
+                if prescale and predivide:
+                    grads = jax.tree.map(lambda g: g / predivide, grads)
+                return loss, grads
 
             def loss_fn(p):
                 cp = _tree_cast(p, compute_dtype)

@@ -66,7 +66,8 @@ class PipelineEngine(DeepSpeedEngine):
 
     def __init__(self, model: PipelineModule, config=None, optimizer=None,
                  lr_scheduler=None, mesh=None, mpu=None, training_data=None,
-                 collate_fn=None, rng=None, example_input=None):
+                 collate_fn=None, rng=None, example_input=None,
+                 schedule=None):
         assert isinstance(model, PipelineModule), \
             "PipelineEngine needs a PipelineModule"
         ctx = resolve_mesh_ctx(config, mesh)
@@ -104,6 +105,20 @@ class PipelineEngine(DeepSpeedEngine):
                     "init requires shapes up front")
         pipeline_params = model.build(build_rng, example_input)
 
+        # schedule selection: kwarg > config "pipeline" block > 1F1B default
+        # (the reference always trains with TrainSchedule — pipe/engine.py:287)
+        if schedule is None:
+            raw = getattr(cfg, "_param_dict", {}) or {}
+            schedule = (raw.get("pipeline") or {}).get("schedule", "1f1b")
+        if schedule not in ("1f1b", "gpipe"):
+            raise ValueError(
+                f"pipeline schedule must be '1f1b' or 'gpipe', got "
+                f"{schedule!r}")
+        self.schedule_kind = schedule
+        if schedule == "1f1b":
+            # hand-scheduled fwd/bwd interleave: the base engine compiles
+            # this program directly instead of value_and_grad
+            self._custom_grad_program = self._make_1f1b_program(ctx)
         apply_fn = self._make_pipelined_apply(ctx, deterministic=False)
         self._eval_apply = self._make_pipelined_apply(ctx, deterministic=True)
         specs = self._make_partition_specs(pipeline_params)
@@ -155,6 +170,65 @@ class PipelineEngine(DeepSpeedEngine):
             blocks = jax.tree.map(lambda _: PartitionSpec(PIPE_AXIS),
                                   pipeline_params["blocks"])
         return {"pre": None, "blocks": blocks, "post": None, "tied": None}
+
+    # ------------------------------------------------------------------ #
+    def _make_1f1b_program(self, ctx):
+        """Build the 1F1B interleaved fwd/bwd program (one_f_one_b.py) —
+        the compiled execution of schedule.py's TrainSchedule."""
+        from .one_f_one_b import make_1f1b_grad_fn
+
+        module = self.pipeline_module
+        S = self.num_stages
+        M = self._micro_batches
+        lo, hi = module.body_range
+        n_layers = len(module.layer_specs)
+        body_layer = module.body_layer()
+        loss_fn = module.loss_fn
+        if loss_fn is None:
+            raise ValueError("PipelineModule.loss_fn is required for training")
+        mesh = ctx.mesh
+        k = (hi - lo) // S
+
+        def constrain(x, *spec):
+            return lax.with_sharding_constraint(
+                x, NamedSharding(mesh, PartitionSpec(*spec)))
+
+        def stage_apply(stage_params, x, mb, stage_idx, rng_base):
+            # dropout seeds keyed by (microbatch, global layer index) so the
+            # backward-lane remat replays the forward bit-exactly
+            def one_layer(carry, lp_j):
+                lp, j = lp_j
+                r = jax.random.fold_in(
+                    rng_base, mb * n_layers + lo + stage_idx * k + j)
+                return body_layer.apply(lp, carry, rng=r), None
+
+            x, _ = lax.scan(one_layer, x, (stage_params, jnp.arange(k)))
+            return x
+
+        def pre_apply(pre, tied, x_mb, mb, rng_pre):
+            return module.chain_apply(
+                range(lo), pre, tied, x_mb,
+                rng=jax.random.fold_in(rng_pre, mb))
+
+        def post_loss(post, tied, h, y_mb, mb, rng_post):
+            o = module.chain_apply(
+                range(hi, n_layers), post, tied, h,
+                rng=jax.random.fold_in(rng_post, mb))
+            return loss_fn(o, y_mb)
+
+        grad_fn = make_1f1b_grad_fn(
+            module=module, constrain=constrain, stage_apply=stage_apply,
+            pre_apply=pre_apply, post_loss=post_loss, micro_batches=M,
+            num_stages=S)
+
+        def program(params, loss_scale, rng, x, y):
+            xm = x.reshape((M, -1) + x.shape[1:])
+            ym = y.reshape((M, -1) + y.shape[1:])
+            xm = constrain(xm, None, (DATA_AXIS, EXPERT_AXIS))
+            ym = constrain(ym, None, (DATA_AXIS, EXPERT_AXIS))
+            return grad_fn(params, loss_scale, rng, xm, ym)
+
+        return program
 
     # ------------------------------------------------------------------ #
     def _make_pipelined_apply(self, ctx, deterministic=False):

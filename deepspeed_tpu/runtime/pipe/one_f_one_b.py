@@ -1,0 +1,353 @@
+"""1F1B pipeline executor — the TrainSchedule, compiled.
+
+Reference: deepspeed/runtime/pipe/engine.py:1209 `_exec_schedule` executes
+TrainSchedule's per-stage instruction stream (schedule.py:182) MPMD-style:
+each rank walks its own list of ForwardPass/BackwardPass/Send/Recv
+instructions.  The 1F1B property — a stage holds at most warmup+1 live
+activations regardless of the microbatch count — comes from each stage
+interleaving one backward between forwards.
+
+TPU/SPMD recasting, in two parts:
+
+1. `simulate_global_clock` *executes the schedule* (TrainSchedule's own
+   1F1B compute order) on a global clock with the physical dependencies
+   (activations arrive one tick after the upstream forward; cotangents one
+   tick after the downstream backward), producing static per-tick tables:
+   which (stage, microbatch) runs its forward and which runs its backward
+   at every tick.  schedule.py is the source of truth; the tables are its
+   compiled form.
+
+2. `make_1f1b_grad_fn` turns the tables into ONE jitted program: a
+   `lax.scan` over ticks where every tick runs a vmapped stage-forward lane
+   and a vmapped stage-backward lane (hand-rolled `jax.vjp`, rematerializing
+   the stage from its saved INPUT — so the rotating activation store holds
+   only `peak_s ≈ stages - s + 1` microbatch inputs per stage, never all M).
+   Activations/cotangents move between stages with `jnp.roll` on
+   pipe-sharded buffers (collective-permute over ICI) — the
+   SendActivation/RecvActivation/SendGrad/RecvGrad instruction pairs.
+   Gradients accumulate tick-by-tick in fp32 (masked on idle stages) —
+   BackwardPass + the final ReduceGrads is the psum XLA inserts from the
+   output shardings.
+
+Verified invariants (asserted by the simulator): cotangents always travel
+exactly one tick (roll transport is sufficient); the last stage's backward
+runs the same tick as its forward (the fresh loss cotangent is consumed
+in-tick); forward activations may wait several ticks at the steady-state
+boundary, hence the slot store rather than a roll for forward transport.
+"""
+
+from dataclasses import dataclass
+from typing import Callable
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...parallel.mesh import DATA_AXIS, EXPERT_AXIS, PIPE_AXIS
+from .schedule import TrainSchedule
+
+
+@dataclass
+class TickTables:
+    """Static per-tick execution tables: every array is [T, S]."""
+    num_ticks: int
+    num_stages: int
+    micro_batches: int
+    slot_counts: np.ndarray        # [S] rotating-store slots per stage
+    fwd_active: np.ndarray         # bool
+    fwd_mb: np.ndarray             # int (clipped valid)
+    fwd_slot: np.ndarray           # int
+    in_active: np.ndarray          # bool — inbound activation write
+    in_slot: np.ndarray            # int
+    bwd_active: np.ndarray         # bool
+    bwd_mb: np.ndarray             # int
+    bwd_slot: np.ndarray           # int
+
+    @property
+    def max_slots(self) -> int:
+        return int(self.slot_counts.max())
+
+
+def simulate_global_clock(micro_batches: int, stages: int) -> TickTables:
+    """Execute TrainSchedule's 1F1B compute order on a global clock.
+
+    Each tick offers every stage one forward lane and one backward lane;
+    a stage advances through its own schedule order (never reordering),
+    executing an op only when its data dependency is met:
+      - forward of (s, mb) needs stage s-1's forward of mb at an earlier
+        tick (activation rolls one stage per tick),
+      - backward of (s, mb) needs stage s+1's backward of mb at an earlier
+        tick; on the last stage it needs its own forward at this tick or
+        earlier (the loss cotangent is computed between the lanes).
+    """
+    M, S = micro_batches, stages
+    ops = {s: list(TrainSchedule(M, S, s)._compute_order()) for s in range(S)}
+    ptr = {s: 0 for s in range(S)}
+    fwd_done, bwd_done = {}, {}
+    rows = []
+    t = 0
+    while any(ptr[s] < len(ops[s]) for s in range(S)):
+        row_f, row_b = {}, {}
+        progressed = False
+        for s in range(S):
+            done_lane = {"fwd": False, "bwd": False}
+            while ptr[s] < len(ops[s]):
+                kind, mb = ops[s][ptr[s]]
+                if done_lane[kind]:
+                    break
+                if kind == "fwd":
+                    if not (s == 0 or fwd_done.get((s - 1, mb), t) < t):
+                        break
+                    fwd_done[(s, mb)] = t
+                    row_f[s] = mb
+                else:
+                    if s == S - 1:
+                        if fwd_done.get((s, mb), t + 1) > t:
+                            break
+                    elif not bwd_done.get((s + 1, mb), t) < t:
+                        break
+                    bwd_done[(s, mb)] = t
+                    row_b[s] = mb
+                done_lane[kind] = True
+                ptr[s] += 1
+                progressed = True
+        if not progressed:
+            raise RuntimeError(
+                f"1F1B schedule deadlock at tick {t} (M={M}, S={S})")
+        rows.append((row_f, row_b))
+        t += 1
+
+    # -- invariants the compiled transports rely on --------------------- #
+    for (s, mb), tt in bwd_done.items():
+        if s < S - 1:
+            assert tt == bwd_done[(s + 1, mb)] + 1, \
+                "cotangent roll transport needs exact 1-tick backward wave"
+        else:
+            assert tt == fwd_done[(s, mb)], \
+                "last stage must consume the loss cotangent in-tick"
+
+    # rotating-store capacity: max in-flight (fwd done, bwd pending) per
+    # stage, counting the tick the backward runs
+    # A slot is OCCUPIED from the tick its activation ARRIVES (the upstream
+    # forward's tick — the inbound wave writes at that tick's end; stage 0
+    # parks at its own forward tick) through the tick of the stage's
+    # backward read.  Capacity = peak simultaneous occupancy.
+    def arrive(s, mb):
+        return fwd_done[(s - 1, mb)] if s > 0 else fwd_done[(s, mb)]
+
+    slot_counts = np.zeros(S, np.int64)
+    for s in range(S):
+        peak = 0
+        for tt in range(t):
+            live = sum(1 for mb in range(M)
+                       if arrive(s, mb) <= tt <= bwd_done[(s, mb)])
+            peak = max(peak, live)
+        slot_counts[s] = max(peak, 1)
+    # Write-after-read safety: consecutive occupants of the same slot must
+    # satisfy arrive(next) >= bwd_read(prev) — the compiled tick reads the
+    # backward input before the inbound wave lands, so equality is safe.
+    for s in range(S):
+        by_slot = {}
+        for mb in range(M):
+            by_slot.setdefault(mb % slot_counts[s], []).append(mb)
+        for mbs in by_slot.values():
+            for m1, m2 in zip(mbs, mbs[1:]):
+                assert arrive(s, m2) >= bwd_done[(s, m1)], (
+                    f"slot reuse hazard: stage {s} mb {m2} arrives at tick "
+                    f"{arrive(s, m2)} before mb {m1}'s backward read at "
+                    f"{bwd_done[(s, m1)]}")
+
+    T = t
+    fwd_active = np.zeros((T, S), bool)
+    fwd_mb = np.zeros((T, S), np.int32)
+    bwd_active = np.zeros((T, S), bool)
+    bwd_mb = np.zeros((T, S), np.int32)
+    for tt, (row_f, row_b) in enumerate(rows):
+        for s, mb in row_f.items():
+            fwd_active[tt, s] = True
+            fwd_mb[tt, s] = mb
+        for s, mb in row_b.items():
+            bwd_active[tt, s] = True
+            bwd_mb[tt, s] = mb
+    fwd_slot = fwd_mb % slot_counts[None, :]
+    bwd_slot = bwd_mb % slot_counts[None, :]
+    # inbound wave: what stage s-1 forwards at tick t arrives at stage s at
+    # the end of tick t (consumed at t+1 or later from the slot store)
+    in_active = np.zeros((T, S), bool)
+    in_slot = np.zeros((T, S), np.int32)
+    in_active[:, 1:] = fwd_active[:, :-1]
+    in_slot[:, 1:] = fwd_mb[:, :-1] % slot_counts[None, 1:]
+    return TickTables(
+        num_ticks=T, num_stages=S, micro_batches=M, slot_counts=slot_counts,
+        fwd_active=fwd_active, fwd_mb=fwd_mb, fwd_slot=fwd_slot,
+        in_active=in_active, in_slot=in_slot,
+        bwd_active=bwd_active, bwd_mb=bwd_mb, bwd_slot=bwd_slot)
+
+
+def _mask_tree(active, tree):
+    return jax.tree.map(
+        lambda g: jnp.where(active, g, jnp.zeros_like(g)), tree)
+
+
+def make_1f1b_grad_fn(*, module, constrain, stage_apply: Callable,
+                      pre_apply: Callable, post_loss: Callable,
+                      micro_batches: int, num_stages: int
+                      ) -> Callable:
+    """Build `f(params, loss_scale, rng, xm, ym) -> (loss_sum, grads)`.
+
+    stage_apply(stage_params, x, mb, stage_idx, rng_base) -> y
+    pre_apply(pre, tied, x_mb, mb, rng_base) -> h           (embedding chain)
+    post_loss(post, tied, h_out, y_mb, mb, rng_base) -> loss (head chain)
+
+    All three must be deterministic in (mb, rng_base) so the backward-lane
+    rematerialization replays the forward bit-exactly (dropout seeds keyed
+    by microbatch, never by tick).
+    """
+    tables = simulate_global_clock(micro_batches, num_stages)
+    S, M, C = tables.num_stages, tables.micro_batches, tables.max_slots
+    tick_xs = jax.tree.map(
+        jnp.asarray, (
+            tables.fwd_active, tables.fwd_mb, tables.fwd_slot,
+            tables.in_active, tables.in_slot,
+            tables.bwd_active, tables.bwd_mb, tables.bwd_slot))
+
+    def bmask(flags, ref):
+        """[S] bool → broadcastable against [S, ...] ref."""
+        return flags.reshape((S,) + (1,) * (ref.ndim - 1))
+
+    def grad_fn(params, loss_scale, rng, xm, ym):
+        """xm: [M, Bg, ...] microbatched inputs; ym: [M, Bg, ...] labels."""
+        pre, blocks = params["pre"], params["blocks"]
+        post, tied = params["post"], params["tied"]
+        rng_pre, rng_post, rng_body = jax.random.split(rng, 3)
+
+        # probe the boundary activation shape abstractly (no runtime FLOPs)
+        h_shape = jax.eval_shape(
+            pre_apply, pre, tied, jax.tree.map(lambda a: a[0], xm),
+            jnp.int32(0), rng_pre)
+
+        def c_wave(t):   # [S, Bg, ...] stage-stacked activations/cotangents
+            return constrain(t, PIPE_AXIS, (DATA_AXIS, EXPERT_AXIS))
+
+        def c_rot(t):    # [S, C, Bg, ...] rotating input store
+            return constrain(t, PIPE_AXIS, None, (DATA_AXIS, EXPERT_AXIS))
+
+        rot0 = jnp.zeros((S, C) + h_shape.shape, h_shape.dtype)
+        cot0 = jnp.zeros((S,) + h_shape.shape, h_shape.dtype)
+        zeros_like_f32 = lambda tree: jax.tree.map(  # noqa: E731
+            lambda p: jnp.zeros(p.shape, jnp.float32), tree)
+        g_blocks0 = zeros_like_f32(blocks)
+        g_pre0 = zeros_like_f32(pre)
+        g_post0 = zeros_like_f32(post)
+        g_tied0 = zeros_like_f32(tied)
+        loss0 = jnp.float32(0.0)
+
+        stage_ids = jnp.arange(S)
+
+        def pick_mb(tree, mb):
+            return jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, mb, 0, keepdims=False),
+                tree)
+
+        def tick(carry, xs):
+            (rot, cot, g_blocks, g_pre, g_post, g_tied, loss_acc) = carry
+            (f_act, f_mb, f_slot, i_act, i_slot, b_act, b_mb, b_slot) = xs
+
+            # ---- BackwardPass input read: FIRST, before any slot write -- #
+            # A backward can share its tick (and slot) with this tick's
+            # stage-0 park or inbound arrival; the schedule guarantees
+            # write-after-read (asserted in the simulator), so the read
+            # order here is load-bearing.
+            x_saved = jax.vmap(
+                lambda r, sl: lax.dynamic_index_in_dim(
+                    r, sl, 0, keepdims=False))(rot, b_slot)
+
+            # ---- ForwardPass lane -------------------------------------- #
+            # LoadMicroBatch on the first stage: run the pre chain and park
+            # the result in stage 0's slot before the lane reads it.
+            x0 = pre_apply(pre, tied, pick_mb(xm, f_mb[0]), f_mb[0], rng_pre)
+            rot0_new = lax.dynamic_update_index_in_dim(
+                rot[0], x0.astype(rot.dtype), f_slot[0], 0)
+            rot = rot.at[0].set(jnp.where(f_act[0], rot0_new, rot[0]))
+            x_in = jax.vmap(
+                lambda r, sl: lax.dynamic_index_in_dim(
+                    r, sl, 0, keepdims=False))(rot, f_slot)
+            y = jax.vmap(stage_apply, in_axes=(0, 0, 0, 0, None))(
+                blocks, x_in, f_mb, stage_ids, rng_body)
+            y = c_wave(y)
+
+            # ---- loss head + cotangent seed (last stage) --------------- #
+            out_last = y[S - 1]
+            yb = pick_mb(ym, f_mb[S - 1])
+
+            def scaled_loss(po, ti, o):
+                l = post_loss(po, ti, o, yb, f_mb[S - 1], rng_post)
+                return l.astype(jnp.float32) * loss_scale, l
+
+            (_, loss_val), (gpo, gti, g_out) = jax.value_and_grad(
+                scaled_loss, argnums=(0, 1, 2), has_aux=True)(
+                post, tied, out_last)
+            active_last = f_act[S - 1]
+            loss_acc = loss_acc + jnp.where(
+                active_last, loss_val.astype(jnp.float32), 0.0)
+            g_post = jax.tree.map(
+                jnp.add, g_post, _mask_tree(active_last, gpo))
+            g_tied = jax.tree.map(
+                jnp.add, g_tied, _mask_tree(active_last, gti))
+
+            # ---- SendActivation/RecvActivation: inbound wave ----------- #
+            inbound = jnp.roll(y, 1, axis=0)
+            upd = jax.vmap(
+                lambda r, sl, v: lax.dynamic_update_index_in_dim(
+                    r, v, sl, 0))(rot, i_slot, inbound)
+            rot = c_rot(jnp.where(bmask(i_act, rot), upd, rot))
+
+            # ---- BackwardPass lane (remat from saved stage input) ------ #
+            ct = cot.at[S - 1].set(g_out.astype(cot.dtype))
+
+            def stage_vjp(p, x, c, mb, sid):
+                _, vjp = jax.vjp(
+                    lambda pp, xx: stage_apply(pp, xx, mb, sid, rng_body),
+                    p, x)
+                return vjp(c)
+
+            gp, gx = jax.vmap(stage_vjp)(blocks, x_saved, ct, b_mb,
+                                         stage_ids)
+            g_blocks = jax.tree.map(
+                lambda acc, g: acc + jnp.where(
+                    bmask(b_act, g), g.astype(jnp.float32), 0.0),
+                g_blocks, gp)
+
+            # stage-0 backward feeds the pre chain (LoadMicroBatch remat):
+            # vjp of the pre chain against the outgoing cotangent, expressed
+            # as grad of <pre(x), stop_grad(gx0)>
+            def pre_cot_loss(pr, ti):
+                h = pre_apply(pr, ti, pick_mb(xm, b_mb[0]), b_mb[0], rng_pre)
+                return jnp.vdot(h.astype(jnp.float32),
+                                lax.stop_gradient(gx[0]).astype(jnp.float32))
+
+            gpr, gti2 = jax.grad(pre_cot_loss, argnums=(0, 1))(pre, tied)
+            active0 = b_act[0]
+            g_pre = jax.tree.map(jnp.add, g_pre, _mask_tree(active0, gpr))
+            g_tied = jax.tree.map(jnp.add, g_tied,
+                                  _mask_tree(active0, gti2))
+
+            # ---- SendGrad/RecvGrad: cotangent wave --------------------- #
+            gx_masked = jnp.where(bmask(b_act, gx), gx.astype(cot.dtype),
+                                  jnp.zeros_like(cot))
+            cot = c_wave(jnp.roll(gx_masked, -1, axis=0))
+
+            return (rot, cot, g_blocks, g_pre, g_post, g_tied,
+                    loss_acc), None
+
+        carry0 = (c_rot(rot0), c_wave(cot0), g_blocks0, g_pre0, g_post0,
+                  g_tied0, loss0)
+        carry, _ = lax.scan(tick, carry0, tick_xs)
+        (_, _, g_blocks, g_pre, g_post, g_tied, loss_sum) = carry
+        grads = {"pre": g_pre, "blocks": g_blocks, "post": g_post,
+                 "tied": g_tied}
+        return loss_sum, grads
+
+    return grad_fn

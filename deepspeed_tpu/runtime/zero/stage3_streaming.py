@@ -80,20 +80,25 @@ def plan_layer_streaming(num_layers: int, params_per_layer: int,
     zero/config.py ``max_live_parameters``); ``stage3_prefetch_bucket_size``
     enables lookahead when it covers at least one more layer group.
     """
-    per_group_budget = max(1, int(max_live_parameters) // max(
+    base_budget = max(1, int(max_live_parameters) // max(
         1, params_per_layer))
-    prefetch = int(prefetch_bucket_size) >= params_per_layer
-    if prefetch:
-        if per_group_budget < 2:
-            # budget can't hold current + prefetched group: honoring
-            # max_live wins over lookahead
-            prefetch = False
-        else:
-            per_group_budget //= 2  # live set holds current + prefetched
-    g = _largest_divisor_at_most(num_layers, per_group_budget)
-    if prefetch and num_layers // g < 2:
-        prefetch = False  # nothing left to look ahead to
-    return StreamPlan(layers_per_step=g, prefetch=prefetch,
+    want_prefetch = (int(prefetch_bucket_size) >= params_per_layer and
+                     base_budget >= 2)
+    if want_prefetch:
+        # live set holds current + prefetched group, and the unroll-2
+        # execution needs an EVEN number of groups — pick the largest group
+        # size satisfying both; otherwise prefetch would silently cost
+        # double the gathers for zero overlap
+        budget = base_budget // 2
+        candidates = [g for g in range(1, budget + 1)
+                      if num_layers % g == 0 and (num_layers // g) % 2 == 0
+                      and num_layers // g >= 2]
+        if candidates:
+            return StreamPlan(layers_per_step=max(candidates), prefetch=True,
+                              num_layers=num_layers,
+                              params_per_layer=params_per_layer)
+    g = _largest_divisor_at_most(num_layers, base_budget)
+    return StreamPlan(layers_per_step=g, prefetch=False,
                       num_layers=num_layers, params_per_layer=params_per_layer)
 
 
@@ -151,11 +156,25 @@ class Zero3StreamContext:
         """Streaming is a no-op on a 1-way ZeRO mesh."""
         return bool(self.manual)
 
-    def _usable(self, init_carry, carry_batch_dim: int) -> bool:
-        """Fall back to a plain scan when streaming cannot apply: 1-way
-        ZeRO mesh, the global mesh has moved on since install (the model
-        object outlives the engine — e.g. reused for inference), or the
-        batch doesn't divide the ZeRO world (batch-1 decode)."""
+    def fold_shard_index(self, key):
+        """Fold the ZeRO shard index into an rng key — models call this on
+        per-layer dropout keys inside the streamed region so masks stay
+        independent across batch shards.  Only legal inside the manual
+        region (scan body); callers must gate on :meth:`usable`."""
+        for ax in sorted(self.manual):
+            key = jax.random.fold_in(key, lax.axis_index(ax))
+        return key
+
+    def usable(self, init_carry, carry_batch_dim: int = 0) -> bool:
+        """True when :meth:`scan` will actually stream.  Models MUST gate
+        both the scan call and any fold_shard_index use on this — it is the
+        same predicate scan applies internally (scan falls back to a plain
+        lax.scan when it is False).
+
+        Streaming cannot apply when: 1-way ZeRO mesh, the global mesh has
+        moved on since install (the model object outlives the engine —
+        e.g. reused for inference), or the batch doesn't divide the ZeRO
+        world (batch-1 decode)."""
         if not self.active:
             return False
         from ...parallel import mesh as mesh_mod
@@ -207,7 +226,7 @@ class Zero3StreamContext:
         carry_batch_dim: dimension of each carry leaf sharded over the ZeRO
         axes (the batch dimension).
         """
-        if not self._usable(init_carry, carry_batch_dim):
+        if not self.usable(init_carry, carry_batch_dim):
             carry, _ = lax.scan(
                 lambda c, xs: body(c, xs),
                 init_carry, (stacked_params,) + tuple(extra_xs))
@@ -303,8 +322,9 @@ class Zero3StreamContext:
         # schedules gather(i+1) alongside compute(i) — the
         # PrefetchCoordinator's lookahead (stage3.py:169) as a loop
         # structure.  (A carried double buffer would re-introduce the full
-        # gathered stack as a scan residual.)
-        unroll = 2 if plan.prefetch and steps % 2 == 0 else 1
+        # gathered stack as a scan residual.)  The plan guarantees an even
+        # group count whenever prefetch is on.
+        unroll = 2 if plan.prefetch else 1
 
         def region_fn(carry, params_grouped, extras_grouped):
             carry, _ = lax.scan(

@@ -1,0 +1,126 @@
+"""1F1B pipeline executor (pipe/one_f_one_b.py): schedule simulation
+invariants, trajectory equality vs the GPipe executor, and the 1F1B memory
+property asserted on the compiled program.
+
+Reference: runtime/pipe/engine.py:1209 _exec_schedule + schedule.py:182
+TrainSchedule — the repo executes the same declarative schedule as static
+tick tables inside one compiled scan.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+from deepspeed_tpu.runtime.pipe.one_f_one_b import simulate_global_clock
+from deepspeed_tpu.runtime.pipe.schedule import TrainSchedule
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from test_pipe import CONFIG, make_data, make_module  # noqa: E402
+
+
+@pytest.mark.parametrize("M,S", [(4, 2), (8, 4), (4, 4), (2, 4), (16, 4),
+                                 (8, 8), (1, 4), (3, 3), (4, 1)])
+def test_global_clock_executes_full_schedule(M, S):
+    t = simulate_global_clock(M, S)
+    # every (stage, microbatch) forward and backward executed exactly once
+    assert t.fwd_active.sum() == M * S
+    assert t.bwd_active.sum() == M * S
+    # per-stage order: each tick consumes the next ops of TrainSchedule's
+    # own 1F1B compute order (a tick's fwd+bwd pair may run in either lane
+    # order — they are schedule-adjacent and independent)
+    for s in range(S):
+        ops = list(TrainSchedule(M, S, s)._compute_order())
+        ptr = 0
+        for tt in range(t.num_ticks):
+            tick_ops = set()
+            if t.fwd_active[tt, s]:
+                tick_ops.add(("fwd", int(t.fwd_mb[tt, s])))
+            if t.bwd_active[tt, s]:
+                tick_ops.add(("bwd", int(t.bwd_mb[tt, s])))
+            expect = set(ops[ptr:ptr + len(tick_ops)])
+            assert tick_ops == expect, (s, tt, tick_ops, expect)
+            ptr += len(tick_ops)
+        assert ptr == len(ops)
+
+
+@pytest.mark.parametrize("M,S", [(8, 4), (16, 4), (32, 4), (8, 8)])
+def test_live_set_independent_of_microbatches(M, S):
+    """The rotating store needs O(S) slots per stage, never O(M)."""
+    t = simulate_global_clock(M, S)
+    assert t.max_slots <= S + 1
+    # deeper stages hold fewer in-flight microbatches (warmup+1 shape)
+    assert list(t.slot_counts) == sorted(t.slot_counts, reverse=True)
+
+
+def _train(schedule, steps=4):
+    deepspeed_tpu.reset_mesh_context()
+    deepspeed_tpu.initialize_mesh(pipe=4, data=-1)
+    module = make_module(n_blocks=4)
+    x, y = make_data(64)
+    engine = PipelineEngine(
+        model=module, config=dict(CONFIG), schedule=schedule,
+        example_input=jnp.zeros((4, x.shape[1]), jnp.float32),
+        rng=jax.random.PRNGKey(3))
+    losses = []
+    for i in range(steps):
+        # DISTINCT microbatches each step — cross-microbatch activation
+        # mix-ups in the executor must show up as a trajectory divergence
+        micro = [(x[j * 4:(j + 1) * 4], y[j * 4:(j + 1) * 4])
+                 for j in range(i * 4, i * 4 + 4)]
+        losses.append(engine.train_batch(iter(micro)))
+    params = jax.tree.map(np.asarray, engine.params)
+    deepspeed_tpu.reset_mesh_context()
+    return losses, params
+
+
+def test_1f1b_matches_gpipe_trajectory():
+    l_g, p_g = _train("gpipe")
+    l_f, p_f = _train("1f1b")
+    np.testing.assert_allclose(l_f, l_g, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(p_f), jax.tree.leaves(p_g)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def _compiled_temp_bytes(schedule, micro_batches):
+    """Temp (activation/workspace) bytes of the compiled grad program."""
+    deepspeed_tpu.reset_mesh_context()
+    deepspeed_tpu.initialize_mesh(pipe=4, data=-1)
+    cfg = dict(CONFIG)
+    cfg["gradient_accumulation_steps"] = micro_batches
+    cfg["train_batch_size"] = 2 * 2 * micro_batches
+    module = make_module(n_blocks=4)
+    engine = PipelineEngine(
+        model=module, config=cfg, schedule=schedule,
+        example_input=jnp.zeros((4, 8), jnp.float32),
+        rng=jax.random.PRNGKey(3))
+    x = jnp.zeros((4 * micro_batches, 8), jnp.float32)
+    y = jnp.zeros((4 * micro_batches, 8), jnp.float32)
+    (xs, ys), _ = engine._shard_batch(((x, y), {}))
+    lowered = engine._grad_fn.lower(engine.params, engine.scaler_state,
+                                    jax.random.PRNGKey(0), xs, ys)
+    stats = lowered.compile().memory_analysis()
+    deepspeed_tpu.reset_mesh_context()
+    return int(stats.temp_size_in_bytes)
+
+
+def test_1f1b_memory_does_not_scale_with_microbatches():
+    """THE 1F1B property: peak live activation memory is bounded by the
+    warmup depth, not the microbatch count (reference schedule.py:192
+    num_pipe_buffers).  GPipe's grows linearly with M."""
+    m4 = _compiled_temp_bytes("1f1b", 4)
+    m16 = _compiled_temp_bytes("1f1b", 16)
+    # 4x the microbatches must cost well under 2x the temp memory
+    assert m16 < 2 * m4, (m4, m16)
+
+    g4 = _compiled_temp_bytes("gpipe", 4)
+    g16 = _compiled_temp_bytes("gpipe", 16)
+    # and the GPipe executor demonstrably scales with M (sanity check that
+    # the measurement sees what we claim it sees)
+    assert g16 > 2 * g4, (g4, g16)

@@ -51,9 +51,13 @@ def test_plan_degenerate():
     # max_live below one layer still streams one layer at a time
     plan = plan_layer_streaming(4, 1000, 10, 0)
     assert plan.layers_per_step == 1
-    # single group disables prefetch (nothing to look ahead to)
+    # unconstrained budget with prefetch: split into two overlapped groups
+    # (same live set as one giant group, but the gathers overlap compute)
     plan = plan_layer_streaming(4, 10, 10 ** 9, 10 ** 9)
-    assert plan.layers_per_step == 4 and not plan.prefetch
+    assert plan.layers_per_step == 2 and plan.prefetch
+    # odd group counts never reach execution with prefetch on
+    plan = plan_layer_streaming(18, 100, 1300, 100)
+    assert not plan.prefetch or (18 // plan.layers_per_step) % 2 == 0
 
 
 def _train(zero_cfg: dict, tp: int = 1, steps: int = 3, num_layers: int = 4):
