@@ -39,6 +39,33 @@ def initialize(args=None, model=None, config=None, config_params=None,
     if cfg is None:
         raise DeepSpeedConfigError("DeepSpeed requires a config (dict or path)")
 
+    # ZeRO-Infinity param offload: layer-streaming engine for models whose
+    # params should never be fully HBM-resident (reference: stage3 +
+    # offload_param — stage3.py:932; see runtime/zero/infinity.py).
+    # Parse the zero block through ZeroConfig so legacy keys
+    # (cpu_offload_params) and device defaults dispatch identically to the
+    # full config parse.
+    from .config import ZeroConfig
+    from .config_utils import load_config_dict
+    raw = cfg if isinstance(cfg, dict) else (
+        load_config_dict(cfg) if isinstance(cfg, str) else
+        getattr(cfg, "_param_dict", {}))
+    zc = ZeroConfig.from_dict(raw.get("zero_optimization"))
+    op = zc.offload_param
+    if op is not None and (op.device or "none") != "none":
+        if not hasattr(model, "layerwise_api"):
+            raise ValueError(
+                "zero_optimization.offload_param requires a model exposing "
+                "layerwise_api() (streaming groups); GPT2Model does")
+        from .runtime.zero.infinity import ZeroInfinityEngine
+        engine = ZeroInfinityEngine(
+            model=model, config=cfg, model_parameters=model_parameters,
+            optimizer=optimizer, lr_scheduler=lr_scheduler, mesh=mesh,
+            rng=rng, mpu=mpu, training_data=training_data,
+            collate_fn=collate_fn)
+        return (engine, engine.optimizer, engine.training_dataloader,
+                engine.lr_scheduler)
+
     if isinstance(model, PipelineModule):
         from .runtime.pipe.engine import PipelineEngine
         if param_partition_specs is not None:

@@ -15,6 +15,8 @@ TPU-native structure:
 from dataclasses import dataclass
 from typing import Optional
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 import optax
@@ -219,6 +221,78 @@ class GPT2Model:
         else:
             h, _ = jax.lax.scan(body, h, (params["h"],) + extras)
         return h
+
+    # -- layer-streaming protocol (ZeRO-Infinity param offload) --------- #
+    def layerwise_api(self):
+        """Split the model into streaming groups for the layer-streaming
+        engine (runtime/zero/infinity.py): embed / one group per layer /
+        head.  The reference's analog is the per-submodule fetch units of
+        stage3.py:397 fetch_sub_module.
+
+        Tied embeddings: the head group reads `wte` from the EMBED group, so
+        wte gradients accumulate from both the embedding lookup and the LM
+        head matmul (the reference ties them through the shared Parameter).
+        """
+        cfg = self.config
+        layer = self.layer
+        n = cfg.num_layers
+
+        def split(params):
+            groups = {"embed": {"wte": params["wte"], "wpe": params["wpe"]}}
+            for i in range(n):
+                groups[f"layer{i}"] = jax.tree.map(lambda a: a[i],
+                                                   params["h"])
+            head = {"ln_f": params["ln_f"]}
+            if not cfg.tie_word_embeddings:
+                head["lm_head"] = params["lm_head"]
+            groups["head"] = head
+            return groups
+
+        def join(groups):
+            params = {
+                "wte": groups["embed"]["wte"],
+                "wpe": groups["embed"]["wpe"],
+                "h": jax.tree.map(
+                    lambda *ls: np.stack(ls) if isinstance(
+                        ls[0], np.ndarray) else jnp.stack(ls),
+                    *[groups[f"layer{i}"] for i in range(n)]),
+                "ln_f": groups["head"]["ln_f"],
+            }
+            if not cfg.tie_word_embeddings:
+                params["lm_head"] = groups["head"]["lm_head"]
+            return params
+
+        def embed_fn(embed_g, input_ids, rng):
+            wte = embed_g["wte"].astype(cfg.dtype)
+            wpe = embed_g["wpe"].astype(cfg.dtype)
+            h = wte[input_ids] + wpe[jnp.arange(input_ids.shape[1])]
+            deterministic = rng is None
+            r = rng if rng is not None else jax.random.PRNGKey(0)
+            return dropout(h, cfg.embd_dropout, r, deterministic)
+
+        def layer_fn(layer_g, h, rng, layer_idx):
+            r = (jax.random.fold_in(rng, layer_idx)
+                 if rng is not None else None)
+            return layer(layer_g, h, rng=r,
+                         deterministic=rng is None)
+
+        def head_loss_fn(head_g, embed_g, h, input_ids, labels):
+            hs = fused_layer_norm(h, head_g["ln_f"]["w"],
+                                  head_g["ln_f"]["b"], cfg.layer_norm_eps)
+            if cfg.tie_word_embeddings:
+                head = embed_g["wte"].astype(hs.dtype).T
+            else:
+                head = head_g["lm_head"].astype(hs.dtype)
+            logits = (hs @ head).astype(jnp.float32)
+            if labels is None:
+                labels = input_ids[:, 1:]
+                logits = logits[:, :-1]
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
+
+        return {"split": split, "join": join, "embed_fn": embed_fn,
+                "layer_fn": layer_fn, "head_loss_fn": head_loss_fn,
+                "num_layers": n}
 
     def logits(self, params, input_ids, rng=None, deterministic=False,
                pld_theta=None):

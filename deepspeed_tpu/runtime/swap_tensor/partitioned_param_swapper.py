@@ -1,0 +1,199 @@
+"""Parameter NVMe swapper — compute-dtype parameter groups paged through a
+pinned host window.
+
+Reference: runtime/swap_tensor/partitioned_param_swapper.py:36
+(AsyncPartitionedParameterSwapper) — the ZeRO-Infinity piece that lets the
+*parameters themselves* live on NVMe, wired into stage 3 at stage3.py:932 so
+a 40B-param model trains on one device (BASELINE.md).
+
+TPU recasting: the unit of paging is a LAYER GROUP (one scanned layer's
+param pytree, or the embed/head chains) — the natural streaming granule of
+the layer-streaming engine (runtime/zero/infinity.py), playing the role the
+reference's per-param ds_tensor handles play.  Groups are flat compute-dtype
+files on local SSD; a fixed window of io-aligned host buffers (reference:
+pinned buffer pool, utils.py:95) absorbs async reads, and `prefetch` lets
+the engine overlap the next group's disk read with the current group's
+device compute.
+"""
+
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import jax
+
+from ...utils.logging import log_dist
+from .aio_handle import AsyncIOHandle
+from .utils import aligned_empty
+
+
+class _Group:
+    """Inventory of one paging group: leaf shapes/dtypes and a flat span."""
+
+    def __init__(self, name: str, tree: Any):
+        self.name = name
+        leaves, self.treedef = jax.tree_util.tree_flatten(tree)
+        self.shapes = [tuple(np.shape(l)) for l in leaves]
+        self.dtypes = [np.asarray(l).dtype for l in leaves]
+        self.sizes = [int(np.prod(s)) if s else 1 for s in self.shapes]
+        self.nbytes = sum(sz * dt.itemsize
+                          for sz, dt in zip(self.sizes, self.dtypes))
+
+    def flatten(self, tree: Any) -> np.ndarray:
+        leaves = self.treedef.flatten_up_to(tree)
+        out = np.empty(self.nbytes, np.uint8)
+        off = 0
+        for leaf, shape, dtype, size in zip(leaves, self.shapes, self.dtypes,
+                                            self.sizes):
+            arr = np.ascontiguousarray(np.asarray(leaf, dtype=dtype))
+            nb = size * dtype.itemsize
+            out[off:off + nb] = arr.reshape(-1).view(np.uint8)
+            off += nb
+        return out
+
+    def unflatten(self, buf: np.ndarray) -> Any:
+        leaves = []
+        off = 0
+        for shape, dtype, size in zip(self.shapes, self.dtypes, self.sizes):
+            nb = size * dtype.itemsize
+            leaves.append(buf[off:off + nb].view(dtype).reshape(shape))
+            off += nb
+        return self.treedef.unflatten(leaves)
+
+
+class PartitionedParamSwapper:
+    """Pages named parameter groups between NVMe files and a host window.
+
+    API (mirroring the reference swapper's swap_in/swap_out lifecycle):
+      write(name, tree)      — (over)write a group's file from host values
+      get(name) -> tree      — group's params as host arrays (reads if not
+                               resident; completes any pending prefetch)
+      prefetch(name)         — async read into a window buffer
+      release(name)          — drop the group from the window
+      resident_groups        — names currently occupying window buffers
+    """
+
+    def __init__(self, swap_dir: str, groups: Dict[str, Any],
+                 buffer_count: int = 4, aio_config=None):
+        os.makedirs(swap_dir, exist_ok=True)
+        self.swap_dir = swap_dir
+        self.groups = {name: _Group(name, tree)
+                       for name, tree in groups.items()}
+        kw = {}
+        if aio_config is not None:
+            kw = dict(block_size=aio_config.block_size,
+                      queue_depth=aio_config.queue_depth,
+                      single_submit=aio_config.single_submit,
+                      overlap_events=aio_config.overlap_events,
+                      thread_count=aio_config.thread_count)
+        self.write_handle = AsyncIOHandle(**kw)
+        max_bytes = max(g.nbytes for g in self.groups.values())
+        self.buffer_count = max(2, int(buffer_count))
+        # one read submission context PER WINDOW BUFFER: completing one
+        # slot's read must not block on another slot's in-flight prefetch
+        # (reference: PipelinedOptimizerSwapper's dual-handle overlap)
+        self._read_handles: List[AsyncIOHandle] = [
+            AsyncIOHandle(**kw) for _ in range(self.buffer_count)]
+        self._buffers: List[np.ndarray] = [
+            aligned_empty(max_bytes, np.uint8)
+            for _ in range(self.buffer_count)]
+        self._free: List[int] = list(range(self.buffer_count))
+        self._resident: Dict[str, int] = {}     # name -> buffer idx
+        self._pending: Dict[str, int] = {}      # name -> buffer idx (reading)
+        self._lru: List[str] = []
+        self._inflight_writes: List[np.ndarray] = []
+        log_dist(
+            f"ZeRO-Infinity param swapper: {len(self.groups)} groups, "
+            f"window={self.buffer_count} x {max_bytes >> 20}MiB at "
+            f"{swap_dir} (native_aio={self.write_handle.using_native})",
+            ranks=[0])
+
+    # ------------------------------------------------------------------ #
+    def _path(self, name: str) -> str:
+        return os.path.join(self.swap_dir, f"param_group_{name}.bin")
+
+    @property
+    def resident_groups(self) -> List[str]:
+        return list(self._resident) + list(self._pending)
+
+    def _evict_for(self, name: str) -> int:
+        if self._free:
+            return self._free.pop()
+        # evict least-recently-used resident group (never a pending read)
+        for cand in list(self._lru):
+            if cand in self._resident and cand != name:
+                idx = self._resident.pop(cand)
+                self._lru.remove(cand)
+                return idx
+        raise RuntimeError(
+            f"param swapper window exhausted ({self.buffer_count} buffers, "
+            f"pending={list(self._pending)}) — raise "
+            f"offload_param.buffer_count")
+
+    # ------------------------------------------------------------------ #
+    def write(self, name: str, tree: Any, async_op: bool = False) -> None:
+        g = self.groups[name]
+        flat = g.flatten(tree)
+        if name in self._resident:      # keep the window coherent
+            idx = self._resident[name]
+            self._buffers[idx][:g.nbytes] = flat
+        # async submission only borrows the buffer — pin it until wait()
+        # (the reference pins its bounce buffers for the same reason)
+        self._inflight_writes.append(flat)
+        self.write_handle.pwrite(flat, self._path(name), async_op=async_op)
+        if not async_op:
+            self.flush_writes()
+
+    def flush_writes(self) -> None:
+        self.write_handle.wait()
+        self._inflight_writes.clear()
+
+    def prefetch(self, name: str) -> None:
+        if name in self._resident or name in self._pending:
+            return
+        g = self.groups[name]
+        idx = self._evict_for(name)
+        buf = self._buffers[idx][:g.nbytes]
+        self._read_handles[idx].pread(buf, self._path(name), async_op=True)
+        self._pending[name] = idx
+
+    def get(self, name: str, copy: bool = True) -> Any:
+        """Group params as host arrays.  copy=True (default) detaches the
+        result from the window buffer — callers hand these to async
+        device uploads, and a subsequent prefetch may overwrite the
+        window slot before the upload drains (a releases-too-early
+        use-after-free otherwise).  copy=False returns zero-copy views for
+        synchronous consumers."""
+        g = self.groups[name]
+        if name in self._pending:
+            idx = self._pending.pop(name)
+            self._read_handles[idx].wait()   # only THIS slot's read
+            self._resident[name] = idx
+            self._lru.append(name)
+        elif name not in self._resident:
+            idx = self._evict_for(name)
+            buf = self._buffers[idx][:g.nbytes]
+            self._read_handles[idx].pread(buf, self._path(name),
+                                          async_op=False)
+            self._resident[name] = idx
+            self._lru.append(name)
+        else:
+            self._lru.remove(name)
+            self._lru.append(name)
+        idx = self._resident[name]
+        tree = g.unflatten(self._buffers[idx][:g.nbytes])
+        if copy:
+            tree = jax.tree.map(lambda a: np.array(a, copy=True), tree)
+        return tree
+
+    def release(self, name: str) -> None:
+        if name in self._pending:
+            idx = self._pending.pop(name)
+            self._read_handles[idx].wait()
+            self._resident[name] = idx
+            self._lru.append(name)
+        if name in self._resident:
+            self._free.append(self._resident.pop(name))
+            if name in self._lru:
+                self._lru.remove(name)

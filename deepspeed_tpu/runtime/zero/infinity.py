@@ -1,0 +1,462 @@
+"""ZeRO-Infinity layer-streaming engine — train models whose parameters do
+not fit in HBM.
+
+Reference: the stage-3 + NVMe composition — parameters paged from NVMe at
+fetch time (runtime/swap_tensor/partitioned_param_swapper.py:36, wired at
+stage3.py:932), gradients partitioned to CPU/NVMe (stage3.py:2088), and
+optimizer states swapped around a sub_group-wise step (stage3.py:2777,
+2633-2686).  That is the reference's "40B params on one V100" story
+(BASELINE.md).
+
+TPU recasting (no autograd hooks; a Python-driven streaming step around
+small jitted programs):
+
+  HBM      : boundary activations + at most TWO layer groups of params at a
+             time (current + async prefetch) — never the whole model;
+  host/NVMe: compute-dtype parameter groups (PartitionedParamSwapper when
+             offload_param.device == "nvme"; host arrays for "cpu"), fp32
+             gradient accumulators, and the fp32 master + Adam moments
+             owned by the host/NVMe optimizer tier (zero/offload.py,
+             swap_tensor/optimizer_swapper.py);
+  step     : forward streams layer groups up through the loss (head runs
+             fused with value_and_grad so the loss cotangent is ready);
+             backward re-streams the groups in reverse, rematerializing
+             each layer's forward with jax.vjp from its saved input;
+             the optimizer sweep then pipelines NVMe master/moment reads,
+             native host Adam, and write-backs leaf by leaf.
+
+The model opts in by exposing `layerwise_api()` (models/gpt2.py) — the
+split/join of its params into ordered streaming groups plus pure embed /
+layer / head-loss functions.  `deepspeed_tpu.initialize` dispatches here
+when `zero_optimization.offload_param` is configured on such a model.
+"""
+
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...config import DeepSpeedConfig
+from ...utils.logging import log_dist
+from ...utils.timer import ThroughputTimer
+from ..engine import resolve_mesh_ctx
+
+
+class ZeroInfinityEngine:
+    """forward/backward/step protocol over streamed parameter groups."""
+
+    def __init__(self, model=None, config=None, model_parameters=None,
+                 optimizer=None, lr_scheduler=None, mesh=None, rng=None,
+                 training_data=None, collate_fn=None, mpu=None,
+                 param_partition_specs=None):
+        if not hasattr(model, "layerwise_api"):
+            raise ValueError(
+                "offload_param requires a model exposing layerwise_api() "
+                "(streaming groups) — GPT2Model does; see models/gpt2.py")
+        if optimizer is not None:
+            raise ValueError(
+                "offload_param drives the host/NVMe optimizer tier — a "
+                "client optax optimizer cannot be streamed")
+        self.module = model
+        self.mesh_ctx = resolve_mesh_ctx(config, mesh)
+        dp = self.mesh_ctx.data_parallel_world_size
+        self.config = (config if isinstance(config, DeepSpeedConfig)
+                       else DeepSpeedConfig(config, world_size=dp))
+        if self.config.fp16.enabled:
+            raise ValueError(
+                "the streaming engine is bf16/fp32-native; use bf16 instead "
+                "of fp16 (dynamic loss scaling is unnecessary on TPU)")
+        self.compute_dtype = (jnp.bfloat16 if self.config.bf16.enabled
+                              else jnp.float32)
+
+        api = model.layerwise_api()
+        self._split = api["split"]
+        self._join = api["join"]
+        self._embed_fn = api["embed_fn"]
+        self._layer_fn = api["layer_fn"]
+        self._head_loss_fn = api["head_loss_fn"]
+        self.num_layers = api["num_layers"]
+        self._order = (["embed"] +
+                       [f"layer{i}" for i in range(self.num_layers)] +
+                       ["head"])
+
+        if model_parameters is None:
+            raise ValueError("model_parameters is required")
+
+        # ---- host/NVMe tiers ----------------------------------------- #
+        zc = self.config.zero_config
+        op = zc.offload_param
+        import ml_dtypes  # bf16 numpy dtype
+        self._np_dtype = (ml_dtypes.bfloat16
+                          if self.compute_dtype == jnp.bfloat16
+                          else np.float32)
+        # cast straight to the compute numpy dtype — no transient fp32 copy
+        # of the full model (this engine exists because the model is big)
+        groups_compute = self._split(jax.tree.map(
+            lambda a: np.asarray(a).astype(self._np_dtype)
+            if np.issubdtype(np.asarray(a).dtype, np.floating) or
+            str(np.asarray(a).dtype) == "bfloat16" else np.asarray(a),
+            model_parameters))
+        self._use_nvme_params = op is not None and op.device == "nvme"
+        if self._use_nvme_params:
+            from ..swap_tensor.partitioned_param_swapper import (
+                PartitionedParamSwapper)
+            swap_dir = os.path.join(
+                op.nvme_path or "/tmp/deepspeed_tpu_nvme", "zero_stage_3",
+                "params")
+            self._swapper = PartitionedParamSwapper(
+                swap_dir, groups_compute,
+                buffer_count=max(2, op.buffer_count),
+                aio_config=self.config.aio_config)
+            for name, tree in groups_compute.items():
+                self._swapper.write(name, tree, async_op=True)
+            self._swapper.flush_writes()
+            self._host_groups = None
+        else:
+            self._swapper = None
+            self._host_groups = groups_compute
+
+        # fp32 master + moments: NVMe or host Adam tier.  The fp32 tree is
+        # consumed by the tier's constructor (NVMe writes it to files and
+        # drops it; host keeps it — that IS the master copy).
+        oo = zc.offload_optimizer
+        full_f32 = jax.tree.map(lambda a: np.asarray(a, np.float32),
+                                model_parameters)
+        if oo is not None and oo.device == "nvme":
+            from ..swap_tensor import create_nvme_offload_optimizer
+            self._opt = create_nvme_offload_optimizer(
+                full_f32, self.config,
+                gradient_clipping=self.config.gradient_clipping)
+        else:
+            from .offload import HostOffloadOptimizer
+            self._opt = HostOffloadOptimizer(
+                full_f32, self.config.optimizer_name or "adam",
+                self.config.optimizer_params,
+                gradient_clipping=self.config.gradient_clipping)
+        del full_f32
+
+        # ---- compiled programs --------------------------------------- #
+        cdt = self.compute_dtype
+
+        def cast(tree):
+            return jax.tree.map(
+                lambda a: a.astype(cdt) if jnp.issubdtype(
+                    jnp.asarray(a).dtype, jnp.floating) else jnp.asarray(a),
+                tree)
+
+        self._jit_embed = jax.jit(
+            lambda e, ids, r: self._embed_fn(cast(e), ids, r))
+        self._jit_layer = jax.jit(
+            lambda p, h, r, i: self._layer_fn(cast(p), h, r, i))
+
+        def head_valgrad(head_g, embed_g, h, ids, labels):
+            def f(hg, eg, hh):
+                return self._head_loss_fn(cast(hg), cast(eg), hh, ids,
+                                          labels)
+            (loss), grads = jax.value_and_grad(f, argnums=(0, 1, 2))(
+                head_g, embed_g, h)
+            return loss, grads
+
+        self._jit_head = jax.jit(head_valgrad)
+
+        def layer_vjp(p, x, ct, r, i):
+            _, vjp = jax.vjp(lambda pp, xx: self._layer_fn(cast(pp), xx,
+                                                           r, i), p, x)
+            return vjp(ct)
+
+        self._jit_layer_vjp = jax.jit(layer_vjp)
+
+        def embed_vjp(e, ids, ct, r):
+            def f(eg):
+                h = self._embed_fn(cast(eg), ids, r)
+                return jnp.vdot(h.astype(jnp.float32),
+                                ct.astype(jnp.float32))
+            return jax.grad(f)(e)
+
+        self._jit_embed_vjp = jax.jit(embed_vjp)
+
+        # ---- bookkeeping --------------------------------------------- #
+        self.lr_scheduler = lr_scheduler
+        self.training_dataloader = self._configure_dataloader(
+            training_data, collate_fn)
+        self.global_steps = 0
+        self.micro_steps = 0
+        self.skipped_steps = 0
+        self._rng = rng if rng is not None else jax.random.PRNGKey(42)
+        self._grad_groups: Optional[Dict[str, Any]] = None
+        self._acts = None
+        self._pending = None
+        self._last_loss = None
+        self.max_live_param_groups = 0
+        self._live_now = 0
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.config.train_micro_batch_size_per_gpu,
+            num_workers=dp,
+            steps_per_output=self.config.steps_per_print)
+        n_params = sum(int(np.prod(np.shape(l)))
+                       for l in jax.tree.leaves(model_parameters))
+        log_dist(
+            f"ZeroInfinityEngine: {n_params:,} params in "
+            f"{len(self._order)} streamed groups, params_on="
+            f"{'nvme' if self._use_nvme_params else 'host'}, "
+            f"optimizer={type(self._opt).__name__}", ranks=[0])
+
+    # ------------------------------------------------------------------ #
+    def _configure_dataloader(self, training_data, collate_fn):
+        """Same per-process sharding contract as DeepSpeedEngine
+        (runtime/engine.py _configure_dataloader)."""
+        if training_data is None:
+            return None
+        from ..dataloader import DeepSpeedDataLoader
+        nproc = jax.process_count()
+        dp = self.mesh_ctx.data_parallel_world_size
+        per_process = (self.config.train_micro_batch_size_per_gpu *
+                       dp) // nproc
+        return DeepSpeedDataLoader(
+            training_data, batch_size=per_process, collate_fn=collate_fn,
+            data_parallel_world_size=nproc,
+            data_parallel_rank=jax.process_index())
+
+    @property
+    def optimizer(self):
+        return self._opt
+
+    def train_micro_batch_size_per_gpu(self):
+        return self.config.train_micro_batch_size_per_gpu
+
+    def gradient_accumulation_steps(self):
+        return self.config.gradient_accumulation_steps
+
+    def is_gradient_accumulation_boundary(self) -> bool:
+        return self.micro_steps % self.gradient_accumulation_steps() == 0
+
+    def estimate_memory(self):
+        """Per-tier byte estimate: HBM holds only the streaming window."""
+        group_bytes = {}
+        for name in self._order:
+            tree = self._group_host(name)
+            group_bytes[name] = sum(
+                np.asarray(l).nbytes for l in jax.tree.leaves(tree))
+        total = sum(group_bytes.values())
+        hbm_window = 2 * max(group_bytes.values())
+        n = sum(int(np.prod(np.shape(l))) for name in self._order
+                for l in jax.tree.leaves(self._group_host(name)))
+        return {
+            "hbm_param_window": hbm_window,
+            "host_or_nvme_params": total,
+            "grads_fp32_host": 4 * n,
+            "optimizer_fp32_nvme_or_host": 12 * n,
+            "total_hbm_params": hbm_window,   # vs 2n/4n resident baselines
+        }
+
+    # ------------------------------------------------------------------ #
+    def _group_host(self, name: str):
+        if self._swapper is not None:
+            return self._swapper.get(name)
+        return self._host_groups[name]
+
+    def _fetch_device(self, name: str):
+        """Host/NVMe -> HBM upload of one group (async dispatch)."""
+        tree = self._group_host(name)
+        self._live_now += 1
+        self.max_live_param_groups = max(self.max_live_param_groups,
+                                         self._live_now)
+        return jax.tree.map(jnp.asarray, tree)
+
+    def _release_device(self, ref):
+        """Callers MUST rebind: ``p = self._release_device(p)`` — deleting a
+        local alias alone would keep the device arrays alive and push peak
+        residency past the 2-group window."""
+        self._live_now -= 1
+        del ref
+        return None
+
+    def _prefetch(self, name: str) -> None:
+        if self._swapper is not None:
+            self._swapper.prefetch(name)
+
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    # ------------------------------------------------------------------ #
+    def forward(self, input_ids, labels=None):
+        """Stream groups forward; returns the loss.  The head runs fused
+        with value_and_grad so backward() starts with the cotangent ready
+        (the reference's PreBackwardFunction re-fetch begins the same way,
+        stage3.py:546)."""
+        self.tput_timer.start()
+        rng = self._next_rng() if self._is_dropout_mode() else None
+        ids = jnp.asarray(input_ids)
+        lbl = None if labels is None else jnp.asarray(labels)
+
+        embed_g = self._fetch_device("embed")
+        h = self._jit_embed(embed_g, ids, rng)
+        acts = [h]
+        # release the embed group during the layer sweep — the head step
+        # re-fetches it (tied wte); peak device residency stays at 2 groups
+        embed_g = self._release_device(embed_g)
+        if self._swapper is not None:
+            self._swapper.release("embed")
+        self._prefetch("layer0")
+        for i in range(self.num_layers):
+            if i + 1 < self.num_layers:
+                self._prefetch(f"layer{i + 1}")
+            else:
+                self._prefetch("head")
+            p = self._fetch_device(f"layer{i}")
+            h = self._jit_layer(p, h, rng, jnp.int32(i))
+            acts.append(h)
+            p = self._release_device(p)
+            if self._swapper is not None:
+                self._swapper.release(f"layer{i}")
+
+        head_g = self._fetch_device("head")
+        embed_g = self._fetch_device("embed")
+        loss, (g_head, g_embed_head, dh) = self._jit_head(
+            head_g, embed_g, h, ids, lbl)
+        head_g = self._release_device(head_g)
+        embed_g = self._release_device(embed_g)
+        if self._swapper is not None:
+            self._swapper.release("head")
+            self._swapper.release("embed")
+        self._acts = acts
+        self._pending = {"rng": rng, "ids": ids, "dh": dh,
+                         "g_head": g_head, "g_embed_head": g_embed_head}
+        self._last_loss = loss
+        return loss
+
+    __call__ = forward
+
+    def _is_dropout_mode(self) -> bool:
+        cfg = getattr(self.module, "config", None)
+        if cfg is None:
+            return False
+        return any(getattr(cfg, k, 0.0) > 0.0 for k in
+                   ("embd_dropout", "attn_dropout", "hidden_dropout"))
+
+    def backward(self, loss=None):
+        """Re-stream groups in reverse; accumulate fp32 grads on host
+        (the reference partitions grads to CPU/NVMe — stage3.py:2088)."""
+        assert self._pending is not None, "backward() before forward()"
+        pend, acts = self._pending, self._acts
+        rng, ids, dh = pend["rng"], pend["ids"], pend["dh"]
+
+        def acc(name, tree):
+            host = jax.tree.map(lambda a: np.asarray(a, np.float32), tree)
+            if self._grad_groups is None:
+                self._grad_groups = {}
+            if name in self._grad_groups:
+                self._grad_groups[name] = jax.tree.map(
+                    np.add, self._grad_groups[name], host)
+            else:
+                self._grad_groups[name] = host
+
+        acc("head", pend["g_head"])
+        self._prefetch(f"layer{self.num_layers - 1}")
+        for i in reversed(range(self.num_layers)):
+            if i > 0:
+                self._prefetch(f"layer{i - 1}")
+            else:
+                self._prefetch("embed")
+            p = self._fetch_device(f"layer{i}")
+            gp, dh = self._jit_layer_vjp(p, acts[i], dh, rng, jnp.int32(i))
+            acc(f"layer{i}", gp)
+            p = self._release_device(p)
+            if self._swapper is not None:
+                self._swapper.release(f"layer{i}")
+
+        embed_g = self._fetch_device("embed")
+        g_embed = self._jit_embed_vjp(embed_g, ids, dh, rng)
+        g_embed = jax.tree.map(jnp.add, g_embed,
+                               jax.tree.map(jnp.asarray,
+                                            pend["g_embed_head"]))
+        acc("embed", g_embed)
+        embed_g = self._release_device(embed_g)
+        if self._swapper is not None:
+            self._swapper.release("embed")
+        self._acts = None
+        self._pending = None
+        self.micro_steps += 1
+        return loss if loss is not None else self._last_loss
+
+    def step(self):
+        """Optimizer sweep at the accumulation boundary: the host/NVMe tier
+        pipelines master/moment reads, native Adam, and write-backs leaf by
+        leaf (reference: stage3.py:2777 sub_group step)."""
+        if not self.is_gradient_accumulation_boundary():
+            return
+        assert self._grad_groups is not None, "step() before backward()"
+        gas = self.gradient_accumulation_steps()
+        full_grads = self._join(self._grad_groups)
+        lr = None
+        if self.lr_scheduler is not None:
+            lr = float(self.lr_scheduler.lr_at(self._opt.step_count()))
+        new_host = self._opt.apply(full_grads, 1.0 / gas, lr,
+                                   self.compute_dtype)
+        overflow = new_host is None
+        if not overflow:
+            new_groups = self._split(jax.tree.map(
+                lambda a: np.asarray(a).astype(self._np_dtype)
+                if np.issubdtype(np.asarray(a).dtype, np.floating) or
+                str(np.asarray(a).dtype) == "bfloat16" else np.asarray(a),
+                new_host))
+            if self._swapper is not None:
+                for name, tree in new_groups.items():
+                    self._swapper.write(name, tree, async_op=True)
+                self._swapper.flush_writes()
+            else:
+                self._host_groups = new_groups
+            if self.lr_scheduler is not None:
+                self.lr_scheduler.step()
+        else:
+            self.skipped_steps += 1
+        self._grad_groups = None
+        self.global_steps += 1
+        self.tput_timer.stop(global_step=True)
+        if self.global_steps % self.config.steps_per_print == 0:
+            log_dist(f"step={self.global_steps}, "
+                     f"loss={float(self._last_loss):.6f}", ranks=[0])
+
+    # ------------------------------------------------------------------ #
+    def module_state_dict(self):
+        """Consolidated fp32 master weights (from the optimizer tier)."""
+        return self._opt.master_params
+
+    def save_checkpoint(self, save_dir, tag=None, client_state=None):
+        from .. import checkpoint as ckpt_mod
+        tag = tag or f"global_step{self.global_steps}"
+        client = dict(client_state or {})
+        client.update({"global_steps": self.global_steps,
+                       "micro_steps": self.micro_steps,
+                       "skipped_steps": self.skipped_steps})
+        return ckpt_mod.save_checkpoint_state(
+            save_dir, tag, module_state={"module": self.module_state_dict()},
+            optimizer_state={"optimizer": self._opt.state_dict()},
+            client_state=client)
+
+    def load_checkpoint(self, load_dir, tag=None):
+        from .. import checkpoint as ckpt_mod
+        module_tmpl = {"module": self.module_state_dict()}
+        opt_tmpl = {"optimizer": self._opt.state_dict()}
+        module_state, opt_state, client = ckpt_mod.load_checkpoint_state(
+            load_dir, tag, module_tmpl, opt_tmpl)
+        self._opt.load_state_dict(opt_state["optimizer"])
+        master = module_state["module"]
+        self._opt.load_master_params(master)
+        new_groups = self._split(jax.tree.map(
+            lambda a: np.asarray(a, np.float32).astype(self._np_dtype),
+            master))
+        if self._swapper is not None:
+            for name, tree in new_groups.items():
+                self._swapper.write(name, tree, async_op=True)
+            self._swapper.flush_writes()
+        else:
+            self._host_groups = new_groups
+        self.global_steps = client.get("global_steps", 0)
+        self.micro_steps = client.get("micro_steps", 0)
+        self.skipped_steps = client.get("skipped_steps", 0)
+        return load_dir, client
