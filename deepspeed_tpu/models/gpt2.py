@@ -89,6 +89,17 @@ class GPT2Config:
 class GPT2Model:
     """Decoder-only LM over stacked DeepSpeedTransformerLayers."""
 
+    @property
+    def sparse_grad_paths(self):
+        """engine "sparse_gradients" consumers: row-sparse embedding grads
+        are reduced as (indices, values) instead of a dense allreduce
+        (reference: engine.py:1729-1792 sparse_allreduce — which applies to
+        sparse nn.Embedding grads).  Only valid UNTIED: a tied LM head adds
+        a dense d loss/d wte contribution over every vocab row."""
+        if self.config.tie_word_embeddings:
+            return ()
+        return ("wte",)
+
     def __init__(self, config: GPT2Config):
         self.config = config
         self.layer = DeepSpeedTransformerLayer(config.layer_config())

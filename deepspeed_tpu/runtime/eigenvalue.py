@@ -30,24 +30,19 @@ class Eigenvalue:
         self.stability = stability
         self.gas_boundary_resolution = gas_boundary_resolution
 
-    def compute_eigenvalue(self, loss_fn: Callable[[Any], jnp.ndarray],
-                           params: Any, rng) -> Tuple[float, Any]:
-        """Dominant |eigenvalue| of d²loss/dparams² and its eigenvector.
-
-        loss_fn: params -> scalar loss (close over the batch).
-        """
-        grad_fn = jax.grad(loss_fn)
-
-        def hvp(v):
-            return jax.jvp(grad_fn, (params,), (v,))[1]
-
-        hvp = jax.jit(hvp)
+    def random_like(self, params: Any, rng) -> Any:
         leaves, treedef = jax.tree_util.tree_flatten(params)
-        v = jax.tree_util.tree_unflatten(treedef, [
+        return jax.tree_util.tree_unflatten(treedef, [
             jax.random.normal(jax.random.fold_in(rng, i), l.shape,
                               jnp.float32)
             for i, l in enumerate(leaves)])
-        v, _ = _normalize(v)
+
+    def power_iterate(self, hvp: Callable[[Any], Any],
+                      v0: Any) -> Tuple[float, Any]:
+        """Power iteration given a Hessian-vector-product callable (which
+        callers should jit ONCE and reuse across probes — re-jitting per
+        probe recompiles the full fwd+bwd+jvp every step)."""
+        v, _ = _normalize(v0)
         eig = jnp.asarray(0.0)
         for _ in range(self.max_iter):
             hv = hvp(v)
@@ -60,6 +55,19 @@ class Eigenvalue:
                 break
             eig = new_eig
         return float(eig), v
+
+    def compute_eigenvalue(self, loss_fn: Callable[[Any], jnp.ndarray],
+                           params: Any, rng) -> Tuple[float, Any]:
+        """Dominant |eigenvalue| of d²loss/dparams² and its eigenvector.
+
+        loss_fn: params -> scalar loss (close over the batch).
+        """
+        grad_fn = jax.grad(loss_fn)
+
+        def hvp(v):
+            return jax.jvp(grad_fn, (params,), (v,))[1]
+
+        return self.power_iterate(jax.jit(hvp), self.random_like(params, rng))
 
     def compute_layer_eigenvalues(
             self, loss_fn: Callable[[Any], jnp.ndarray], params: Dict,

@@ -278,6 +278,18 @@ class DeepSpeedEngine:
         if self.config.quantize_training_enabled:
             from .quantize import Quantizer
             self.quantizer = Quantizer(self.config.quantize_training_config)
+        # Eigenvalue curvature probe driving the MoQ schedule (reference:
+        # engine.py:1478-1485 block_eigenvalue → quantizer.quantize).
+        self.eigenvalue = None
+        self._block_eigs = None
+        self._last_batch = None
+        ec = self.config.eigenvalue_config
+        if ec.enabled:
+            from .eigenvalue import Eigenvalue
+            self.eigenvalue = Eigenvalue(
+                verbose=ec.verbose, max_iter=ec.max_iter, tol=ec.tol,
+                stability=ec.stability,
+                gas_boundary_resolution=ec.gas_boundary_resolution)
 
         # ---- bookkeeping --------------------------------------------- #
         self.timers = SynchronizedWallClockTimer()
@@ -472,6 +484,25 @@ class DeepSpeedEngine:
         predivide = self.config.gradient_predivide_factor
 
         custom_grad_program = getattr(self, "_custom_grad_program", None)
+        sparse_paths = ()
+        if self.config.sparse_gradients_enabled:
+            sparse_paths = tuple(getattr(self.module, "sparse_grad_paths",
+                                         ()))
+            stage = self.config.zero_optimization_stage
+            if stage >= 2:
+                raise ValueError(
+                    "sparse_gradients is incompatible with ZeRO stage >= 2 "
+                    "(grads are reduce-scattered, not allreduced — same "
+                    "restriction as the reference)")
+            if self.mesh_ctx.model_parallel_world_size > 1:
+                raise ValueError(
+                    "sparse_gradients does not compose with tensor "
+                    "parallelism — the row-sparse reduction assumes "
+                    "replicated embedding shards")
+            if not sparse_paths:
+                logger.warning(
+                    "sparse_gradients enabled but the model declares no "
+                    "sparse_grad_paths — falling back to dense reduction")
 
         def loss_and_grads(params, scaler_state, rng, *args, **kwargs):
             # inputs follow the compute dtype too — otherwise f32 activations
@@ -507,6 +538,88 @@ class DeepSpeedEngine:
             if prescale and predivide:
                 grads = jax.tree.map(lambda g: g / predivide, grads)
             return loss, grads
+
+        from ..parallel.mesh import ZERO_AXES
+        manual = tuple(a for a in ZERO_AXES
+                       if self.mesh_ctx.axis_size(a) > 1)
+        if sparse_paths and manual and custom_grad_program is None:
+            # Row-sparse embedding-grad reduction (reference:
+            # engine.py:1729-1792): each shard ships (token indices, touched
+            # rows) and every shard scatter-adds the gathered pairs — comm
+            # volume O(batch·seq·hidden·dp) instead of O(vocab·hidden).
+            mesh = self.mesh_ctx.mesh
+            dpw = int(np.prod([self.mesh_ctx.axis_size(a) for a in manual]))
+
+            def loss_and_grads(params, scaler_state, rng, *args, **kwargs):
+                args = _tree_cast(args, compute_dtype)
+                kwargs = _tree_cast(kwargs, compute_dtype)
+
+                def batch_spec(a):
+                    shape = getattr(a, "shape", ())
+                    if len(shape) >= 1 and shape[0] % dpw == 0:
+                        return jax.sharding.PartitionSpec(manual)
+                    return jax.sharding.PartitionSpec()
+
+                args_specs = jax.tree.map(batch_spec, args)
+                kwargs_specs = jax.tree.map(batch_spec, kwargs)
+                P0 = jax.sharding.PartitionSpec()
+
+                def region(p, ls, r, rargs, rkwargs):
+                    for ax in manual:  # independent dropout per shard
+                        r = jax.random.fold_in(r, lax.axis_index(ax))
+
+                    def loss_fn(pp):
+                        cp = _tree_cast(pp, compute_dtype)
+                        out = apply_model(cp, r, *rargs, **rkwargs)
+                        loss = out[0] if isinstance(out, tuple) else out
+                        return loss.astype(jnp.float32) * ls, loss
+
+                    (_, loss), grads = jax.value_and_grad(
+                        loss_fn, has_aux=True)(p)
+                    ids_list = [
+                        a for a in jax.tree.leaves((rargs, rkwargs))
+                        if hasattr(a, "dtype") and jnp.issubdtype(
+                            a.dtype, jnp.integer) and
+                        getattr(a, "ndim", 0) >= 2]
+                    if not ids_list:
+                        raise ValueError(
+                            "sparse_gradients: no integer id array found "
+                            "in the batch to drive row sparsity")
+                    ids_flat = ids_list[0].reshape(-1)
+                    flat, treedef = jax.tree_util.tree_flatten_with_path(
+                        grads)
+                    reduced = []
+                    for path, g in flat:
+                        key0 = getattr(path[0], "key", None)
+                        if key0 in sparse_paths and g.ndim == 2:
+                            counts = jnp.zeros(
+                                (g.shape[0],), jnp.float32).at[
+                                ids_flat].add(1.0)
+                            vals = g[ids_flat] / counts[ids_flat][:, None]
+                            idx_g = lax.all_gather(ids_flat, manual,
+                                                   tiled=True)
+                            vals_g = lax.all_gather(vals, manual,
+                                                    tiled=True)
+                            red = jnp.zeros_like(g).at[idx_g].add(
+                                vals_g.astype(g.dtype)) / dpw
+                        else:
+                            red = lax.pmean(g, manual)
+                        reduced.append(red)
+                    grads = jax.tree_util.tree_unflatten(treedef, reduced)
+                    return lax.pmean(loss, manual), grads
+
+                # check_vma off: the scatter-add of all-gathered rows IS
+                # replicated (every shard adds the same gathered pairs) but
+                # the varying-axis analysis cannot prove it statically
+                loss, grads = jax.shard_map(
+                    region, mesh=mesh,
+                    in_specs=(P0, P0, P0, args_specs, kwargs_specs),
+                    out_specs=(P0, P0), axis_names=set(manual),
+                    check_vma=False)(
+                    params, scaler_state.loss_scale, rng, args, kwargs)
+                if prescale and predivide:
+                    grads = jax.tree.map(lambda g: g / predivide, grads)
+                return loss, grads
 
         replicated = self.mesh_ctx.replicated()
         self._grad_fn = jax.jit(
@@ -640,6 +753,11 @@ class DeepSpeedEngine:
             prof.start_profile()
             prof.profile_fn(self._grad_fn, self.params, self.scaler_state,
                             rng, *args, **kwargs)
+        if (self.eigenvalue is not None and self.quantizer is not None
+                and self._is_train_mode):
+            # curvature probes re-run the loss on the latest TRAIN batch;
+            # no quantizer = no consumer, don't pin the batch
+            self._last_batch = (args, kwargs)
         loss, grads = self._grad_fn(self.params, self.scaler_state,
                                     rng, *args, **kwargs)
         if profile_now:
@@ -712,13 +830,30 @@ class DeepSpeedEngine:
         elif self.lr_scheduler is not None:
             self.lr_scheduler.step(**(lr_kwargs or {}))
         if self.quantizer is not None and not step_skipped:
-            # MoQ post-step fake-quantization (reference engine.py:1427):
-            # compiled with the params' own shardings so no resharding or
-            # host sync sneaks in.
-            bits = self.quantizer.update_bits(self.global_steps)
-            if bits < 16:
-                self.params = self._quantize_fn(bits)(
-                    self.params, self._next_rng())
+            if (self.eigenvalue is not None and self._last_batch is not None
+                    and self.global_steps % max(
+                        1, self.eigenvalue.gas_boundary_resolution) == 0):
+                # reference engine.py:1478-1485: block curvature modulates
+                # each block's quantize period
+                self._block_eigs = self._compute_block_eigenvalues()
+            if self._block_eigs is not None:
+                # keep the global schedule advancing too so a resume with
+                # eigenvalue disabled continues the annealing trajectory
+                self.quantizer.update_bits(self.global_steps)
+                bits_map = self.quantizer.update_bits_per_block(
+                    self.global_steps, self._block_eigs)
+                if any(b < 16 for b in bits_map.values()):
+                    self.params = self._quantize_blocks_fn(
+                        tuple(sorted(bits_map.items())))(
+                        self.params, self._next_rng())
+            else:
+                # MoQ post-step fake-quantization (reference engine.py:1427):
+                # compiled with the params' own shardings so no resharding
+                # or host sync sneaks in.
+                bits = self.quantizer.update_bits(self.global_steps)
+                if bits < 16:
+                    self.params = self._quantize_fn(bits)(
+                        self.params, self._next_rng())
         self.tput_timer.stop(global_step=True)
 
         if self.global_steps % self.steps_per_print() == 0:
@@ -737,6 +872,82 @@ class DeepSpeedEngine:
                                             self.global_steps)
         if self.wall_clock_breakdown():
             self.timers(STEP_MICRO_TIMER).stop()
+
+    def _block_hvp(self, key):
+        """Compiled-once per-block Hessian-vector product: (params, v,
+        batch) are arguments, so re-probing a new batch reuses the XLA
+        program instead of recompiling the full fwd+bwd+jvp every step."""
+        cache = getattr(self, "_block_hvp_cache", None)
+        if cache is None:
+            cache = self._block_hvp_cache = {}
+        if key not in cache:
+            compute_dtype = self.compute_dtype
+            apply_model = self._apply_model
+
+            def hvp(params, v, args, kwargs):
+                def block_loss(block):
+                    merged = dict(params)
+                    merged[key] = block
+                    cp = _tree_cast(merged, compute_dtype)
+                    cargs = _tree_cast(args, compute_dtype)
+                    ckwargs = _tree_cast(kwargs, compute_dtype)
+                    out = apply_model(cp, None, *cargs, **ckwargs)
+                    return (out[0] if isinstance(out, tuple)
+                            else out).astype(jnp.float32)
+
+                return jax.jvp(jax.grad(block_loss),
+                               (params[key],), (v,))[1]
+
+            cache[key] = jax.jit(hvp)
+        return cache[key]
+
+    def _compute_block_eigenvalues(self):
+        """Per-top-level-block dominant Hessian eigenvalues on the latest
+        batch (reference: eigenvalue.py power iteration at gas boundaries)."""
+        import zlib
+        args, kwargs = self._last_batch
+        if not isinstance(self.params, dict):
+            # block decomposition needs a named top level; fall back to one
+            # whole-tree eigenvalue (uncached — rare path)
+            compute_dtype = self.compute_dtype
+            apply_model = self._apply_model
+
+            def loss_fn(p):
+                cp = _tree_cast(p, compute_dtype)
+                out = apply_model(cp, None,
+                                  *_tree_cast(args, compute_dtype),
+                                  **_tree_cast(kwargs, compute_dtype))
+                return (out[0] if isinstance(out, tuple) else out).astype(
+                    jnp.float32)
+
+            eig, _ = self.eigenvalue.compute_eigenvalue(
+                loss_fn, self.params, self._next_rng())
+            return {"__all__": eig}
+        rng = self._next_rng()
+        out = {}
+        for key in self.params:
+            hvp_fn = self._block_hvp(key)
+            v0 = self.eigenvalue.random_like(
+                self.params[key],
+                jax.random.fold_in(rng, zlib.crc32(str(key).encode())
+                                   & 0x7FFFFFFF))
+            eig, _ = self.eigenvalue.power_iterate(
+                lambda v: hvp_fn(self.params, v, args, kwargs), v0)
+            out[key] = eig
+        return out
+
+    def _quantize_blocks_fn(self, bits_items: tuple):
+        """Compiled per-block fake-quantization (bits_map is static)."""
+        cache = getattr(self, "_quantize_blocks_cache", None)
+        if cache is None:
+            cache = self._quantize_blocks_cache = {}
+        if bits_items not in cache:
+            qz = self.quantizer
+            bits_map = dict(bits_items)
+            cache[bits_items] = jax.jit(
+                lambda p, rng: qz.apply_tree_blocks(p, bits_map, rng),
+                out_shardings=self.param_shardings, donate_argnums=(0,))
+        return cache[bits_items]
 
     def _quantize_fn(self, bits: int):
         """Per-bit-width compiled fake-quantization preserving the engine's

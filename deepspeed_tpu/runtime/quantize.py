@@ -72,19 +72,33 @@ class Quantizer:
         self.symmetric = int(getattr(config, "quantize_type", 0)) == 0
         self.stochastic = int(getattr(config, "rounding", 0)) == 1
 
-    def update_bits(self, step: int) -> int:
+    def _advance(self, state: dict, step: int, factor: float = 1.0,
+                 label: str = "") -> int:
+        """Advance one {cur_bits, period, last_drop_step} schedule: halve
+        bits toward target when `period * factor` steps elapsed since the
+        last drop, doubling the period at each drop."""
         cfg = self.config
         if step < self.offset:
-            return self.cur_bits
-        if (self.cur_bits > cfg.target_bits and
-                step - self.last_drop_step >= self.period):
-            self.cur_bits = max(self.cur_bits // 2, int(cfg.target_bits))
-            self.last_drop_step = step
-            self.period *= 2
+            return state["cur_bits"]
+        if (state["cur_bits"] > cfg.target_bits and
+                step - state["last_drop_step"] >= state["period"] * factor):
+            state["cur_bits"] = max(state["cur_bits"] // 2,
+                                    int(cfg.target_bits))
+            state["last_drop_step"] = step
+            state["period"] *= 2
             if cfg.quantize_verbose:
-                log_dist(f"MoQ: step {step} -> {self.cur_bits} bits",
-                         ranks=[0])
-        return self.cur_bits
+                log_dist(f"MoQ{label}: step {step} -> "
+                         f"{state['cur_bits']} bits", ranks=[0])
+        return state["cur_bits"]
+
+    def update_bits(self, step: int) -> int:
+        state = {"cur_bits": self.cur_bits, "period": self.period,
+                 "last_drop_step": self.last_drop_step}
+        bits = self._advance(state, step)
+        self.cur_bits = state["cur_bits"]
+        self.period = state["period"]
+        self.last_drop_step = state["last_drop_step"]
+        return bits
 
     def apply_tree(self, params: Any, bits: int,
                    rng: Optional[jax.Array] = None) -> Any:
@@ -117,12 +131,64 @@ class Quantizer:
             return params
         return self.apply_tree(params, bits, rng)
 
+    # -- eigenvalue-modulated schedule (reference engine.py:1478-1485) --- #
+    def update_bits_per_block(self, step: int, block_eigs) -> dict:
+        """Per-top-level-block bit schedule driven by curvature: a block
+        whose dominant Hessian eigenvalue is large (quantization-sensitive)
+        gets its quantize period stretched, a flat block gets it shortened —
+        the reference's block_eigenvalue modulation of the MoQ schedule.
+
+        Returns {block_name: bits}; blocks absent from block_eigs follow the
+        global schedule."""
+        import math
+        cfg = self.config
+        eigs = {k: abs(float(v)) for k, v in block_eigs.items()}
+        finite = [v for v in eigs.values() if math.isfinite(v) and v > 0]
+        ref = sorted(finite)[len(finite) // 2] if finite else 1.0
+        if not hasattr(self, "_block_state"):
+            self._block_state = {}
+        bits_map = {}
+        for name, eig in eigs.items():
+            st = self._block_state.setdefault(name, {
+                "cur_bits": int(cfg.start_bits),
+                "period": int(cfg.quantize_period),
+                "last_drop_step": self.offset,
+            })
+            if not math.isfinite(eig) or eig <= 0:
+                factor = 1.0  # unusable probe: stay on the base schedule
+            else:
+                factor = min(2.0, max(0.5, eig / max(ref, 1e-12)))
+            bits_map[name] = self._advance(st, step, factor,
+                                           label=f"[eig:{name}]")
+        return bits_map
+
+    def apply_tree_blocks(self, params: Any, bits_map: dict,
+                          rng: Optional[jax.Array] = None) -> Any:
+        """Fake-quantize top-level blocks each at its own bit width
+        (16+ bits = leave untouched)."""
+        out = {}
+        for name, block in params.items():
+            bits = int(bits_map.get(name, 16))
+            if bits >= 16:
+                out[name] = block
+            else:
+                import zlib  # crc32: stable across processes (hash() salts)
+                key = (jax.random.fold_in(
+                    rng, zlib.crc32(str(name).encode()) & 0x7FFFFFFF)
+                    if rng is not None else None)
+                out[name] = self.apply_tree(block, bits, key)
+        return out
+
     # -- checkpoint: the annealing trajectory must survive resume -------- #
     def state_dict(self):
         return {"cur_bits": self.cur_bits, "period": self.period,
-                "last_drop_step": self.last_drop_step}
+                "last_drop_step": self.last_drop_step,
+                "block_state": dict(getattr(self, "_block_state", {}))}
 
     def load_state_dict(self, sd):
         self.cur_bits = int(sd["cur_bits"])
         self.period = int(sd["period"])
         self.last_drop_step = int(sd["last_drop_step"])
+        if sd.get("block_state"):
+            self._block_state = {k: dict(v)
+                                 for k, v in sd["block_state"].items()}

@@ -1,0 +1,144 @@
+"""Engine couplings the reference wires inside DeepSpeedEngine:
+sparse (CSR-style) embedding-grad reduction (engine.py:1729-1792) and the
+eigenvalue→MoQ schedule modulation (engine.py:1478-1485)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import GPT2Config, GPT2Model
+
+SEQ = 32
+GLOBAL_BATCH = 8
+
+
+def _model(tied=False):
+    # untied LM head: row-sparse embedding grads are only valid when the
+    # wte grad is the pure embedding scatter (see GPT2Model.sparse_grad_paths)
+    cfg = GPT2Config(vocab_size=128, n_positions=SEQ, hidden_size=32,
+                     num_layers=2, num_heads=4, bf16=False, embd_dropout=0.0,
+                     attn_dropout=0.0, hidden_dropout=0.0,
+                     tie_word_embeddings=tied)
+    return GPT2Model(cfg)
+
+
+def _train(extra_conf, steps=3, tp=1):
+    ds.reset_mesh_context()
+    mesh = ds.initialize_mesh(data=-1, model=tp)
+    model = _model()
+    dp = mesh.data_parallel_world_size
+    conf = {
+        "train_micro_batch_size_per_gpu": GLOBAL_BATCH // dp,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 10 ** 9,
+    }
+    conf.update(extra_conf)
+    engine, _, _, _ = ds.initialize(
+        model=model, config=conf,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)),
+        mesh=mesh, rng=jax.random.PRNGKey(7))
+    ids = np.asarray(jax.random.randint(jax.random.PRNGKey(1),
+                                        (GLOBAL_BATCH, SEQ), 0, 128),
+                     np.int32)
+    losses = []
+    for _ in range(steps):
+        loss = engine.forward(ids)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    params = jax.tree.map(np.asarray, engine.params)
+    ds.reset_mesh_context()
+    return losses, params, engine
+
+
+# ---------------------------------------------------------------------- #
+# sparse_gradients
+# ---------------------------------------------------------------------- #
+def test_sparse_gradients_matches_dense():
+    """The row-sparse (indices, values) reduction must be a pure layout
+    change: identical trajectory to the dense allreduce."""
+    dense_losses, dense_params, _ = _train({})
+    losses, params, engine = _train({"sparse_gradients": True})
+    np.testing.assert_allclose(losses, dense_losses, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(dense_params)):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
+
+
+def test_sparse_gradients_uses_gathered_rows():
+    """The compiled grad program must actually take the sparse path:
+    all_gather of (indices, rows) appears in the jaxpr where the dense
+    path has none."""
+    ds.reset_mesh_context()
+    mesh = ds.initialize_mesh(data=-1)
+    model = _model()
+    conf = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "sparse_gradients": True,
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = ds.initialize(
+        model=model, config=conf,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)),
+        mesh=mesh, rng=jax.random.PRNGKey(7))
+    ids = jax.numpy.zeros((8, SEQ), jax.numpy.int32)
+    jaxpr = str(jax.make_jaxpr(
+        lambda p, s, r: engine._grad_fn.__wrapped__(p, s, r, ids))(
+        engine.params, engine.scaler_state, jax.random.PRNGKey(0)))
+    assert "all_gather" in jaxpr
+    ds.reset_mesh_context()
+
+
+def test_sparse_gradients_rejects_zero2():
+    with pytest.raises(ValueError, match="stage"):
+        _train({"sparse_gradients": True,
+                "zero_optimization": {"stage": 2}}, steps=1)
+    ds.reset_mesh_context()
+
+
+def test_sparse_gradients_rejects_tensor_parallel():
+    with pytest.raises(ValueError, match="tensor"):
+        _train({"sparse_gradients": True}, steps=1, tp=2)
+    ds.reset_mesh_context()
+
+
+# ---------------------------------------------------------------------- #
+# eigenvalue -> MoQ
+# ---------------------------------------------------------------------- #
+def test_eigenvalue_drives_moq_schedule():
+    conf = {
+        "quantize_training": {
+            "enabled": True, "quantize_bits": {"start_bits": 16,
+                                               "target_bits": 8},
+            "quantize_schedule": {"quantize_period": 1,
+                                  "schedule_offset": 0},
+        },
+        "eigenvalue": {"enabled": True, "max_iter": 4, "tol": 0.1,
+                       "gas_boundary_resolution": 1},
+    }
+    losses, params, engine = _train(conf, steps=3)
+    # the probe ran and produced per-block curvature
+    assert engine._block_eigs is not None and len(engine._block_eigs) >= 3
+    assert all(np.isfinite(v) for v in engine._block_eigs.values())
+    # the per-block schedule advanced (blocks dropped bits independently)
+    blocks = engine.quantizer.state_dict()["block_state"]
+    assert blocks and any(st["cur_bits"] < 16 for st in blocks.values())
+    # curvature modulation: per-block periods may diverge from the global
+    periods = {k: st["period"] for k, st in blocks.items()}
+    assert len(periods) == len(engine._block_eigs)
+
+
+def test_eigenvalue_disabled_keeps_global_schedule():
+    conf = {
+        "quantize_training": {
+            "enabled": True, "quantize_bits": {"start_bits": 16,
+                                               "target_bits": 8},
+            "quantize_schedule": {"quantize_period": 1,
+                                  "schedule_offset": 0},
+        },
+    }
+    losses, params, engine = _train(conf, steps=2)
+    assert engine._block_eigs is None
+    assert engine.quantizer.cur_bits < 16  # global path advanced
