@@ -469,6 +469,9 @@ class QuantizeTrainingConfig:
 @dataclass
 class CheckpointConfig:
     tag_validation: str = C.CHECKPOINT_TAG_VALIDATION_DEFAULT
+    # None = auto: sharded whenever multi-process (a consolidated save
+    # would gather non-addressable arrays); True/False forces the layout.
+    sharded: Optional[bool] = None
 
     @staticmethod
     def from_dict(d: Optional[Dict[str, Any]]) -> "CheckpointConfig":
@@ -479,7 +482,8 @@ class CheckpointConfig:
             raise DeepSpeedConfigError(
                 "Checkpoint config {} only supports {}".format(
                     C.CHECKPOINT_TAG_VALIDATION, C.CHECKPOINT_TAG_VALIDATION_MODES))
-        return CheckpointConfig(tag_validation=mode)
+        return CheckpointConfig(tag_validation=mode,
+                                sharded=d.get("sharded"))
 
 
 @dataclass

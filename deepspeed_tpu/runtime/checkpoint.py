@@ -83,7 +83,7 @@ def save_checkpoint_state(save_dir: str, tag: str, module_state: Any,
             f"zero_pp_rank_{dp_rank}_mp_rank_{mp_rank:02d}_optim_states.npz")
         np.savez(optim_file, **_flatten(optimizer_state))
 
-    meta = {"client_state": _jsonable(client_state or {})}
+    meta = {"client_state": jsonable(client_state or {})}
     with open(os.path.join(ckpt_dir, "ds_meta.json"), "w") as f:
         json.dump(meta, f)
 
@@ -157,11 +157,12 @@ def consolidate_to_fp32(ckpt_dir: str, tag: Optional[str] = None,
     return weights
 
 
-def _jsonable(obj):
+def jsonable(obj):
+    """Best-effort JSON coercion for client-state metadata."""
     if isinstance(obj, dict):
-        return {str(k): _jsonable(v) for k, v in obj.items()}
+        return {str(k): jsonable(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
-        return [_jsonable(v) for v in obj]
+        return [jsonable(v) for v in obj]
     if isinstance(obj, (np.integer,)):
         return int(obj)
     if isinstance(obj, (np.floating,)):
@@ -171,3 +172,6 @@ def _jsonable(obj):
     if hasattr(obj, "item") and getattr(obj, "ndim", 1) == 0:
         return obj.item()
     return obj
+
+
+_jsonable = jsonable  # backwards-compat alias

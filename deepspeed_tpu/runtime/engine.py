@@ -831,10 +831,13 @@ class DeepSpeedEngine:
             self.lr_scheduler.step(**(lr_kwargs or {}))
         if self.quantizer is not None and not step_skipped:
             if (self.eigenvalue is not None and self._last_batch is not None
+                    and isinstance(self.params, dict)
                     and self.global_steps % max(
                         1, self.eigenvalue.gas_boundary_resolution) == 0):
                 # reference engine.py:1478-1485: block curvature modulates
-                # each block's quantize period
+                # each block's quantize period.  Non-dict param trees have
+                # no named blocks to modulate — they stay on the global
+                # schedule below.
                 self._block_eigs = self._compute_block_eigenvalues()
             if self._block_eigs is not None:
                 # keep the global schedule advancing too so a resume with
@@ -1031,6 +1034,12 @@ class DeepSpeedEngine:
             "scaler": self.scaler_state,
         }
 
+    def _sharded_checkpoints(self) -> bool:
+        cfg = self.config.checkpoint_config.sharded
+        if cfg is not None:
+            return bool(cfg)
+        return jax.process_count() > 1
+
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True):
         if tag is None:
@@ -1053,9 +1062,22 @@ class DeepSpeedEngine:
                            if self.curriculum_scheduler is not None
                            else None),
         })
-        path = ckpt_mod.save_checkpoint_state(
-            save_dir, tag, module_state={"module": self.params},
-            optimizer_state=self._engine_state(), client_state=client)
+        if self._sharded_checkpoints():
+            # per-process shard files keyed by global slice (reference:
+            # engine.py:1821-1878 per-rank model/optim shards) — no host
+            # materializes the full model
+            from . import sharded_checkpoint as sc
+            path = os.path.join(save_dir, str(tag))
+            sc.save_sharded(path, "model", {"module": self.params})
+            # offload-tier optimizer states are host numpy arrays — the
+            # sharded writer stores those whole from process 0
+            sc.save_sharded(path, "optim", self._engine_state())
+            sc.finalize_checkpoint(save_dir, tag, client,
+                                   save_latest=save_latest)
+        else:
+            path = ckpt_mod.save_checkpoint_state(
+                save_dir, tag, module_state={"module": self.params},
+                optimizer_state=self._engine_state(), client_state=client)
         log_dist(f"saved checkpoint {path}", ranks=[0])
         return path
 
@@ -1065,9 +1087,36 @@ class DeepSpeedEngine:
         module_tmpl = {"module": self.params}
         opt_tmpl = (None if load_module_only or not load_optimizer_states
                     else self._engine_state())
-        module_state, opt_state, client = ckpt_mod.load_checkpoint_state(
-            load_dir, tag, module_tmpl, opt_tmpl,
-            strict=load_module_strict)
+        resolved_tag = tag or ckpt_mod.read_latest_tag(load_dir)
+        sharded_index = os.path.join(load_dir, str(resolved_tag),
+                                     "model_index.json")
+        if os.path.isfile(sharded_index):
+            # sharded layout: assemble each device's local slice from the
+            # overlapping stored shards — restore across a DIFFERENT dp/mp
+            # world size is the same path (reference elastic checkpoint,
+            # stage2.py:1948-2126)
+            import json
+            from . import sharded_checkpoint as sc
+            path = os.path.join(load_dir, str(resolved_tag))
+            module_state = sc.load_sharded(path, "model", module_tmpl,
+                                           strict=load_module_strict)
+            opt_state = None
+            if opt_tmpl is not None:
+                try:
+                    opt_state = sc.load_sharded(path, "optim", opt_tmpl)
+                except FileNotFoundError:
+                    # model-only checkpoint (e.g. consolidated export):
+                    # mirror the dense path's graceful None
+                    opt_state = None
+            client = {}
+            meta = os.path.join(path, "ds_meta.json")
+            if os.path.isfile(meta):
+                with open(meta) as f:
+                    client = json.load(f).get("client_state", {})
+        else:
+            module_state, opt_state, client = ckpt_mod.load_checkpoint_state(
+                load_dir, tag, module_tmpl, opt_tmpl,
+                strict=load_module_strict)
         self.params = module_state["module"]
         if opt_state is not None:
             if self._offload_enabled:

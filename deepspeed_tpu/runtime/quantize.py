@@ -165,10 +165,11 @@ class Quantizer:
     def apply_tree_blocks(self, params: Any, bits_map: dict,
                           rng: Optional[jax.Array] = None) -> Any:
         """Fake-quantize top-level blocks each at its own bit width
-        (16+ bits = leave untouched)."""
+        (16+ bits = leave untouched); blocks absent from bits_map follow
+        the global schedule's current bits."""
         out = {}
         for name, block in params.items():
-            bits = int(bits_map.get(name, 16))
+            bits = int(bits_map.get(name, self.cur_bits))
             if bits >= 16:
                 out[name] = block
             else:

@@ -1,0 +1,80 @@
+"""Worker script for the 2-process jax.distributed checkpoint test.
+
+Each process owns 4 virtual CPU devices (global mesh = 8); the training
+batch is fed per-process (make_array_from_process_local_data), the engine
+saves the sharded per-process checkpoint layout, and process 0's shard
+files must NOT contain the other process's slices.
+
+Usage: python distributed_ckpt_worker.py <coord> <num_procs> <proc_id> <dir>
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    coord, nprocs, pid, workdir = (sys.argv[1], int(sys.argv[2]),
+                                   int(sys.argv[3]), sys.argv[4])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=4")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=nprocs, process_id=pid)
+    assert jax.process_count() == nprocs
+    assert len(jax.devices()) == nprocs * 4
+
+    import numpy as np
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT2Config, GPT2Model
+
+    mesh = ds.initialize_mesh(data=-1)
+    cfg = GPT2Config(vocab_size=64, n_positions=16, hidden_size=32,
+                     num_layers=2, num_heads=4, bf16=False, embd_dropout=0.0,
+                     attn_dropout=0.0, hidden_dropout=0.0)
+    model = GPT2Model(cfg)
+    conf = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = ds.initialize(
+        model=model, config=conf,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)),
+        mesh=mesh, rng=jax.random.PRNGKey(1))
+
+    # global batch 8, each process feeds ITS half (rows 4p..4p+4)
+    full = np.asarray(jax.random.randint(jax.random.PRNGKey(2), (8, 16),
+                                         0, 64), np.int32)
+    local = full[pid * 4:(pid + 1) * 4]
+    losses = []
+    for _ in range(2):
+        loss = engine.forward(local)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+
+    # tag agreement check runs across both processes
+    engine.save_checkpoint(workdir, tag="tag0")
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("ckpt_saved")
+
+    # every process restores; trajectory continues
+    engine.load_checkpoint(workdir, tag="tag0")
+    loss = engine.forward(local)
+    engine.backward(loss)
+    engine.step()
+
+    out = {"pid": pid, "losses": losses, "final_loss": float(loss),
+           "shard_file": f"model_shards_p{pid:05d}.npz"}
+    with open(os.path.join(workdir, f"result_p{pid}.json"), "w") as f:
+        json.dump(out, f)
+    multihost_utils.sync_global_devices("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,206 @@
+"""Sharded checkpoint layout (runtime/sharded_checkpoint.py): per-process
+slice-keyed shard files, resharding-on-load, dp-resize restore, offline
+fp32 consolidation, and a REAL 2-process jax.distributed run.
+
+Reference: engine.py:1821-1878 per-rank shard files; stage2.py:1948-2126
+elastic dp-resize; utils/zero_to_fp32.py:281 consolidation; the reference's
+multi-process unit harness is tests/unit/common.py:16 distributed_test.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import GPT2Config, GPT2Model
+from deepspeed_tpu.runtime import sharded_checkpoint as sc
+
+SEQ = 16
+GLOBAL_BATCH = 8
+
+
+def _mesh(n):
+    ds.reset_mesh_context()
+    return ds.initialize_mesh(data=-1, devices=jax.devices()[:n])
+
+
+def test_save_load_roundtrip_resharded(tmp_path):
+    """Shards written under one sharding must reassemble exactly under a
+    DIFFERENT sharding (the dp-resize primitive)."""
+    mesh8 = _mesh(8)
+    x = jnp.arange(64 * 6, dtype=jnp.float32).reshape(64, 6)
+    xs = jax.device_put(x, NamedSharding(mesh8.mesh, P("data", None)))
+    sc.save_sharded(str(tmp_path), "model", {"w": xs, "b": np.arange(3)})
+
+    mesh4 = _mesh(4)
+    tmpl = {"w": jax.device_put(jnp.zeros((64, 6)),
+                                NamedSharding(mesh4.mesh, P("data", None))),
+            "b": np.zeros(3, np.int64)}
+    out = sc.load_sharded(str(tmp_path), "model", tmpl)
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(x))
+    np.testing.assert_array_equal(out["b"], np.arange(3))
+    assert out["w"].sharding.is_equivalent_to(
+        NamedSharding(mesh4.mesh, P("data", None)), 2)
+    ds.reset_mesh_context()
+
+
+def test_bfloat16_roundtrip(tmp_path):
+    """npz degrades bf16 to a '|V2' void payload — the catalog must re-view
+    it from the index dtype (default models are bf16)."""
+    import ml_dtypes
+    mesh8 = _mesh(8)
+    x = jnp.arange(32 * 4, dtype=jnp.bfloat16).reshape(32, 4)
+    xs = jax.device_put(x, NamedSharding(mesh8.mesh, P("data", None)))
+    sc.save_sharded(str(tmp_path), "model", {"w": xs})
+    tmpl = {"w": jax.device_put(
+        jnp.zeros((32, 4), jnp.bfloat16),
+        NamedSharding(mesh8.mesh, P("data", None)))}
+    out = sc.load_sharded(str(tmp_path), "model", tmpl)
+    assert out["w"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(out["w"]).view(np.uint16),
+        np.asarray(x).view(np.uint16))
+    # consolidation upcasts to fp32
+    cons = sc.consolidate_sharded_to_fp32(str(tmp_path), "model")
+    vals = list(cons.values())[0]
+    assert vals.dtype == np.float32
+    np.testing.assert_array_equal(vals, np.asarray(x, np.float32))
+    ds.reset_mesh_context()
+
+
+def _train(nsteps, n_devices, tmp_path=None, save_at=None, load_from=None,
+           tag="t0"):
+    mesh = _mesh(n_devices)
+    cfg = GPT2Config(vocab_size=64, n_positions=SEQ, hidden_size=32,
+                     num_layers=2, num_heads=4, bf16=False, embd_dropout=0.0,
+                     attn_dropout=0.0, hidden_dropout=0.0)
+    model = GPT2Model(cfg)
+    dp = mesh.data_parallel_world_size
+    conf = {
+        "train_micro_batch_size_per_gpu": GLOBAL_BATCH // dp,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+        "checkpoint": {"sharded": True},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = ds.initialize(
+        model=model, config=conf,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)),
+        mesh=mesh, rng=jax.random.PRNGKey(1))
+    if load_from is not None:
+        engine.load_checkpoint(load_from, tag=tag)
+    ids = np.asarray(jax.random.randint(jax.random.PRNGKey(2),
+                                        (GLOBAL_BATCH, SEQ), 0, 64),
+                     np.int32)
+    losses = []
+    for step in range(nsteps):
+        loss = engine.forward(ids)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+        if save_at is not None and engine.global_steps == save_at:
+            engine.save_checkpoint(str(tmp_path), tag=tag)
+    ds.reset_mesh_context()
+    return losses, engine
+
+
+def test_dp_resize_restore(tmp_path):
+    """Kill-and-resume at a different dp world size reproduces the loss
+    curve (matched global batch): dp=8 saves at step 2, dp=4 resumes."""
+    full_losses, _ = _train(4, 8)
+    _train(2, 8, tmp_path=tmp_path, save_at=2)
+    resumed_losses, engine = _train(2, 4, load_from=str(tmp_path))
+    assert engine.global_steps == 4
+    np.testing.assert_allclose(resumed_losses, full_losses[2:], rtol=1e-5)
+
+
+def test_engine_sharded_layout_files(tmp_path):
+    _train(1, 8, tmp_path=tmp_path, save_at=1)
+    ckpt = tmp_path / "t0"
+    assert (ckpt / "model_index.json").is_file()
+    assert (ckpt / "model_shards_p00000.npz").is_file()
+    assert (ckpt / "optim_shards_p00000.npz").is_file()
+    # index covers every leaf with shapes
+    idx = json.loads((ckpt / "model_index.json").read_text())
+    assert any("wte" in k for k in idx)
+
+
+def test_consolidate_sharded_to_fp32(tmp_path):
+    _, engine0 = _train(1, 8, tmp_path=tmp_path, save_at=1)
+    out = sc.consolidate_sharded_to_fp32(str(tmp_path / "t0"), "model")
+    assert all(v.dtype == np.float32 for v in out.values()
+               if np.issubdtype(np.asarray(v).dtype, np.floating))
+    # consolidated weights equal the engine's own (gathered) params
+    flat = {jax.tree_util.keystr(p): np.asarray(l) for p, l in
+            jax.tree_util.tree_flatten_with_path(
+                {"module": engine0.params})[0]}
+    for k, v in out.items():
+        np.testing.assert_allclose(v, flat[k].astype(np.float32),
+                                   rtol=1e-6)
+
+
+def test_expert_shards_stored_separately(tmp_path):
+    """MoE analog of the reference's per-expert checkpoint files
+    (engine.py:2230-2298): expert-stacked leaves sharded over the expert
+    axis produce one slice-keyed shard entry per expert partition, so
+    experts restore independently under a different expert-parallel size."""
+    ds.reset_mesh_context()
+    mesh = ds.initialize_mesh(data=-1, expert=4)
+    w = jnp.arange(4 * 8 * 8, dtype=jnp.float32).reshape(4, 8, 8)
+    ws = jax.device_put(
+        w, NamedSharding(mesh.mesh, P("expert", None, None)))
+    sc.save_sharded(str(tmp_path), "model", {"experts": ws})
+    with np.load(tmp_path / "model_shards_p00000.npz") as z:
+        expert_keys = [k for k in z.files if "experts" in k]
+    assert len(expert_keys) == 4  # one slice entry per expert shard
+    # reload onto expert=2 topology
+    mesh2 = ds.initialize_mesh(data=-1, expert=2)
+    tmpl = {"experts": jax.device_put(
+        jnp.zeros((4, 8, 8)),
+        NamedSharding(mesh2.mesh, P("expert", None, None)))}
+    out = sc.load_sharded(str(tmp_path), "model", tmpl)
+    np.testing.assert_array_equal(np.asarray(out["experts"]), np.asarray(w))
+    ds.reset_mesh_context()
+
+
+@pytest.mark.timeout(600)
+def test_two_process_distributed_checkpoint(tmp_path):
+    """Real 2-process jax.distributed run: per-process batch feeding
+    (make_array_from_process_local_data), cross-process checkpoint tag
+    agreement, per-process shard files, save/load round-trip."""
+    port = socket.socket()
+    port.bind(("127.0.0.1", 0))
+    coord = f"127.0.0.1:{port.getsockname()[1]}"
+    port.close()
+    worker = os.path.join(os.path.dirname(__file__),
+                          "distributed_ckpt_worker.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))) +
+        os.pathsep + env.get("PYTHONPATH", ""))
+    procs = [subprocess.Popen(
+        [sys.executable, worker, coord, "2", str(pid), str(tmp_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for pid in range(2)]
+    outs = [p.communicate(timeout=540)[0].decode() for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+    # both processes wrote their own shard files
+    assert (tmp_path / "tag0" / "model_shards_p00000.npz").is_file()
+    assert (tmp_path / "tag0" / "model_shards_p00001.npz").is_file()
+    results = [json.loads((tmp_path / f"result_p{pid}.json").read_text())
+               for pid in range(2)]
+    # both processes observed identical (global) losses
+    np.testing.assert_allclose(results[0]["losses"], results[1]["losses"],
+                               rtol=1e-6)
+    np.testing.assert_allclose(results[0]["final_loss"],
+                               results[1]["final_loss"], rtol=1e-6)
