@@ -1,0 +1,140 @@
+"""GPT-2 as a PipelineModule — the 3D-parallel flagship assembly.
+
+Reference: the Megatron-GPT2 + PipelineModule composition the reference's
+model-level tests exercise (tests/model/run_func_test.py:606 mp×zero matrix;
+pipe/module.py:87).  Body blocks are DeepSpeedTransformerLayers, so the
+pipeline engine picks up their Megatron column/row TP specs automatically
+(pipe/engine.py _make_partition_specs) and 3D = pipe × data/ZeRO × model
+falls out of the mesh.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..ops.activations import dropout
+from ..ops.normalize import fused_layer_norm
+from ..runtime.pipe.module import (LayerSpec, PipeLayer, PipelineModule,
+                                   TiedLayerSpec)
+from .gpt2 import GPT2Config
+
+
+class GPT2EmbedPipe(PipeLayer):
+    """wte + wpe lookup (reference: the embedding stage of a Megatron
+    pipeline)."""
+
+    def __init__(self, cfg: GPT2Config):
+        self.cfg = cfg
+
+    def init_params(self, rng, x):
+        cfg = self.cfg
+        k1, k2 = jax.random.split(rng)
+        init = jax.nn.initializers.normal(cfg.initializer_range)
+        return {"wte": init(k1, (cfg.vocab_size, cfg.hidden_size),
+                            jnp.float32),
+                "wpe": init(k2, (cfg.n_positions, cfg.hidden_size),
+                            jnp.float32)}
+
+    def apply(self, params, input_ids, rng=None):
+        cfg = self.cfg
+        wte = params["wte"].astype(cfg.dtype)
+        wpe = params["wpe"].astype(cfg.dtype)
+        h = wte[input_ids] + wpe[jnp.arange(input_ids.shape[1])]
+        return dropout(h, cfg.embd_dropout, rng, deterministic=rng is None)
+
+
+class GPT2BlockPipe(PipeLayer):
+    """One transformer layer; carries the Megatron TP specs so the
+    pipeline engine shards qkv/mlp over the "model" axis."""
+
+    def __init__(self, cfg: GPT2Config):
+        from ..ops.transformer import DeepSpeedTransformerLayer
+        self.cfg = cfg
+        self.layer = DeepSpeedTransformerLayer(cfg.layer_config())
+
+    def init_params(self, rng, x):
+        return self.layer.init_params(rng)
+
+    def apply(self, params, x, rng=None):
+        return self.layer(params, x, rng=rng, deterministic=rng is None)
+
+    def param_partition_specs(self):
+        return type(self.layer).param_partition_specs()
+
+
+class GPT2HeadPipe(PipeLayer):
+    """Final LN + (untied) LM head producing fp32 logits."""
+
+    def __init__(self, cfg: GPT2Config):
+        self.cfg = cfg
+
+    def init_params(self, rng, x):
+        cfg = self.cfg
+        init = jax.nn.initializers.normal(cfg.initializer_range)
+        return {"ln_f": {"w": jnp.ones((cfg.hidden_size,), jnp.float32),
+                         "b": jnp.zeros((cfg.hidden_size,), jnp.float32)},
+                "lm_head": init(rng, (cfg.hidden_size, cfg.vocab_size),
+                                jnp.float32)}
+
+    def apply(self, params, h, rng=None):
+        cfg = self.cfg
+        h = fused_layer_norm(h, params["ln_f"]["w"], params["ln_f"]["b"],
+                             cfg.layer_norm_eps)
+        head = params["lm_head"].astype(h.dtype)
+        return (h @ head).astype(jnp.float32)
+
+
+class GPT2FinalLNPipe(PipeLayer):
+    """Final LayerNorm alone (tied-head pipelines: the projection lives in
+    the tied embed spec)."""
+
+    def __init__(self, cfg: GPT2Config):
+        self.cfg = cfg
+
+    def init_params(self, rng, x):
+        cfg = self.cfg
+        return {"w": jnp.ones((cfg.hidden_size,), jnp.float32),
+                "b": jnp.zeros((cfg.hidden_size,), jnp.float32)}
+
+    def apply(self, params, h, rng=None):
+        return fused_layer_norm(h, params["w"], params["b"],
+                                self.cfg.layer_norm_eps)
+
+
+def gpt2_next_token_loss(logits, input_ids):
+    """Shift-by-one LM loss over the microbatch's own ids as labels."""
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits[:, :-1], input_ids[:, 1:].astype(jnp.int32)).mean()
+
+
+def gpt2_pipeline_module(cfg: GPT2Config,
+                         num_stages: Optional[int] = None,
+                         activation_checkpoint_interval: int = 0
+                         ) -> PipelineModule:
+    """GPT-2 as [embed] + num_layers × [block] + [ln_f, head] pipeline
+    stages.  cfg.tie_word_embeddings routes the LM projection through a
+    TiedLayerSpec sharing the embed stage's wte (reference:
+    pipe/module.py:73 tied input/output embeddings); untied uses an
+    independent lm_head.
+
+    The loss consumes (logits, labels) where the dataloader feeds
+    (input_ids, input_ids) — next-token shift happens in the loss.
+    """
+    blocks = [LayerSpec(GPT2BlockPipe, cfg) for _ in range(cfg.num_layers)]
+    if cfg.tie_word_embeddings:
+        def tied_head(params, h):
+            head = params["wte"].astype(h.dtype).T
+            return (h @ head).astype(jnp.float32)
+
+        layers = ([TiedLayerSpec("embed", GPT2EmbedPipe, cfg)] + blocks +
+                  [LayerSpec(GPT2FinalLNPipe, cfg),
+                   TiedLayerSpec("embed", GPT2EmbedPipe, cfg,
+                                 forward_fn=tied_head)])
+    else:
+        layers = ([LayerSpec(GPT2EmbedPipe, cfg)] + blocks +
+                  [LayerSpec(GPT2HeadPipe, cfg)])
+    return PipelineModule(
+        layers, num_stages=num_stages, loss_fn=gpt2_next_token_loss,
+        activation_checkpoint_interval=activation_checkpoint_interval)

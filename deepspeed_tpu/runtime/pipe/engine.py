@@ -71,6 +71,13 @@ class PipelineEngine(DeepSpeedEngine):
         assert isinstance(model, PipelineModule), \
             "PipelineEngine needs a PipelineModule"
         ctx = resolve_mesh_ctx(config, mesh)
+        if ctx.expert_parallel_world_size > 1:
+            raise NotImplementedError(
+                "pipeline × expert parallelism is not composed yet — run "
+                "MoE models under the non-pipeline engine (expert axis) or "
+                "the pipeline without an expert axis; silently combining "
+                "them would misroute the all-to-all over the pipe-sharded "
+                "buffers")
         num_stages = ctx.pipe_parallel_world_size
         if model.num_stages in (None, 1):
             model.num_stages = num_stages

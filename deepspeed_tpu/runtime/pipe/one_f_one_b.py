@@ -64,6 +64,7 @@ class TickTables:
     bwd_active: np.ndarray         # bool
     bwd_mb: np.ndarray             # int
     bwd_slot: np.ndarray           # int
+    bwd_from_fwd: np.ndarray       # bool — bwd consumes this tick's fwd input
 
     @property
     def max_slots(self) -> int:
@@ -173,6 +174,11 @@ def simulate_global_clock(micro_batches: int, stages: int) -> TickTables:
             bwd_mb[tt, s] = mb
     fwd_slot = fwd_mb % slot_counts[None, :]
     bwd_slot = bwd_mb % slot_counts[None, :]
+    # A backward can share its tick with the SAME microbatch's forward
+    # (always on the last stage, where the loss cotangent is consumed
+    # in-tick; with one stage that is also the parking stage, so the input
+    # must come from the forward lane's fresh read, not the pre-park store).
+    bwd_from_fwd = fwd_active & bwd_active & (fwd_mb == bwd_mb)
     # inbound wave: what stage s-1 forwards at tick t arrives at stage s at
     # the end of tick t (consumed at t+1 or later from the slot store)
     in_active = np.zeros((T, S), bool)
@@ -183,7 +189,8 @@ def simulate_global_clock(micro_batches: int, stages: int) -> TickTables:
         num_ticks=T, num_stages=S, micro_batches=M, slot_counts=slot_counts,
         fwd_active=fwd_active, fwd_mb=fwd_mb, fwd_slot=fwd_slot,
         in_active=in_active, in_slot=in_slot,
-        bwd_active=bwd_active, bwd_mb=bwd_mb, bwd_slot=bwd_slot)
+        bwd_active=bwd_active, bwd_mb=bwd_mb, bwd_slot=bwd_slot,
+        bwd_from_fwd=bwd_from_fwd)
 
 
 def _mask_tree(active, tree):
@@ -211,7 +218,8 @@ def make_1f1b_grad_fn(*, module, constrain, stage_apply: Callable,
         jnp.asarray, (
             tables.fwd_active, tables.fwd_mb, tables.fwd_slot,
             tables.in_active, tables.in_slot,
-            tables.bwd_active, tables.bwd_mb, tables.bwd_slot))
+            tables.bwd_active, tables.bwd_mb, tables.bwd_slot,
+            tables.bwd_from_fwd))
 
     def bmask(flags, ref):
         """[S] bool → broadcastable against [S, ...] ref."""
@@ -253,7 +261,8 @@ def make_1f1b_grad_fn(*, module, constrain, stage_apply: Callable,
 
         def tick(carry, xs):
             (rot, cot, g_blocks, g_pre, g_post, g_tied, loss_acc) = carry
-            (f_act, f_mb, f_slot, i_act, i_slot, b_act, b_mb, b_slot) = xs
+            (f_act, f_mb, f_slot, i_act, i_slot, b_act, b_mb, b_slot,
+             b_from_f) = xs
 
             # ---- BackwardPass input read: FIRST, before any slot write -- #
             # A backward can share its tick (and slot) with this tick's
@@ -277,6 +286,9 @@ def make_1f1b_grad_fn(*, module, constrain, stage_apply: Callable,
             y = jax.vmap(stage_apply, in_axes=(0, 0, 0, 0, None))(
                 blocks, x_in, f_mb, stage_ids, rng_body)
             y = c_wave(y)
+            # same-tick fwd+bwd of one microbatch: the backward's input is
+            # the forward lane's fresh (post-park) read
+            x_saved = jnp.where(bmask(b_from_f, x_saved), x_in, x_saved)
 
             # ---- loss head + cotangent seed (last stage) --------------- #
             out_last = y[S - 1]
