@@ -14,12 +14,14 @@ Hardened per round-1 failure (BENCH_r01 rc=1 at first dispatch): backend init
 is retried with backoff, and ANY failure still emits a single diagnostic JSON
 line instead of a bare traceback.
 
-Ladder: `python bench.py --config {gpt2|bert_z2|decode}` selects other
-BASELINE.md anchor points; default is the flagship gpt2.
+Ladder: `python bench.py --config {gpt2|bert_z2|decode|moe|infinity}`
+selects other BASELINE.md anchor points; default is the flagship gpt2.
+DS_BENCH_ITERS overrides the timing iteration count (CI smoke).
 """
 
 import argparse
 import json
+import os
 import sys
 import time
 import traceback
@@ -62,6 +64,8 @@ def _peak_tflops():
 
 
 def _time_steps(step, warmup=3, iters=30):
+    iters = max(1, int(os.environ.get("DS_BENCH_ITERS", iters)))
+    warmup = min(warmup, iters)
     for _ in range(warmup):
         loss = step()
     float(loss)  # scalar fetch — the only reliable sync through the tunnel
@@ -183,7 +187,7 @@ def bench_decode():
     out = engine.generate(ids, max_new_tokens=gen)  # compile
     np.asarray(out)
     t0 = time.time()
-    iters = 5
+    iters = max(1, int(os.environ.get("DS_BENCH_ITERS", 5)))
     for _ in range(iters):
         out = engine.generate(ids, max_new_tokens=gen)
     np.asarray(out)
@@ -198,12 +202,120 @@ def bench_decode():
     }
 
 
+def bench_moe():
+    """GPT-2-small + MoE FFN throughput on one chip (GShard top-2 gating;
+    the BASELINE.md GPT-MoE ladder point, single-chip anchor)."""
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.moe import MoE
+
+    batch, seq, d = 8, 1024, 768
+    mesh = ds.initialize_mesh(data=-1)
+    moe = MoE(hidden_size=d, num_experts=4, k=2, capacity_factor=1.25)
+    rng = jax.random.PRNGKey(0)
+    x0 = jnp.zeros((batch * seq, d), jnp.bfloat16)
+    moe_params = moe.init_params(rng, x0)
+    head = jax.random.normal(jax.random.PRNGKey(1), (d, d),
+                             jnp.float32) * 0.02
+    params = {"moe": moe_params, "head": head}
+
+    def model(p, rng, x, y):
+        h, l_aux, _ = moe.apply(p["moe"], x, rng=rng)
+        pred = h @ p["head"].astype(h.dtype)
+        return jnp.mean((pred.astype(jnp.float32) - y) ** 2) + 0.01 * l_aux
+
+    config = {
+        "train_micro_batch_size_per_gpu": batch * seq,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config,
+                                    model_parameters=params, mesh=mesh)
+    rng_np = np.random.RandomState(0)
+    xb = rng_np.randn(batch * seq, d).astype(np.float32)
+    yb = rng_np.randn(batch * seq, d).astype(np.float32)
+
+    def step():
+        loss = engine.forward(xb, yb)
+        engine.backward(loss)
+        engine.step()
+        return loss
+
+    dt, final_loss, n = _time_steps(step)
+    tokens_per_sec = n * batch * seq / dt
+    return {
+        "metric": "moe_top2_train_tokens_per_sec_1chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,  # no single-chip MoE anchor in BASELINE.md
+        "num_experts": 4, "final_loss": round(final_loss, 4),
+    }
+
+
+def bench_infinity():
+    """ZeRO-Infinity layer streaming on one chip: GPT-2 124M with params
+    AND optimizer states on NVMe (the BASELINE.md max-model-per-chip
+    ladder point — throughput of the streamed step)."""
+    import jax
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT2Config, GPT2Model
+
+    batch, seq = 4, 1024
+    mesh = ds.initialize_mesh(data=1, devices=jax.devices()[:1])
+    cfg = GPT2Config(n_positions=seq, bf16=True)
+    model = GPT2Model(cfg)
+    config = {
+        "train_micro_batch_size_per_gpu": batch,
+        "optimizer": {"type": "AdamW", "params": {"lr": 6e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {
+            "stage": 3,
+            "offload_param": {"device": "nvme",
+                              "nvme_path": "/tmp/ds_tpu_bench_nvme"},
+            "offload_optimizer": {"device": "nvme",
+                                  "nvme_path": "/tmp/ds_tpu_bench_nvme"}},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = ds.initialize(
+        model=model, config=config,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)),
+        mesh=mesh)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32)
+
+    def step():
+        loss = engine.forward(ids)
+        engine.backward(loss)
+        engine.step()
+        return loss
+
+    dt, final_loss, n = _time_steps(step, warmup=2, iters=8)
+    tokens_per_sec = n * batch * seq / dt
+    tflops = tokens_per_sec * cfg.flops_per_token() / 1e12
+    return {
+        "metric": "gpt2_124m_infinity_nvme_tokens_per_sec_1chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tflops / REFERENCE_TFLOPS, 3),
+        "tflops_per_chip": round(tflops, 2),
+        "hbm_groups_resident": engine.max_live_param_groups,
+        "final_loss": round(final_loss, 4),
+    }
+
+
 BENCHES = {"gpt2": bench_gpt2, "bert_z2": bench_bert_z2,
-           "decode": bench_decode}
+           "decode": bench_decode, "moe": bench_moe,
+           "infinity": bench_infinity}
 METRIC_NAMES = {  # error-path metric must match the success-path name
     "gpt2": ("gpt2_124m_train_tokens_per_sec_1chip", "tokens/s"),
     "bert_z2": ("bert_large_z2_samples_per_sec_1chip", "samples/s"),
     "decode": ("gpt2_124m_decode_tokens_per_sec_1chip", "tokens/s"),
+    "moe": ("moe_top2_train_tokens_per_sec_1chip", "tokens/s"),
+    "infinity": ("gpt2_124m_infinity_nvme_tokens_per_sec_1chip",
+                 "tokens/s"),
 }
 
 
