@@ -33,10 +33,16 @@ PEAK_TFLOPS = {"v5 lite": 197.0, "v5e": 197.0, "v4": 275.0, "v5p": 459.0,
                "v6e": 918.0}
 
 
-def _init_backend(retries=4, delay=10.0):
-    """Initialize the JAX backend with retries (TPU tunnel can be flaky)."""
+def _init_backend(retries=None, delay=None):
+    """Initialize the JAX backend with retries (TPU tunnel can be flaky).
+
+    A stale claim can also block jax.devices() forever — main()'s watchdog
+    covers that case by emitting the diagnostic JSON line and exiting.
+    """
     import jax
 
+    retries = int(os.environ.get("DS_BENCH_INIT_RETRIES", retries or 4))
+    delay = float(os.environ.get("DS_BENCH_INIT_DELAY", delay or 15.0))
     last = None
     for attempt in range(retries):
         try:
@@ -323,13 +329,37 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="gpt2", choices=sorted(BENCHES))
     args = ap.parse_args()
+
+    # Watchdog: a stale TPU claim can wedge jax.devices() (or any first
+    # dispatch) FOREVER — the contract is one JSON line no matter what, so
+    # emit the diagnostic and exit before the driver's timeout fires.
+    # `finished` keeps a success that lands near the deadline from being
+    # followed by a second (error) line.
+    import threading
+
+    finished = threading.Event()
+
+    def watchdog():
+        time.sleep(float(os.environ.get("DS_BENCH_WATCHDOG", 1500)))
+        if finished.is_set():
+            return
+        metric, unit = METRIC_NAMES[args.config]
+        _emit({"metric": metric, "value": 0.0, "unit": unit,
+               "vs_baseline": 0.0,
+               "error": "bench wedged past watchdog (likely a stale TPU "
+                        "claim holding the tunnel's single slot)"})
+        os._exit(0)
+
+    threading.Thread(target=watchdog, daemon=True).start()
     try:
         devs = _init_backend()
         payload = BENCHES[args.config]()
         payload["platform"] = devs[0].platform
         payload["device_kind"] = devs[0].device_kind
+        finished.set()
         _emit(payload)
     except Exception as e:  # noqa: BLE001 — contract: always one JSON line
+        finished.set()
         metric, unit = METRIC_NAMES[args.config]
         _emit({
             "metric": metric,

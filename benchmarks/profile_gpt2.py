@@ -110,14 +110,13 @@ def main():
     tf = 8 * 2 * 8192 * 4096 * 4096 / t / 1e12
     print(f"    -> {tf:.1f} TFLOPS achievable")
 
+    # flops_per_token() already includes the LM-head matmul (Megatron-style
+    # accounting) — do not add it again
     flops = BATCH * SEQ * cfg.flops_per_token()
-    head_flops = 6 * BATCH * SEQ * cfg.hidden_size * cfg.vocab_size
-    print(f"step model-FLOPs (accounted): {flops/1e12:.2f} T, "
-          f"head extra: {head_flops/1e12:.2f} T")
+    print(f"step model-FLOPs (incl LM head): {flops/1e12:.2f} T")
 
     t = timeit("full step (dropout)", step_full, params, opt_state, rng)
-    print(f"    -> {flops / t / 1e12:.1f} TFLOPS accounted, "
-          f"{(flops + head_flops) / t / 1e12:.1f} incl head")
+    print(f"    -> {flops / t / 1e12:.1f} TFLOPS")
     t = timeit("full step (no dropout)", step_nodrop, params, opt_state)
     t = timeit("fwd only (dropout)", fwd_only, params, rng)
     t = timeit("fwd+bwd (dropout)", fwdbwd, params, rng)

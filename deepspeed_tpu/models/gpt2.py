@@ -46,6 +46,10 @@ class GPT2Config:
     activation_checkpointing: bool = False
     sparse_attention: Optional[object] = None  # a SparsityConfig
     tie_word_embeddings: bool = True
+    # chunked LM-head + cross-entropy: never materializes the [B,S,V] fp32
+    # logits (ops/fused_cross_entropy.py); the training-loss default
+    fused_loss: bool = True
+    fused_loss_chunk: int = 8192
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -79,11 +83,14 @@ class GPT2Config:
         return n
 
     def flops_per_token(self) -> int:
-        """Training FLOPs/token (fwd+bwd ≈ 6N + attention term), the
-        standard accounting used for MFU."""
+        """Training FLOPs/token (fwd+bwd ≈ 6N + attention + LM head), the
+        Megatron-style accounting used for MFU: the vocab projection is a
+        real [*, H]x[H, V] matmul on the MXU and belongs in the count
+        (the embedding LOOKUP does not)."""
         n = self.num_params(include_embeddings=False)
         attn = 12 * self.num_layers * self.hidden_size * self.n_positions
-        return 6 * n + attn
+        head = 6 * self.hidden_size * self.vocab_size
+        return 6 * n + attn + head
 
 
 class GPT2Model:
@@ -158,16 +165,19 @@ class GPT2Model:
         pos = position_offset + jnp.arange(input_ids.shape[1])
         return wte[input_ids] + wpe[pos]
 
+    def _head_matrix(self, params, dtype):
+        """[H, V] LM projection — tied wte.T or the independent lm_head
+        (the ONE place the tie decision lives)."""
+        if self.config.tie_word_embeddings:
+            return params["wte"].astype(dtype).T
+        return params["lm_head"].astype(dtype)
+
     def head_logits(self, params, h):
         """Final LN + (tied) LM head, fp32 logits."""
         cfg = self.config
         h = fused_layer_norm(h, params["ln_f"]["w"], params["ln_f"]["b"],
                              cfg.layer_norm_eps)
-        if cfg.tie_word_embeddings:
-            head = params["wte"].astype(h.dtype).T
-        else:
-            head = params["lm_head"].astype(h.dtype)
-        return (h @ head).astype(jnp.float32)
+        return (h @ self._head_matrix(params, h.dtype)).astype(jnp.float32)
 
     def hidden_states(self, params, input_ids, rng=None,
                       deterministic: bool = False, pld_theta=None):
@@ -294,10 +304,17 @@ class GPT2Model:
                 head = embed_g["wte"].astype(hs.dtype).T
             else:
                 head = head_g["lm_head"].astype(hs.dtype)
-            logits = (hs @ head).astype(jnp.float32)
             if labels is None:
                 labels = input_ids[:, 1:]
-                logits = logits[:, :-1]
+                hs = hs[:, :-1]
+            if cfg.fused_loss:
+                from ..ops.fused_cross_entropy import (
+                    fused_linear_cross_entropy)
+                return fused_linear_cross_entropy(
+                    hs.reshape(-1, cfg.hidden_size), head,
+                    labels.reshape(-1).astype(jnp.int32),
+                    cfg.fused_loss_chunk)
+            logits = (hs @ head).astype(jnp.float32)
             return optax.softmax_cross_entropy_with_integer_labels(
                 logits, labels).mean()
 
@@ -315,7 +332,29 @@ class GPT2Model:
         """Next-token cross entropy (fp32 softmax).  When labels is None,
         input_ids[:, 1:] serve as targets; the model runs on the FULL
         sequence and the last logit column is dropped (keeps the attention
-        length unchanged, e.g. divisible by a sparse-attention block)."""
+        length unchanged, e.g. divisible by a sparse-attention block).
+
+        With cfg.fused_loss (default) the head projection and the CE fuse
+        into a vocab-chunked streaming pass that never materializes the
+        [B, S, V] fp32 logits — the LM-head HBM fix."""
+        cfg = self.config
+        if cfg.fused_loss:
+            from ..ops.fused_cross_entropy import fused_linear_cross_entropy
+            h = self.hidden_states(params, input_ids, rng,
+                                   deterministic=rng is None,
+                                   pld_theta=pld_theta)
+            h = fused_layer_norm(h, params["ln_f"]["w"],
+                                 params["ln_f"]["b"], cfg.layer_norm_eps)
+            if labels is None:
+                labels2 = input_ids[:, 1:]
+                h = h[:, :-1]
+            else:
+                labels2 = labels
+            return fused_linear_cross_entropy(
+                h.reshape(-1, cfg.hidden_size),
+                self._head_matrix(params, h.dtype),
+                labels2.reshape(-1).astype(jnp.int32),
+                cfg.fused_loss_chunk)
         logits = self.logits(params, input_ids, rng,
                              deterministic=rng is None,
                              pld_theta=pld_theta).astype(jnp.float32)

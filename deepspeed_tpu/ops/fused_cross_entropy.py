@@ -1,0 +1,124 @@
+"""Fused (chunked) linear + softmax cross-entropy — the LM-head memory fix.
+
+The naive path materializes fp32 logits [B, S, V] (GPT-2 124M at B=8,
+S=1024: 1.6 GB) and reads them again for the softmax — pure HBM traffic
+the MXU waits on.  This op never materializes more than one vocab CHUNK of
+logits: the forward streams logsumexp over chunks (online softmax), and
+the custom VJP recomputes each chunk to emit dh and dW incrementally —
+O(B·S·chunk) live instead of O(B·S·V).
+
+Non-divisible vocabularies (e.g. GPT-2's unpadded 50257) are padded up to
+a whole number of chunks; padded columns are masked to -inf in the
+forward (zero probability) so they contribute nothing to the loss or the
+gradients, and the dW pad columns are sliced away.
+
+Reference counterpart: the training softmax kernels
+(csrc/transformer/softmax_kernels.cu) fuse scale+mask+softmax for the same
+reason — do not round-trip the big tensor through HBM.  (The chunked
+linear-CE formulation matches public "fused linear cross entropy" practice
+in TPU/GPU LM stacks.)
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _plan(vocab: int, chunk_size: int):
+    """(chunk, n_chunks, padded_vocab) with chunk*n_chunks == padded."""
+    c = max(1, min(chunk_size, vocab))
+    n_chunks = -(-vocab // c)
+    return c, n_chunks, c * n_chunks
+
+
+def _padded_w(w, padded_vocab):
+    hid, vocab = w.shape
+    if padded_vocab == vocab:
+        return w
+    return jnp.pad(w, ((0, 0), (0, padded_vocab - vocab)))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_linear_cross_entropy(h, w, labels, chunk_size: int = 8192):
+    """mean over tokens of CE(softmax(h @ w), labels).
+
+    h: [N, H] hidden states (any float dtype; matmuls accumulate fp32)
+    w: [H, V] head projection
+    labels: [N] int
+    """
+    loss, _ = _forward(h, w, labels, chunk_size)
+    return loss
+
+
+def _forward(h, w, labels, chunk_size):
+    n, hid = h.shape
+    vocab = w.shape[1]
+    c, n_chunks, padded = _plan(vocab, chunk_size)
+    wc = _padded_w(w, padded).reshape(hid, n_chunks, c).transpose(1, 0, 2)
+
+    def body(carry, w_i):
+        m, s, idx = carry
+        logits = jnp.einsum(
+            "nh,hc->nc", h, w_i.astype(h.dtype),
+            preferred_element_type=jnp.float32)  # [N, c] fp32
+        cols = idx * c + jnp.arange(c)
+        logits = jnp.where(cols[None, :] < vocab, logits, -jnp.inf)
+        m_new = jnp.maximum(m, logits.max(axis=1))
+        s = s * jnp.exp(m - m_new) + jnp.exp(
+            logits - m_new[:, None]).sum(axis=1)
+        # label logit if it falls in this chunk
+        local = labels - idx * c
+        in_chunk = (local >= 0) & (local < c)
+        lab = jnp.take_along_axis(
+            logits, jnp.clip(local, 0, c - 1)[:, None], axis=1)[:, 0]
+        return (m_new, s, idx + 1), jnp.where(in_chunk, lab, 0.0)
+
+    m0 = jnp.full((n,), -jnp.inf, jnp.float32)
+    s0 = jnp.zeros((n,), jnp.float32)
+    (m, s, _), lab_parts = lax.scan(body, (m0, s0, jnp.int32(0)), wc)
+    lse = m + jnp.log(s)
+    label_logit = lab_parts.sum(axis=0)
+    loss = (lse - label_logit).mean()
+    return loss.astype(jnp.float32), (lse,)
+
+
+def _fwd(h, w, labels, chunk_size):
+    loss, (lse,) = _forward(h, w, labels, chunk_size)
+    return loss, (h, w, labels, lse)
+
+
+def _bwd(chunk_size, res, g):
+    h, w, labels, lse = res
+    n, hid = h.shape
+    vocab = w.shape[1]
+    c, n_chunks, padded = _plan(vocab, chunk_size)
+    wc = _padded_w(w, padded).reshape(hid, n_chunks, c).transpose(1, 0, 2)
+    scale = g / n  # d mean / d token
+
+    def body(carry, w_i):
+        dh, idx = carry
+        logits = jnp.einsum("nh,hc->nc", h, w_i.astype(h.dtype),
+                            preferred_element_type=jnp.float32)
+        cols = idx * c + jnp.arange(c)
+        logits = jnp.where(cols[None, :] < vocab, logits, -jnp.inf)
+        p = jnp.exp(logits - lse[:, None])   # softmax chunk (0 on padding)
+        local = labels - idx * c
+        onehot = (local[:, None] == jnp.arange(c)[None, :])
+        grad_logits = (p - onehot.astype(p.dtype)) * scale  # [N, c] fp32
+        # dh accumulates fp32 across chunks — rounding per-chunk to bf16
+        # would compound error the unchunked path doesn't have
+        dh = dh + jnp.einsum("nc,hc->nh", grad_logits, w_i,
+                             preferred_element_type=jnp.float32)
+        dw_i = jnp.einsum("nh,nc->hc", h, grad_logits,
+                          preferred_element_type=jnp.float32)
+        return (dh, idx + 1), dw_i
+
+    dh0 = jnp.zeros(h.shape, jnp.float32)
+    (dh, _), dw_chunks = lax.scan(body, (dh0, jnp.int32(0)), wc)
+    dw = dw_chunks.transpose(1, 0, 2).reshape(hid, padded)[:, :vocab]
+    return dh.astype(h.dtype), dw.astype(w.dtype), None
+
+
+fused_linear_cross_entropy.defvjp(_fwd, _bwd)

@@ -167,8 +167,9 @@ def test_streaming_with_tensor_parallel():
          "stage3_max_live_parameters": LAYER_PARAMS,
          "stage3_prefetch_bucket_size": LAYER_PARAMS}, tp=2)
     assert stream is not None and stream.active
-    # TP=2 re-partitions the matmuls, so reductions reassociate — the
-    # tolerance admits fp32 summation-order noise but nothing structural.
+    # TP=2 re-partitions the matmuls (and the chunked fused CE reassociates
+    # its vocab sums) — the tolerance admits fp32 summation-order noise
+    # but nothing structural.
     np.testing.assert_allclose(losses, base_losses, rtol=1e-5)
     for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(base_params)):
-        np.testing.assert_allclose(a, b, rtol=5e-5, atol=1e-5)
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
