@@ -338,16 +338,19 @@ def main():
     import threading
 
     finished = threading.Event()
+    emit_lock = threading.Lock()  # one JSON line exactly: set+emit is atomic
 
     def watchdog():
         time.sleep(float(os.environ.get("DS_BENCH_WATCHDOG", 1500)))
-        if finished.is_set():
-            return
-        metric, unit = METRIC_NAMES[args.config]
-        _emit({"metric": metric, "value": 0.0, "unit": unit,
-               "vs_baseline": 0.0,
-               "error": "bench wedged past watchdog (likely a stale TPU "
-                        "claim holding the tunnel's single slot)"})
+        with emit_lock:
+            if finished.is_set():
+                return
+            finished.set()
+            metric, unit = METRIC_NAMES[args.config]
+            _emit({"metric": metric, "value": 0.0, "unit": unit,
+                   "vs_baseline": 0.0,
+                   "error": "bench wedged past watchdog (likely a stale TPU "
+                            "claim holding the tunnel's single slot)"})
         os._exit(0)
 
     threading.Thread(target=watchdog, daemon=True).start()
@@ -356,10 +359,17 @@ def main():
         payload = BENCHES[args.config]()
         payload["platform"] = devs[0].platform
         payload["device_kind"] = devs[0].device_kind
-        finished.set()
-        _emit(payload)
+        with emit_lock:
+            if finished.is_set():  # watchdog already spoke for this run
+                return
+            finished.set()
+            _emit(payload)
+        return
     except Exception as e:  # noqa: BLE001 — contract: always one JSON line
-        finished.set()
+        with emit_lock:
+            if finished.is_set():
+                return
+            finished.set()
         metric, unit = METRIC_NAMES[args.config]
         _emit({
             "metric": metric,

@@ -43,6 +43,17 @@ class BertConfig:
     activation_checkpointing: bool = False
     sparse_attention: Optional[object] = None  # a SparsityConfig
     ignore_index: int = -100
+    # layer-stack execution, same semantics as GPT2Config.scan_layers
+    scan_layers: Optional[bool] = None
+    # chunked LM-head + CE (ops/fused_cross_entropy.py) — never
+    # materializes the [B, S, V] fp32 logits
+    fused_loss: bool = True
+    fused_loss_chunk: int = 8192
+
+    @property
+    def use_scan(self) -> bool:
+        from .layer_stack import resolve_use_scan
+        return resolve_use_scan(self.scan_layers, self.num_layers)
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -78,12 +89,14 @@ class BertConfig:
         return n
 
     def flops_per_token(self, seq_len: Optional[int] = None) -> int:
-        """Training FLOPs/token (fwd+bwd ≈ 6N + attention term), the
-        standard accounting used for MFU (matches GPT2Config)."""
+        """Training FLOPs/token (fwd+bwd ≈ 6N + attention + MLM head), the
+        Megatron-style accounting used for MFU (matches GPT2Config: the
+        vocab projection is a real MXU matmul and belongs in the count)."""
         n = self.num_params(include_embeddings=False)
         s = seq_len if seq_len is not None else self.max_position_embeddings
         attn = 12 * self.num_layers * self.hidden_size * s
-        return 6 * n + attn
+        head = 6 * self.hidden_size * self.vocab_size
+        return 6 * n + attn + head
 
 
 class BertModel:
@@ -156,8 +169,9 @@ class BertModel:
         if cfg.activation_checkpointing:
             body = jax.checkpoint(body)
         layer_rngs = jax.random.split(r_layers, cfg.num_layers)
-        h, _ = jax.lax.scan(body, h, (params["h"], layer_rngs))
-        return h
+        from .layer_stack import run_layer_stack
+        return run_layer_stack(body, h, (params["h"], layer_rngs),
+                               cfg.use_scan)
 
     def mlm_loss(self, params, rng, input_ids, labels,
                  attention_mask=None, token_type_ids=None):
@@ -166,6 +180,13 @@ class BertModel:
         cfg = self.config
         h = self.hidden_states(params, input_ids, attention_mask,
                                token_type_ids, rng)
+        if cfg.fused_loss:
+            from ..ops.fused_cross_entropy import fused_linear_cross_entropy
+            return fused_linear_cross_entropy(
+                h.reshape(-1, cfg.hidden_size),
+                params["wte"].astype(h.dtype).T,
+                labels.reshape(-1).astype(jnp.int32),
+                cfg.fused_loss_chunk, cfg.ignore_index)
         logits = (h @ params["wte"].astype(h.dtype).T).astype(jnp.float32)
         valid = labels != cfg.ignore_index
         safe_labels = jnp.where(valid, labels, 0)

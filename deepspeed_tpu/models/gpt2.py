@@ -50,6 +50,15 @@ class GPT2Config:
     # logits (ops/fused_cross_entropy.py); the training-loss default
     fused_loss: bool = True
     fused_loss_chunk: int = 8192
+    # layer-stack execution: None = auto (unrolled up to the measured
+    # threshold, scan beyond — see models/layer_stack.py).  ZeRO-3
+    # streaming always uses its gather-scan.
+    scan_layers: Optional[bool] = None
+
+    @property
+    def use_scan(self) -> bool:
+        from .layer_stack import resolve_use_scan
+        return resolve_use_scan(self.scan_layers, self.num_layers)
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -166,17 +175,31 @@ class GPT2Model:
         return wte[input_ids] + wpe[pos]
 
     def _head_matrix(self, params, dtype):
-        """[H, V] LM projection — tied wte.T or the independent lm_head
-        (the ONE place the tie decision lives)."""
+        """[H, V] LM projection — tied wte.T or the independent lm_head.
+        (The layer-streaming path re-derives the tie from its own group
+        split — layerwise_api head_loss_fn.)"""
         if self.config.tie_word_embeddings:
             return params["wte"].astype(dtype).T
         return params["lm_head"].astype(dtype)
 
+    def _final_hidden(self, params, h):
+        """Final layer norm shared by head_logits and the fused-loss path."""
+        return fused_layer_norm(h, params["ln_f"]["w"], params["ln_f"]["b"],
+                                self.config.layer_norm_eps)
+
+    @staticmethod
+    def _shift_for_next_token(h, input_ids, labels):
+        """Next-token convention: when labels is None, input_ids[:, 1:] are
+        the targets and the last hidden column is dropped (keeps the
+        attention length unchanged, e.g. divisible by a sparse-attention
+        block)."""
+        if labels is None:
+            return h[:, :-1], input_ids[:, 1:]
+        return h, labels
+
     def head_logits(self, params, h):
         """Final LN + (tied) LM head, fp32 logits."""
-        cfg = self.config
-        h = fused_layer_norm(h, params["ln_f"]["w"], params["ln_f"]["b"],
-                             cfg.layer_norm_eps)
+        h = self._final_hidden(params, h)
         return (h @ self._head_matrix(params, h.dtype)).astype(jnp.float32)
 
     def hidden_states(self, params, input_ids, rng=None,
@@ -240,7 +263,9 @@ class GPT2Model:
             h = stream.scan(body, h, params["h"], extras,
                             param_tp_specs=self.param_partition_specs()["h"])
         else:
-            h, _ = jax.lax.scan(body, h, (params["h"],) + extras)
+            from .layer_stack import run_layer_stack
+            h = run_layer_stack(body, h, (params["h"],) + extras,
+                                cfg.use_scan)
         return h
 
     # -- layer-streaming protocol (ZeRO-Infinity param offload) --------- #
@@ -304,9 +329,8 @@ class GPT2Model:
                 head = embed_g["wte"].astype(hs.dtype).T
             else:
                 head = head_g["lm_head"].astype(hs.dtype)
-            if labels is None:
-                labels = input_ids[:, 1:]
-                hs = hs[:, :-1]
+            hs, labels = GPT2Model._shift_for_next_token(
+                hs, input_ids, labels)
             if cfg.fused_loss:
                 from ..ops.fused_cross_entropy import (
                     fused_linear_cross_entropy)
@@ -343,13 +367,8 @@ class GPT2Model:
             h = self.hidden_states(params, input_ids, rng,
                                    deterministic=rng is None,
                                    pld_theta=pld_theta)
-            h = fused_layer_norm(h, params["ln_f"]["w"],
-                                 params["ln_f"]["b"], cfg.layer_norm_eps)
-            if labels is None:
-                labels2 = input_ids[:, 1:]
-                h = h[:, :-1]
-            else:
-                labels2 = labels
+            h = self._final_hidden(params, h)
+            h, labels2 = self._shift_for_next_token(h, input_ids, labels)
             return fused_linear_cross_entropy(
                 h.reshape(-1, cfg.hidden_size),
                 self._head_matrix(params, h.dtype),
@@ -358,9 +377,7 @@ class GPT2Model:
         logits = self.logits(params, input_ids, rng,
                              deterministic=rng is None,
                              pld_theta=pld_theta).astype(jnp.float32)
-        if labels is None:
-            labels = input_ids[:, 1:]
-            logits = logits[:, :-1]
+        logits, labels = self._shift_for_next_token(logits, input_ids, labels)
         return optax.softmax_cross_entropy_with_integer_labels(
             logits, labels).mean()
 

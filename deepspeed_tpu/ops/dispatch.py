@@ -1,0 +1,33 @@
+"""Kernel-backend dispatch switch.
+
+Pallas TPU kernels (flash attention, fused LN) must not lower on CPU
+(pallas supports only interpret mode there), and the usual gate —
+``jax.default_backend() == "tpu"`` — is wrong in one real scenario: a
+process that touched the TPU backend first and then forced
+``jax_platforms=cpu`` (the multichip CPU-sim dryrun) still reports "tpu".
+This module gives such callers an explicit override, also settable via
+``DS_FORCE_XLA_OPS=1``.
+"""
+
+import os
+
+import jax
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+_force_xla = bool(int(os.environ.get("DS_FORCE_XLA_OPS", "0")))
+
+
+def force_xla_kernels(on: bool = True) -> None:
+    """Route all op dispatchers to their XLA reference paths (no Pallas)."""
+    global _force_xla
+    _force_xla = on
+
+
+def pallas_available() -> bool:
+    """True when Pallas TPU kernels may be used in this process."""
+    return (not _force_xla and pltpu is not None
+            and jax.default_backend() == "tpu")

@@ -389,7 +389,8 @@ def _flash(q, k, v, causal, sm_scale, block_q, block_k):
 
 
 def _use_pallas(q_len, k_len, d, block_q, block_k):
-    if pltpu is None or jax.default_backend() != "tpu":
+    from .dispatch import pallas_available
+    if not pallas_available():
         return False
     bq, bk = min(block_q, q_len), min(block_k, k_len)
     return q_len % bq == 0 and k_len % bk == 0
@@ -420,15 +421,41 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+# Below this many bytes of fp32 score matrix ([B,H,Sq,Sk], the transient
+# mha_reference materializes via preferred_element_type=f32), the plain-XLA
+# attention beats the Pallas kernel on TPU: measured on v5e at
+# B=8,H=12,S=1024 the full GPT-2 step drops 160ms -> 108ms with XLA
+# attention (benchmarks/profile_ablations2.py), because at short sequence
+# the flash kernel's small [block_q, d] matmuls under-fill the MXU while
+# XLA's batched [S,S] matmuls stream perfectly.  Past this size the score
+# materialization dominates HBM and flash wins — which is its actual job.
+#
+# NOTE the trade the "auto" policy makes: the XLA path also saves the
+# softmax output per layer for backward (O(S^2) residual per layer, ~200MB
+# at the flagship shape; recomputed, not saved, under jax.checkpoint), so
+# memory-constrained configs should force impl="pallas"
+# (DeepSpeedTransformerConfig.attn_impl) to keep flash's O(S) footprint.
+_XLA_ATTN_MAX_SCORE_BYTES = 512 * 1024 * 1024
+
+
 def flash_attention(q, k, v, causal: bool = False,
                     sm_scale: Optional[float] = None, bias=None,
-                    block_q: int = 128, block_k: int = 128):
+                    block_q: int = 128, block_k: int = 128,
+                    impl: str = "auto"):
     """Fused multi-head attention: q,k,v [B, H, S, D] -> [B, H, S, D].
 
-    Dispatches to the Pallas kernel on TPU (bias-free paths); additive-bias
-    attention falls back to the XLA path, which the compiler still fuses into
-    few kernels."""
+    impl: "auto" (default) picks the XLA path when the score matrix is
+    small enough to be compute-optimal and the Pallas flash kernel beyond
+    (see _XLA_ATTN_MAX_SCORE_BYTES for the memory trade); "pallas"/"xla"
+    force a path.  Additive-bias attention always takes the XLA path (the
+    compiler fuses the bias add into the softmax)."""
     if bias is not None:
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
                              bias=bias)
+    if impl == "auto":
+        b, h, s, _ = q.shape
+        score_bytes = 4 * b * h * s * k.shape[2]
+        impl = "xla" if score_bytes <= _XLA_ATTN_MAX_SCORE_BYTES else "pallas"
+    if impl == "xla":
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
     return _flash(q, k, v, causal, sm_scale, block_q, block_k)

@@ -40,23 +40,36 @@ def _padded_w(w, padded_vocab):
     return jnp.pad(w, ((0, 0), (0, padded_vocab - vocab)))
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3,))
-def fused_linear_cross_entropy(h, w, labels, chunk_size: int = 8192):
-    """mean over tokens of CE(softmax(h @ w), labels).
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_linear_cross_entropy(h, w, labels, chunk_size: int = 8192,
+                               ignore_index=None):
+    """mean over (valid) tokens of CE(softmax(h @ w), labels).
 
     h: [N, H] hidden states (any float dtype; matmuls accumulate fp32)
     w: [H, V] head projection
     labels: [N] int
+    ignore_index: labels equal to this contribute nothing to the loss or
+      gradients and are excluded from the mean (the masked-LM convention,
+      reference bing_bert objective / torch F.cross_entropy semantics).
     """
-    loss, _ = _forward(h, w, labels, chunk_size)
+    loss, _ = _forward(h, w, labels, chunk_size, ignore_index)
     return loss
 
 
-def _forward(h, w, labels, chunk_size):
+def _valid_mask(labels, ignore_index):
+    if ignore_index is None:
+        return jnp.ones(labels.shape, jnp.float32), jnp.float32(
+            labels.shape[0])
+    valid = (labels != ignore_index).astype(jnp.float32)
+    return valid, jnp.maximum(valid.sum(), 1.0)
+
+
+def _forward(h, w, labels, chunk_size, ignore_index):
     n, hid = h.shape
     vocab = w.shape[1]
     c, n_chunks, padded = _plan(vocab, chunk_size)
     wc = _padded_w(w, padded).reshape(hid, n_chunks, c).transpose(1, 0, 2)
+    valid, denom = _valid_mask(labels, ignore_index)
 
     def body(carry, w_i):
         m, s, idx = carry
@@ -80,22 +93,23 @@ def _forward(h, w, labels, chunk_size):
     (m, s, _), lab_parts = lax.scan(body, (m0, s0, jnp.int32(0)), wc)
     lse = m + jnp.log(s)
     label_logit = lab_parts.sum(axis=0)
-    loss = (lse - label_logit).mean()
+    loss = ((lse - label_logit) * valid).sum() / denom
     return loss.astype(jnp.float32), (lse,)
 
 
-def _fwd(h, w, labels, chunk_size):
-    loss, (lse,) = _forward(h, w, labels, chunk_size)
+def _fwd(h, w, labels, chunk_size, ignore_index):
+    loss, (lse,) = _forward(h, w, labels, chunk_size, ignore_index)
     return loss, (h, w, labels, lse)
 
 
-def _bwd(chunk_size, res, g):
+def _bwd(chunk_size, ignore_index, res, g):
     h, w, labels, lse = res
     n, hid = h.shape
     vocab = w.shape[1]
     c, n_chunks, padded = _plan(vocab, chunk_size)
     wc = _padded_w(w, padded).reshape(hid, n_chunks, c).transpose(1, 0, 2)
-    scale = g / n  # d mean / d token
+    valid, denom = _valid_mask(labels, ignore_index)
+    scale = (g / denom) * valid  # [N] d mean / d token (0 on ignored)
 
     def body(carry, w_i):
         dh, idx = carry
@@ -106,7 +120,7 @@ def _bwd(chunk_size, res, g):
         p = jnp.exp(logits - lse[:, None])   # softmax chunk (0 on padding)
         local = labels - idx * c
         onehot = (local[:, None] == jnp.arange(c)[None, :])
-        grad_logits = (p - onehot.astype(p.dtype)) * scale  # [N, c] fp32
+        grad_logits = (p - onehot.astype(p.dtype)) * scale[:, None]
         # dh accumulates fp32 across chunks — rounding per-chunk to bf16
         # would compound error the unchunked path doesn't have
         dh = dh + jnp.einsum("nc,hc->nh", grad_logits, w_i,

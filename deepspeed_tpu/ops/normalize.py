@@ -74,7 +74,8 @@ def _fused_ln(x, gamma, beta, eps):
 
 
 def _fused_ln_fwd(x, gamma, beta, eps):
-    if pltpu is not None and jax.default_backend() == "tpu":
+    from .dispatch import pallas_available
+    if pallas_available():
         out = layer_norm_pallas(x, gamma, beta, eps)
     else:
         out = layer_norm_reference(x, gamma, beta, eps)

@@ -49,6 +49,9 @@ class DeepSpeedTransformerConfig:
     causal: bool = False
     block_q: int = 128
     block_k: int = 128
+    # "auto" = XLA attention at short seq, Pallas flash beyond (measured
+    # crossover — see ops/flash_attention._XLA_ATTN_MAX_SCORE_BYTES)
+    attn_impl: str = "auto"
     # "gelu_new"/"gelu_pytorch_tanh" = tanh approx (the reference kernel's
     # flavor, gelu_kernels.cu:10); "gelu" = exact erf (HF BERT default)
     activation: str = "gelu_new"
@@ -185,7 +188,8 @@ class DeepSpeedTransformerLayer:
             ctx = self._sparse_attn(q, k, v, causal=cfg.causal)
         else:
             ctx = flash_attention(q, k, v, causal=cfg.causal, bias=attn_mask,
-                                  block_q=cfg.block_q, block_k=cfg.block_k)
+                                  block_q=cfg.block_q, block_k=cfg.block_k,
+                                  impl=cfg.attn_impl)
         ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h)
         ctx = dropout(ctx, cfg.attn_dropout_ratio, r_attn, deterministic)
 

@@ -332,8 +332,11 @@ class Zero3StreamContext:
                 unroll=unroll)
             return carry
 
+        # check_vma off: pallas_call outputs carry no varying-mesh-axes
+        # metadata, so the vma analysis rejects any Pallas kernel (LN,
+        # flash attention) inside the manual region at trace time.
         streamed = jax.shard_map(
             region_fn, mesh=mesh,
             in_specs=(carry_spec, in_specs_params, extras_specs),
-            out_specs=carry_spec, axis_names=set(manual))
+            out_specs=carry_spec, axis_names=set(manual), check_vma=False)
         return streamed(init_carry, grouped_params, grouped_extras)

@@ -21,7 +21,7 @@ def _naive(h, w, labels):
 @pytest.mark.parametrize("n,hid,vocab,chunk", [
     (32, 16, 64, 16),      # evenly divisible chunks
     (32, 16, 64, 64),      # single chunk
-    (32, 16, 64, 7),       # chunk snapped down to a divisor
+    (32, 16, 64, 7),       # non-divisor chunk: vocab padded to 10x7=70
     (17, 16, 96, 32),      # odd token count
 ])
 def test_matches_naive_fp32(n, hid, vocab, chunk):
