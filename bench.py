@@ -176,7 +176,10 @@ def bench_bert_z2():
 
 
 def bench_decode():
-    """Inference decode tokens/s on GPT-2 124M (KV-cache scan decode)."""
+    """Inference decode tokens/s on GPT-2 124M (KV-cache scan decode),
+    bf16 and int8 — plus the int8 accuracy delta (greedy-token agreement
+    vs the bf16 engine on the same weights, the serving-accuracy check the
+    reference's int8 path implies — module_quantize.py)."""
     import jax
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import GPT2Config, GPT2Model
@@ -185,26 +188,34 @@ def bench_decode():
     cfg = GPT2Config(n_positions=prompt + gen, bf16=True)
     model = GPT2Model(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
-    engine = ds.init_inference(model=model, model_parameters=params,
-                               dtype="bf16")
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, size=(batch, prompt)).astype(np.int32)
-
-    out = engine.generate(ids, max_new_tokens=gen)  # compile
-    np.asarray(out)
-    t0 = time.time()
     iters = max(1, int(os.environ.get("DS_BENCH_ITERS", 5)))
-    for _ in range(iters):
-        out = engine.generate(ids, max_new_tokens=gen)
-    np.asarray(out)
-    dt = time.time() - t0
-    tps = iters * batch * gen / dt
+
+    def run(dtype):
+        engine = ds.init_inference(model=model, model_parameters=params,
+                                   dtype=dtype)
+        out = engine.generate(ids, max_new_tokens=gen)  # compile
+        np.asarray(out)
+        t0 = time.time()
+        for _ in range(iters):
+            out = engine.generate(ids, max_new_tokens=gen)
+        toks = np.asarray(out)
+        dt = time.time() - t0
+        return iters * batch * gen / dt, toks
+
+    tps_bf16, toks_bf16 = run("bf16")
+    tps_int8, toks_int8 = run("int8")
+    # generate() returns the NEW tokens only: [B, gen]
+    agree = float((toks_bf16 == toks_int8).mean())
     return {
         "metric": "gpt2_124m_decode_tokens_per_sec_1chip",
-        "value": round(tps, 1),
+        "value": round(tps_bf16, 1),
         "unit": "tokens/s",
         "vs_baseline": 0.0,  # no reference decode anchor on this hw class
         "batch": batch, "prompt": prompt, "gen": gen,
+        "int8_tokens_per_sec": round(tps_int8, 1),
+        "int8_greedy_token_agreement": round(agree, 4),
     }
 
 
@@ -261,6 +272,56 @@ def bench_moe():
     }
 
 
+def bench_offload():
+    """GPT-2 124M, ZeRO-2 + host-offloaded optimizer (native C++ host Adam
+    — the DeepSpeedCPUAdam role).  Same model/step as the flagship gpt2
+    config, so value/72k-ish quantifies the offload tax directly
+    (reference framing: ZeRO-Offload trades step time for HBM,
+    docs/_posts/2020-09-09-ZeRO-Offload.md)."""
+    import jax
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT2Config, GPT2Model
+
+    batch, seq = 8, 1024
+    cfg = GPT2Config(n_positions=seq, bf16=True)
+    model = GPT2Model(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+
+    config = {
+        "train_micro_batch_size_per_gpu": batch,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW",
+                      "params": {"lr": 6e-4, "weight_decay": 0.1}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {
+            "stage": 2,
+            "offload_optimizer": {"device": "cpu"}},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config,
+                                    model_parameters=params)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32)
+
+    def step():
+        loss = engine.forward(ids)
+        engine.backward(loss)
+        engine.step()
+        return loss
+
+    dt, final_loss, n = _time_steps(step, warmup=2, iters=10)
+    tokens_per_sec = n * batch * seq / dt
+    tflops = tokens_per_sec * cfg.flops_per_token() / 1e12
+    return {
+        "metric": "gpt2_124m_offload_cpu_adam_tokens_per_sec_1chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tflops / REFERENCE_TFLOPS, 3),
+        "tflops_per_chip": round(tflops, 2),
+        "final_loss": round(final_loss, 4),
+    }
+
+
 def bench_infinity():
     """ZeRO-Infinity layer streaming on one chip: GPT-2 124M with params
     AND optimizer states on NVMe (the BASELINE.md max-model-per-chip
@@ -314,12 +375,14 @@ def bench_infinity():
 
 BENCHES = {"gpt2": bench_gpt2, "bert_z2": bench_bert_z2,
            "decode": bench_decode, "moe": bench_moe,
-           "infinity": bench_infinity}
+           "offload": bench_offload, "infinity": bench_infinity}
 METRIC_NAMES = {  # error-path metric must match the success-path name
     "gpt2": ("gpt2_124m_train_tokens_per_sec_1chip", "tokens/s"),
     "bert_z2": ("bert_large_z2_samples_per_sec_1chip", "samples/s"),
     "decode": ("gpt2_124m_decode_tokens_per_sec_1chip", "tokens/s"),
     "moe": ("moe_top2_train_tokens_per_sec_1chip", "tokens/s"),
+    "offload": ("gpt2_124m_offload_cpu_adam_tokens_per_sec_1chip",
+                "tokens/s"),
     "infinity": ("gpt2_124m_infinity_nvme_tokens_per_sec_1chip",
                  "tokens/s"),
 }

@@ -45,10 +45,10 @@ class BertConfig:
     ignore_index: int = -100
     # layer-stack execution, same semantics as GPT2Config.scan_layers
     scan_layers: Optional[bool] = None
-    # chunked LM-head + CE (ops/fused_cross_entropy.py) — never
-    # materializes the [B, S, V] fp32 logits
+    # chunked LM-head + CE (ops/fused_cross_entropy.py) — never SAVES the
+    # [B, S, V] fp32 logits; None = auto chunk from the transient budget
     fused_loss: bool = True
-    fused_loss_chunk: int = 8192
+    fused_loss_chunk: Optional[int] = None
 
     @property
     def use_scan(self) -> bool:

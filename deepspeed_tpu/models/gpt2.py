@@ -46,10 +46,11 @@ class GPT2Config:
     activation_checkpointing: bool = False
     sparse_attention: Optional[object] = None  # a SparsityConfig
     tie_word_embeddings: bool = True
-    # chunked LM-head + cross-entropy: never materializes the [B,S,V] fp32
-    # logits (ops/fused_cross_entropy.py); the training-loss default
+    # chunked LM-head + cross-entropy: never SAVES the [B,S,V] fp32 logits
+    # (ops/fused_cross_entropy.py); None = auto chunk from the transient
+    # budget (largest chunk wins on speed — profile_ce_sweep.py)
     fused_loss: bool = True
-    fused_loss_chunk: int = 8192
+    fused_loss_chunk: Optional[int] = None
     # layer-stack execution: None = auto (unrolled up to the measured
     # threshold, scan beyond — see models/layer_stack.py).  ZeRO-3
     # streaming always uses its gather-scan.

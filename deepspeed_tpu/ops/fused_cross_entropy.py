@@ -26,8 +26,18 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _plan(vocab: int, chunk_size: int):
+# Auto chunk policy: bound the transient [N, chunk] fp32 logits block.
+# Measured on v5e (benchmarks/profile_ce_sweep.py): larger chunks are
+# faster (fewer scan steps, bigger matmuls) — 105ms vs 111ms full-step at
+# the flagship shape for whole-vocab vs 8192 — so "auto" picks the largest
+# chunk whose transient stays under this budget.
+_CE_CHUNK_ELEM_BUDGET = 1 << 29  # 512M fp32 elements = 2 GB transient
+
+
+def _plan(vocab: int, chunk_size, n_tokens: int):
     """(chunk, n_chunks, padded_vocab) with chunk*n_chunks == padded."""
+    if chunk_size is None:
+        chunk_size = max(4096, _CE_CHUNK_ELEM_BUDGET // max(1, n_tokens))
     c = max(1, min(chunk_size, vocab))
     n_chunks = -(-vocab // c)
     return c, n_chunks, c * n_chunks
@@ -41,7 +51,7 @@ def _padded_w(w, padded_vocab):
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def fused_linear_cross_entropy(h, w, labels, chunk_size: int = 8192,
+def fused_linear_cross_entropy(h, w, labels, chunk_size=None,
                                ignore_index=None):
     """mean over (valid) tokens of CE(softmax(h @ w), labels).
 
@@ -67,7 +77,7 @@ def _valid_mask(labels, ignore_index):
 def _forward(h, w, labels, chunk_size, ignore_index):
     n, hid = h.shape
     vocab = w.shape[1]
-    c, n_chunks, padded = _plan(vocab, chunk_size)
+    c, n_chunks, padded = _plan(vocab, chunk_size, n)
     wc = _padded_w(w, padded).reshape(hid, n_chunks, c).transpose(1, 0, 2)
     valid, denom = _valid_mask(labels, ignore_index)
 
@@ -106,7 +116,7 @@ def _bwd(chunk_size, ignore_index, res, g):
     h, w, labels, lse = res
     n, hid = h.shape
     vocab = w.shape[1]
-    c, n_chunks, padded = _plan(vocab, chunk_size)
+    c, n_chunks, padded = _plan(vocab, chunk_size, n)
     wc = _padded_w(w, padded).reshape(hid, n_chunks, c).transpose(1, 0, 2)
     valid, denom = _valid_mask(labels, ignore_index)
     scale = (g / denom) * valid  # [N] d mean / d token (0 on ignored)

@@ -667,6 +667,15 @@ class DeepSpeedEngine:
             new_scaler = update_loss_scale(scaler_cfg, scaler_state, overflow)
             return new_params, new_opt, new_scaler, overflow
 
+        # Donation: params and opt_state alias the outputs 1:1; grads have
+        # no matching output (4n donated leaves vs 3n outputs) so XLA warns
+        # "donated buffers were not usable" for exactly the grad tree at
+        # compile time.  The donation is still wanted — grad buffers become
+        # in-place scratch for the unscale/update temporaries — so that
+        # specific expected warning is filtered once, process-wide.
+        import warnings as _warnings
+        _warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
         self._apply_fn = jax.jit(
             apply_step,
             out_shardings=(self.param_shardings, self.opt_shardings,

@@ -61,8 +61,14 @@ def test_prime_vocab_pads_not_degrades():
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
     # chunked, not degraded to one column per step
     from deepspeed_tpu.ops.fused_cross_entropy import _plan
-    c, n_chunks, padded = _plan(vocab, 32)
+    c, n_chunks, padded = _plan(vocab, 32, h.shape[0])
     assert c == 32 and n_chunks == 4 and padded == 128
+    # auto policy (chunk_size=None): large budget / few tokens -> one chunk
+    c, n_chunks, padded = _plan(vocab, None, h.shape[0])
+    assert c == vocab and n_chunks == 1 and padded == vocab
+    # auto policy under a huge token count stays above the floor
+    c, _, _ = _plan(10 ** 6, None, 10 ** 9)
+    assert c == 4096
 
 
 def test_matches_naive_bf16_inputs():
