@@ -43,14 +43,16 @@ def time_step(name, make_step, params, flops):
 
 def main():
     # Pin the ROUND-START configuration this script's recorded numbers used
-    # (scan + pallas attention + CE chunk 8192) — the model defaults have
-    # since moved to the measured winners (unrolled, auto-XLA attention,
-    # whole-vocab CE), so relying on defaults would silently change every
-    # row's meaning.
+    # (scan + 128x128-block pallas attention + CE chunk 8192) — the model
+    # defaults have since moved to the measured winners (unrolled,
+    # 512x1024 flash blocks, whole-vocab CE), so relying on defaults would
+    # silently change every row's meaning.
     cfg = GPT2Config(n_positions=SEQ, bf16=True, scan_layers=True,
                      fused_loss_chunk=8192)
     model = GPT2Model(cfg)
     model.layer.config.attn_impl = "pallas"
+    model.layer.config.block_q = 128
+    model.layer.config.block_k = 128
 
     params0 = jax.tree.map(jnp.asarray,
                            model.init_params(jax.random.PRNGKey(0)))

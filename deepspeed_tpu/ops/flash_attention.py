@@ -131,6 +131,30 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
+def _fit_block(length: int, target: int) -> int:
+    """Largest divisor of `length` that is <= `target` (>=1)."""
+    b = min(target, length)
+    while b > 1 and length % b:
+        b -= 1
+    return b
+
+
+def _resolve_blocks(q_len, k_len, block_q, block_k):
+    """Fit the requested blocks to the sequence lengths.
+
+    Returns (usable, bq, bk): blocks are shrunk to the largest divisors of
+    the lengths, and `usable` says whether those divisors still give the
+    kernel a sane tile (k block a lane multiple — or the whole length —
+    and q block a sublane multiple): lengths like 1536 fit (768x512),
+    pathological ones (primes) report unusable so the dispatcher can take
+    the XLA path instead of running degenerate tiles."""
+    bq = _fit_block(q_len, block_q)
+    bk = _fit_block(k_len, block_k)
+    usable = ((bk % _LANES == 0 or bk == k_len) and
+              (bq % 8 == 0 or bq == q_len))
+    return usable, bq, bk
+
+
 def _check_blocks(q_len, k_len, block_q, block_k):
     block_q = min(block_q, q_len)
     block_k = min(block_k, k_len)
@@ -143,7 +167,7 @@ def _check_blocks(q_len, k_len, block_q, block_k):
 
 def flash_attention_pallas(q, k, v, causal: bool = False,
                            sm_scale: Optional[float] = None,
-                           block_q: int = 128, block_k: int = 128,
+                           block_q: int = 512, block_k: int = 1024,
                            interpret: bool = False, return_lse: bool = False):
     """Pallas flash attention. q,k,v: [B, H, S, D] -> [B, H, S, D]
     (+ logsumexp [B, H, S] when return_lse)."""
@@ -302,7 +326,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
                                sm_scale: Optional[float] = None,
-                               block_q: int = 128, block_k: int = 128,
+                               block_q: int = 512, block_k: int = 1024,
                                interpret: bool = False):
     """Block-wise dq, dk, dv — no [S, S] materialization in HBM."""
     batch, heads, q_len, d = q.shape
@@ -392,15 +416,16 @@ def _use_pallas(q_len, k_len, d, block_q, block_k):
     from .dispatch import pallas_available
     if not pallas_available():
         return False
-    bq, bk = min(block_q, q_len), min(block_k, k_len)
-    return q_len % bq == 0 and k_len % bk == 0
+    usable, _, _ = _resolve_blocks(q_len, k_len, block_q, block_k)
+    return usable
 
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
     if _use_pallas(q.shape[2], k.shape[2], q.shape[3], block_q, block_k):
+        _, bq, bk = _resolve_blocks(q.shape[2], k.shape[2], block_q, block_k)
         out, lse = flash_attention_pallas(
             q, k, v, causal=causal, sm_scale=sm_scale,
-            block_q=block_q, block_k=block_k, return_lse=True)
+            block_q=bq, block_k=bk, return_lse=True)
         return out, (q, k, v, out, lse)
     out = mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
     return out, (q, k, v, None, None)
@@ -409,9 +434,10 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
 def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
     q, k, v, out, lse = res
     if lse is not None:
+        _, bq, bk = _resolve_blocks(q.shape[2], k.shape[2], block_q, block_k)
         return flash_attention_bwd_pallas(
             q, k, v, out, lse, g, causal=causal, sm_scale=sm_scale,
-            block_q=block_q, block_k=block_k)
+            block_q=bq, block_k=bk)
     _, vjp = jax.vjp(
         lambda q_, k_, v_: mha_reference(q_, k_, v_, causal=causal,
                                          sm_scale=sm_scale), q, k, v)
@@ -421,41 +447,35 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-# Below this many bytes of fp32 score matrix ([B,H,Sq,Sk], the transient
-# mha_reference materializes via preferred_element_type=f32), the plain-XLA
-# attention beats the Pallas kernel on TPU: measured on v5e at
-# B=8,H=12,S=1024 the full GPT-2 step drops 160ms -> 108ms with XLA
-# attention (benchmarks/profile_ablations2.py), because at short sequence
-# the flash kernel's small [block_q, d] matmuls under-fill the MXU while
-# XLA's batched [S,S] matmuls stream perfectly.  Past this size the score
-# materialization dominates HBM and flash wins — which is its actual job.
-#
-# NOTE the trade the "auto" policy makes: the XLA path also saves the
-# softmax output per layer for backward (O(S^2) residual per layer, ~200MB
-# at the flagship shape; recomputed, not saved, under jax.checkpoint), so
-# memory-constrained configs should force impl="pallas"
-# (DeepSpeedTransformerConfig.attn_impl) to keep flash's O(S) footprint.
-_XLA_ATTN_MAX_SCORE_BYTES = 512 * 1024 * 1024
+# Default block sizes, tuned on v5e (benchmarks/profile_flash_blocks.py,
+# state-feedback + fetch-sync measurement): large blocks dominate —
+# 128x128 is grid-overhead-bound (S=4096 fwd+bwd: 28.1 ms at 128x128 vs
+# 6.7 ms at 1024x1024; S=1024: 10.0 -> 4.3 ms).  With these blocks the
+# Pallas kernel also beats the batched-XLA attention at BOTH measured
+# lengths (S=1024: 4.3 vs 6.3 ms; S=4096: 6.7 vs 23.9 ms), so "auto"
+# simply means pallas-when-usable — an earlier short-seq XLA dispatch
+# here was an artifact of the old 128x128 default.  512x1024 (not
+# 1024x1024, statistically tied) keeps the bwd kernel's [bq, bk] fp32
+# score/ds tiles at 2 MB each for VMEM headroom at D>64.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
 
 
 def flash_attention(q, k, v, causal: bool = False,
                     sm_scale: Optional[float] = None, bias=None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
                     impl: str = "auto"):
     """Fused multi-head attention: q,k,v [B, H, S, D] -> [B, H, S, D].
 
-    impl: "auto" (default) picks the XLA path when the score matrix is
-    small enough to be compute-optimal and the Pallas flash kernel beyond
-    (see _XLA_ATTN_MAX_SCORE_BYTES for the memory trade); "pallas"/"xla"
-    force a path.  Additive-bias attention always takes the XLA path (the
-    compiler fuses the bias add into the softmax)."""
+    impl: "auto" (default) and "pallas" run the Pallas flash kernel with
+    blocks fitted to the sequence lengths (_resolve_blocks), falling back
+    to the XLA reference only on CPU or pathological (prime-ish) lengths;
+    "xla" forces the reference.  Additive-bias attention always takes the
+    XLA path (the compiler fuses the bias add into the softmax)."""
     if bias is not None:
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
                              bias=bias)
-    if impl == "auto":
-        b, h, s, _ = q.shape
-        score_bytes = 4 * b * h * s * k.shape[2]
-        impl = "xla" if score_bytes <= _XLA_ATTN_MAX_SCORE_BYTES else "pallas"
     if impl == "xla":
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
     return _flash(q, k, v, causal, sm_scale, block_q, block_k)

@@ -47,10 +47,10 @@ class DeepSpeedTransformerConfig:
     layer_id: int = 0
     # TPU additions
     causal: bool = False
-    block_q: int = 128
-    block_k: int = 128
-    # "auto" = XLA attention at short seq, Pallas flash beyond (measured
-    # crossover — see ops/flash_attention._XLA_ATTN_MAX_SCORE_BYTES)
+    # v5e-tuned flash blocks (ops/flash_attention.DEFAULT_BLOCK_*)
+    block_q: int = 512
+    block_k: int = 1024
+    # "auto" = Pallas flash when usable, XLA reference otherwise
     attn_impl: str = "auto"
     # "gelu_new"/"gelu_pytorch_tanh" = tanh approx (the reference kernel's
     # flavor, gelu_kernels.cu:10); "gelu" = exact erf (HF BERT default)

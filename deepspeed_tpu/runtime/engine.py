@@ -24,6 +24,7 @@ gradient-accumulation boundaries.
 """
 
 import os
+import warnings
 from typing import Any, Callable, Dict, Optional
 
 import numpy as np
@@ -32,6 +33,14 @@ import jax
 import jax.numpy as jnp
 import optax
 from jax import lax
+
+# The ZeRO apply step donates the grad tree purely as scratch (no output
+# aliases it — see _build_functions), which makes XLA's compile-time
+# "donated buffers were not usable" warning expected noise on every engine.
+# Filtered once at import; the filter is message-scoped, so other donation
+# diagnostics (different messages) still surface.
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
 
 from ..config import DeepSpeedConfig
 from ..parallel import mesh as mesh_mod
@@ -671,11 +680,8 @@ class DeepSpeedEngine:
         # no matching output (4n donated leaves vs 3n outputs) so XLA warns
         # "donated buffers were not usable" for exactly the grad tree at
         # compile time.  The donation is still wanted — grad buffers become
-        # in-place scratch for the unscale/update temporaries — so that
-        # specific expected warning is filtered once, process-wide.
-        import warnings as _warnings
-        _warnings.filterwarnings(
-            "ignore", message="Some donated buffers were not usable")
+        # in-place scratch for the unscale/update temporaries — and the
+        # expected warning is filtered once at module import (top of file).
         self._apply_fn = jax.jit(
             apply_step,
             out_shardings=(self.param_shardings, self.opt_shardings,

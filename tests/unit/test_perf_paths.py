@@ -14,7 +14,8 @@ from deepspeed_tpu.models.layer_stack import (SCAN_LAYERS_AUTO_THRESHOLD,
                                               resolve_use_scan,
                                               run_layer_stack)
 from deepspeed_tpu.ops import dispatch
-from deepspeed_tpu.ops.flash_attention import (_XLA_ATTN_MAX_SCORE_BYTES,
+from deepspeed_tpu.ops.flash_attention import (DEFAULT_BLOCK_K,
+                                               DEFAULT_BLOCK_Q,
                                                flash_attention, mha_reference)
 from deepspeed_tpu.utils.timer import ThroughputTimer
 
@@ -66,11 +67,8 @@ def test_flash_attention_impl_dispatch():
         out = flash_attention(q, k, v, causal=True, impl=impl)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
-    # the auto crossover: flagship shape stays XLA, long-seq goes pallas
-    flagship = 4 * 8 * 12 * 1024 * 1024
-    assert flagship <= _XLA_ATTN_MAX_SCORE_BYTES
-    long_seq = 4 * 8 * 12 * 4096 * 4096
-    assert long_seq > _XLA_ATTN_MAX_SCORE_BYTES
+    # tuned defaults: large blocks (grid overhead dominates small ones)
+    assert DEFAULT_BLOCK_Q >= 512 and DEFAULT_BLOCK_K >= 512
 
 
 def test_force_xla_kernels_override():
