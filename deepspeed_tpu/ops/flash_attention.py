@@ -131,38 +131,36 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 
 
-def _fit_block(length: int, target: int) -> int:
-    """Largest divisor of `length` that is <= `target` (>=1)."""
+def _fit_block(length: int, target: int, align: int) -> int:
+    """Largest divisor of `length` <= `target` that is a multiple of
+    `align`; falls back to the largest unaligned divisor (callers judge
+    usability).  A short whole length (< align) is its own block."""
+    best_unaligned = 1
     b = min(target, length)
-    while b > 1 and length % b:
+    while b >= 1:
+        if length % b == 0:
+            if b % align == 0 or b == length:
+                return b
+            if best_unaligned == 1:
+                best_unaligned = b
         b -= 1
-    return b
+    return best_unaligned
 
 
 def _resolve_blocks(q_len, k_len, block_q, block_k):
     """Fit the requested blocks to the sequence lengths.
 
-    Returns (usable, bq, bk): blocks are shrunk to the largest divisors of
-    the lengths, and `usable` says whether those divisors still give the
-    kernel a sane tile (k block a lane multiple — or the whole length —
-    and q block a sublane multiple): lengths like 1536 fit (768x512),
-    pathological ones (primes) report unusable so the dispatcher can take
-    the XLA path instead of running degenerate tiles."""
-    bq = _fit_block(q_len, block_q)
-    bk = _fit_block(k_len, block_k)
+    Returns (usable, bq, bk): the largest ALIGNED divisors of the lengths
+    at most the requested blocks (k lane-aligned, q sublane-aligned), so
+    e.g. 1536 fits as 512x768 and 1152 as 384x384; `usable` is False only
+    for pathological lengths (primes and such) with no aligned tiling,
+    where the dispatcher should take the XLA path instead of running
+    degenerate tiles."""
+    bq = _fit_block(q_len, block_q, 8)
+    bk = _fit_block(k_len, block_k, _LANES)
     usable = ((bk % _LANES == 0 or bk == k_len) and
               (bq % 8 == 0 or bq == q_len))
     return usable, bq, bk
-
-
-def _check_blocks(q_len, k_len, block_q, block_k):
-    block_q = min(block_q, q_len)
-    block_k = min(block_k, k_len)
-    if q_len % block_q or k_len % block_k:
-        raise ValueError(
-            f"seq lengths ({q_len},{k_len}) must divide into blocks "
-            f"({block_q},{block_k})")
-    return block_q, block_k
 
 
 def flash_attention_pallas(q, k, v, causal: bool = False,
@@ -179,7 +177,8 @@ def flash_attention_pallas(q, k, v, causal: bool = False,
     k_len = k.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
-    block_q, block_k = _check_blocks(q_len, k_len, block_q, block_k)
+    # fit to the lengths (largest aligned divisors <= requested blocks)
+    _, block_q, block_k = _resolve_blocks(q_len, k_len, block_q, block_k)
     nq, nk = q_len // block_q, k_len // block_k
 
     kernel = functools.partial(
@@ -333,7 +332,8 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
     k_len = k.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
-    block_q, block_k = _check_blocks(q_len, k_len, block_q, block_k)
+    # fit to the lengths (largest aligned divisors <= requested blocks)
+    _, block_q, block_k = _resolve_blocks(q_len, k_len, block_q, block_k)
     nq, nk = q_len // block_q, k_len // block_k
 
     # delta_i = rowsum(dO_i * O_i)  (cheap elementwise; leave to XLA)

@@ -37,10 +37,18 @@ from jax import lax
 # The ZeRO apply step donates the grad tree purely as scratch (no output
 # aliases it — see _build_functions), which makes XLA's compile-time
 # "donated buffers were not usable" warning expected noise on every engine.
-# Filtered once at import; the filter is message-scoped, so other donation
-# diagnostics (different messages) still surface.
-warnings.filterwarnings(
-    "ignore", message="Some donated buffers were not usable")
+# Installed once when the FIRST engine builds its functions (not at import
+# — merely importing the package must not mutate the host process's
+# warning filters); message-scoped so other donation diagnostics surface.
+_donation_filter_installed = False
+
+
+def _install_donation_warning_filter():
+    global _donation_filter_installed
+    if not _donation_filter_installed:
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        _donation_filter_installed = True
 
 from ..config import DeepSpeedConfig
 from ..parallel import mesh as mesh_mod
@@ -681,7 +689,9 @@ class DeepSpeedEngine:
         # "donated buffers were not usable" for exactly the grad tree at
         # compile time.  The donation is still wanted — grad buffers become
         # in-place scratch for the unscale/update temporaries — and the
-        # expected warning is filtered once at module import (top of file).
+        # expected warning is filtered once, on first engine build
+        # (_install_donation_warning_filter at top of file).
+        _install_donation_warning_filter()
         self._apply_fn = jax.jit(
             apply_step,
             out_shardings=(self.param_shardings, self.opt_shardings,

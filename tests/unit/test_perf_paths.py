@@ -71,6 +71,28 @@ def test_flash_attention_impl_dispatch():
     assert DEFAULT_BLOCK_Q >= 512 and DEFAULT_BLOCK_K >= 512
 
 
+def test_resolve_blocks_policy():
+    """The block-fitting policy behind impl='auto': every 128-multiple
+    length stays on the Pallas path with aligned tiles; only pathological
+    lengths fall back."""
+    from deepspeed_tpu.ops.flash_attention import _resolve_blocks
+    # flagship and long-seq shapes get the full tuned blocks
+    assert _resolve_blocks(1024, 1024, 512, 1024) == (True, 512, 1024)
+    assert _resolve_blocks(4096, 4096, 512, 1024) == (True, 512, 1024)
+    # non-power-of-two 128-multiples fit with smaller ALIGNED divisors
+    assert _resolve_blocks(1536, 1536, 512, 1024) == (True, 512, 768)
+    usable, bq, bk = _resolve_blocks(1152, 1152, 512, 1024)
+    assert usable and bq % 8 == 0 and bk % 128 == 0
+    assert 1152 % bq == 0 and 1152 % bk == 0
+    # a short whole length is its own (single) block
+    assert _resolve_blocks(33, 33, 512, 1024) == (True, 33, 33)
+    # primes have no aligned tiling -> XLA path
+    assert _resolve_blocks(1021, 1021, 512, 1024)[0] is False
+    # explicit small blocks remain honored (kernel-parity tests rely on it)
+    _, bq, bk = _resolve_blocks(128, 128, 64, 64)
+    assert (bq, bk) == (64, 64)
+
+
 def test_force_xla_kernels_override():
     orig = dispatch._force_xla
     try:
