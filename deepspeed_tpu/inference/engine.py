@@ -170,27 +170,48 @@ class InferenceEngine:
         max_len = prompt_len + max_new
         embed = model.embed
         head_logits = model.head_logits
+        # same policy object as training: the config's use_scan property
+        # (GPT2Config/BertConfig); fall back to the shared resolver for
+        # configs that predate it
+        use_scan = getattr(cfg, "use_scan", None)
+        if use_scan is None:
+            from ..models.layer_stack import resolve_use_scan
+            use_scan = resolve_use_scan(getattr(cfg, "scan_layers", None),
+                                        n_layers)
 
         def generate(params, input_ids, rng, temperature):
             b = input_ids.shape[0]
-            caches = KVCache(
-                jnp.zeros((n_layers, b, heads, max_len, head_dim),
-                          cfg.dtype),
-                jnp.zeros((n_layers, b, heads, max_len, head_dim),
-                          cfg.dtype))
 
-            # ---- prefill: scan layers over the whole prompt ---------- #
+            def zero_cache():
+                return jnp.zeros((b, heads, max_len, head_dim), cfg.dtype)
+
+            # Layer-stack execution mirrors training (models/layer_stack.py):
+            # scan carries STACKED [L, ...] caches; the unrolled variant
+            # keeps a per-layer tuple so no step ever restacks the cache.
+            # ---- prefill over the whole prompt ------------------------ #
             h = embed(params, input_ids, 0)
 
-            def prefill_body(carry, xs):
-                lp, ck, cv = xs
-                out, cache = layer.prefill(
-                    lp, carry, KVCache(ck, cv))
-                return out, (cache.k, cache.v)
+            if use_scan:
+                stacked = KVCache(
+                    jnp.zeros((n_layers,) + zero_cache().shape, cfg.dtype),
+                    jnp.zeros((n_layers,) + zero_cache().shape, cfg.dtype))
 
-            h, (ks, vs) = jax.lax.scan(
-                prefill_body, h, (params["h"], caches.k, caches.v))
-            caches = KVCache(ks, vs)
+                def prefill_body(carry, xs):
+                    lp, ck, cv = xs
+                    out, cache = layer.prefill(lp, carry, KVCache(ck, cv))
+                    return out, (cache.k, cache.v)
+
+                h, (ks, vs) = jax.lax.scan(
+                    prefill_body, h, (params["h"], stacked.k, stacked.v))
+                caches = KVCache(ks, vs)
+            else:
+                caches = []
+                for i in range(n_layers):
+                    lp = jax.tree.map(lambda a: a[i], params["h"])
+                    h, cache = layer.prefill(
+                        lp, h, KVCache(zero_cache(), zero_cache()))
+                    caches.append((cache.k, cache.v))
+                caches = tuple(caches)
             logits = head_logits(params, h[:, -1:, :])
 
             def sample(logits, r):
@@ -209,15 +230,24 @@ class InferenceEngine:
                 caches, tok, pos = carry
                 x = embed(params, tok[:, None], pos)
 
-                def layer_body(carry_h, xs):
-                    lp, ck, cv = xs
-                    out, cache = layer.decode(
-                        lp, carry_h, KVCache(ck, cv), pos)
-                    return out, (cache.k, cache.v)
+                if use_scan:
+                    def layer_body(carry_h, xs):
+                        lp, ck, cv = xs
+                        out, cache = layer.decode(
+                            lp, carry_h, KVCache(ck, cv), pos)
+                        return out, (cache.k, cache.v)
 
-                h, (ks, vs) = jax.lax.scan(
-                    layer_body, x, (params["h"], caches.k, caches.v))
-                caches = KVCache(ks, vs)
+                    h, (ks, vs) = jax.lax.scan(
+                        layer_body, x, (params["h"], caches.k, caches.v))
+                    caches = KVCache(ks, vs)
+                else:
+                    h, new_caches = x, []
+                    for i in range(n_layers):
+                        lp = jax.tree.map(lambda a: a[i], params["h"])
+                        h, cache = layer.decode(
+                            lp, h, KVCache(*caches[i]), pos)
+                        new_caches.append((cache.k, cache.v))
+                    caches = tuple(new_caches)
                 logits = head_logits(params, h)
                 nxt = sample(logits, r)
                 return (caches, nxt, pos + 1), tok

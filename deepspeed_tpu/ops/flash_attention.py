@@ -177,8 +177,15 @@ def flash_attention_pallas(q, k, v, causal: bool = False,
     k_len = k.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
-    # fit to the lengths (largest aligned divisors <= requested blocks)
+    # fit to the lengths (largest aligned divisors <= requested blocks);
+    # explicit small blocks are legal (kernel tests use 64x64) but a
+    # degenerate 1-wide tiling (prime-ish length) is rejected loudly —
+    # the flash_attention dispatcher falls back to XLA for those
     _, block_q, block_k = _resolve_blocks(q_len, k_len, block_q, block_k)
+    if (block_q == 1 and q_len > 1) or (block_k == 1 and k_len > 1):
+        raise ValueError(
+            f"seq lengths ({q_len},{k_len}) only tile into 1-wide blocks "
+            f"— use the flash_attention dispatcher (XLA fallback)")
     nq, nk = q_len // block_q, k_len // block_k
 
     kernel = functools.partial(
@@ -332,8 +339,15 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
     k_len = k.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
-    # fit to the lengths (largest aligned divisors <= requested blocks)
+    # fit to the lengths (largest aligned divisors <= requested blocks);
+    # explicit small blocks are legal (kernel tests use 64x64) but a
+    # degenerate 1-wide tiling (prime-ish length) is rejected loudly —
+    # the flash_attention dispatcher falls back to XLA for those
     _, block_q, block_k = _resolve_blocks(q_len, k_len, block_q, block_k)
+    if (block_q == 1 and q_len > 1) or (block_k == 1 and k_len > 1):
+        raise ValueError(
+            f"seq lengths ({q_len},{k_len}) only tile into 1-wide blocks "
+            f"— use the flash_attention dispatcher (XLA fallback)")
     nq, nk = q_len // block_q, k_len // block_k
 
     # delta_i = rowsum(dO_i * O_i)  (cheap elementwise; leave to XLA)

@@ -63,7 +63,6 @@ def ring_attention_inner(q, k, v, axis_name: str = SEQ_AXIS,
     k_len = k.shape[2]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
 
-    q32 = q.astype(jnp.float32)
     q_pos = idx * q_len + lax.broadcasted_iota(jnp.int32, (q_len, k_len), 0)
 
     # Ring rotation: shard j hands its current K/V block to shard j+1, so at
@@ -71,8 +70,13 @@ def ring_attention_inner(q, k, v, axis_name: str = SEQ_AXIS,
     perm = [(j, (j + 1) % sp) for j in range(sp)]
 
     def block(m, l, acc, k_cur, v_cur, src):
-        """Flash-style online-softmax update with one remote K/V block."""
-        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_cur.astype(jnp.float32),
+        """Flash-style online-softmax update with one remote K/V block.
+
+        Matmuls run in the INPUT dtype with fp32 accumulation (bf16 inputs
+        ride the MXU fast path; fp32 inputs keep exact fp32 math — an
+        upcast-first einsum would force the slow fp32 matmul passes even
+        for bf16 callers).  Softmax statistics are always fp32."""
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_cur,
                        preferred_element_type=jnp.float32) * scale
         if causal:
             k_pos = src * k_len + lax.broadcasted_iota(
@@ -90,7 +94,7 @@ def ring_attention_inner(q, k, v, axis_name: str = SEQ_AXIS,
         corr = jnp.exp(m - m_new)
         l = l * corr + jnp.sum(p, axis=-1)
         acc = acc * corr[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32),
+            "bhqk,bhkd->bhqd", p.astype(v_cur.dtype), v_cur,
             preferred_element_type=jnp.float32)
         return m_new, l, acc
 
