@@ -27,7 +27,8 @@ class MoE:
                  k: int = 1, capacity_factor: float = 1.0,
                  eval_capacity_factor: float = 1.0, min_capacity: int = 4,
                  noisy_gate_policy: Optional[str] = None,
-                 expert_ff_size: Optional[int] = None):
+                 expert_ff_size: Optional[int] = None,
+                 dispatch_impl: str = "scatter"):
         if noisy_gate_policy is not None and noisy_gate_policy not in (
                 "None", "Jitter", "RSample"):
             raise ValueError(
@@ -50,7 +51,8 @@ class MoE:
                         eval_capacity_factor, min_capacity,
                         None if noisy_gate_policy == "None"
                         else noisy_gate_policy)
-        self.deepspeed_moe = MOELayer(gate, expert, num_experts)
+        self.deepspeed_moe = MOELayer(gate, expert, num_experts,
+                                      dispatch_impl=dispatch_impl)
 
     def _check_mesh(self):
         ctx = mesh_mod.get_mesh_context(required=False)

@@ -48,15 +48,17 @@ def gumbel_rsample(rng, shape):
     return jax.random.gumbel(rng, shape, dtype=jnp.float32)
 
 
-def top1gating(logits: jnp.ndarray, capacity_factor: float = 1.0,
-               min_capacity: int = 4, used_token: Optional[jnp.ndarray] = None,
-               noisy_gate_policy: Optional[str] = None,
-               rng: Optional[jax.Array] = None
-               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Top-1 gating (reference: sharded_moe.py:99).
+def top1gating_compact(
+        logits: jnp.ndarray, capacity_factor: float = 1.0,
+        min_capacity: int = 4, used_token: Optional[jnp.ndarray] = None,
+        noisy_gate_policy: Optional[str] = None,
+        rng: Optional[jax.Array] = None):
+    """Top-1 gating, compact form — the single source of routing truth.
 
-    Returns (l_aux, combine_weights [S,E,C], dispatch_mask [S,E,C] bool,
-    exp_counts [E]).
+    Returns (l_aux, capacity, experts [S,1], slots [S,1], weights [S,1]
+    fp32 with zeros for dropped tokens, exp_counts [E]).  The [S,E,C]
+    mask form (top1gating) expands from this; the scatter dispatcher
+    consumes it directly with O(S·d) memory instead of O(S·E·C).
     """
     num_tokens, num_experts = logits.shape
     capacity = _capacity(num_tokens, num_experts, capacity_factor,
@@ -84,25 +86,51 @@ def top1gating(logits: jnp.ndarray, capacity_factor: float = 1.0,
     locations1 = jnp.cumsum(mask1, axis=0) - mask1
     mask1 = mask1 * (locations1 < capacity)
     locations1_s = (locations1 * mask1).sum(axis=-1)
+    gates1_s = (gates * mask1).sum(axis=-1)  # 0 for dropped tokens
 
-    gates1_s = (gates * mask1).sum(axis=-1)
-    combine = (gates1_s[:, None, None] * mask1[:, :, None] *
-               _one_hot(locations1_s, capacity)[:, None, :])
-    dispatch = combine > 0
+    return (l_aux, capacity, indices1[:, None],
+            locations1_s.astype(jnp.int32)[:, None], gates1_s[:, None],
+            exp_counts)
+
+
+def _expand_compact(capacity, num_experts, experts, slots, weights):
+    """Compact routing -> legacy (combine [S,E,C], dispatch [S,E,C])."""
+    combine = jnp.zeros((experts.shape[0], num_experts, capacity),
+                        jnp.float32)
+    for i in range(experts.shape[1]):
+        combine = combine + (weights[:, i, None, None] *
+                             _one_hot(experts[:, i], num_experts)[:, :, None] *
+                             _one_hot(slots[:, i], capacity)[:, None, :])
+    return combine, combine > 0
+
+
+def top1gating(logits: jnp.ndarray, capacity_factor: float = 1.0,
+               min_capacity: int = 4, used_token: Optional[jnp.ndarray] = None,
+               noisy_gate_policy: Optional[str] = None,
+               rng: Optional[jax.Array] = None
+               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Top-1 gating (reference: sharded_moe.py:99).
+
+    Returns (l_aux, combine_weights [S,E,C], dispatch_mask [S,E,C] bool,
+    exp_counts [E]).
+    """
+    l_aux, capacity, experts, slots, weights, exp_counts = top1gating_compact(
+        logits, capacity_factor, min_capacity, used_token,
+        noisy_gate_policy, rng)
+    combine, dispatch = _expand_compact(capacity, logits.shape[1],
+                                        experts, slots, weights)
     return l_aux, combine, dispatch, exp_counts
 
 
-def top2gating(logits: jnp.ndarray, capacity_factor: float = 1.0,
-               min_capacity: int = 4, rng: Optional[jax.Array] = None,
-               noisy_gate_policy: Optional[str] = None
-               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Top-2 gating (reference: sharded_moe.py:173).
+def top2gating_compact(
+        logits: jnp.ndarray, capacity_factor: float = 1.0,
+        min_capacity: int = 4, rng: Optional[jax.Array] = None,
+        noisy_gate_policy: Optional[str] = None):
+    """Top-2 gating, compact form (see top1gating_compact).
 
-    Second expert chosen with the top-1 expert masked out; gumbel noise is
-    added to the selection when an rng is available (the reference noises
-    unconditionally via torch's implicit global RNG; JAX needs an explicit
-    key, so pass rng= for reference-parity stochastic second choice).
-    Top-2 capacity doubles the slot budget like the reference (2 * S / E).
+    Returns (l_aux, capacity, experts [S,2], slots [S,2], weights [S,2]
+    fp32 normalized over the kept choices with zeros for dropped slots,
+    exp_counts [E]).
     """
     num_tokens, num_experts = logits.shape
     capacity = _capacity(num_tokens, num_experts, 2 * capacity_factor,
@@ -144,12 +172,28 @@ def top2gating(logits: jnp.ndarray, capacity_factor: float = 1.0,
     gates1_s = gates1_s / denom
     gates2_s = gates2_s / denom
 
-    combine1 = (gates1_s[:, None, None] * mask1[:, :, None] *
-                _one_hot(locations1_s, capacity)[:, None, :])
-    combine2 = (gates2_s[:, None, None] * mask2[:, :, None] *
-                _one_hot(locations2_s, capacity)[:, None, :])
-    combine = combine1 + combine2
-    dispatch = combine > 0
+    experts = jnp.stack([indices1, indices2], axis=1)
+    slots = jnp.stack([locations1_s, locations2_s], axis=1).astype(jnp.int32)
+    weights = jnp.stack([gates1_s, gates2_s], axis=1)
+    return l_aux, capacity, experts, slots, weights, exp_counts
+
+
+def top2gating(logits: jnp.ndarray, capacity_factor: float = 1.0,
+               min_capacity: int = 4, rng: Optional[jax.Array] = None,
+               noisy_gate_policy: Optional[str] = None
+               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Top-2 gating (reference: sharded_moe.py:173).
+
+    Second expert chosen with the top-1 expert masked out; gumbel noise is
+    added to the selection when an rng is available (the reference noises
+    unconditionally via torch's implicit global RNG; JAX needs an explicit
+    key, so pass rng= for reference-parity stochastic second choice).
+    Top-2 capacity doubles the slot budget like the reference (2 * S / E).
+    """
+    l_aux, capacity, experts, slots, weights, exp_counts = top2gating_compact(
+        logits, capacity_factor, min_capacity, rng, noisy_gate_policy)
+    combine, dispatch = _expand_compact(capacity, logits.shape[1],
+                                        experts, slots, weights)
     return l_aux, combine, dispatch, exp_counts
 
 
@@ -175,7 +219,18 @@ class TopKGate:
             rng, (self.model_dim, self.num_experts), jnp.float32) * scale)}
 
     def apply(self, params, x, rng=None, train=True):
-        """x: [S, d] tokens → (l_aux, combine, dispatch, exp_counts)."""
+        """x: [S, d] tokens → (l_aux, combine, dispatch, exp_counts) —
+        the legacy [S,E,C] form, expanded from the compact routing so the
+        einsum and scatter dispatch paths can never route differently."""
+        l_aux, capacity, experts, slots, weights, exp_counts = \
+            self.apply_compact(params, x, rng=rng, train=train)
+        combine, dispatch = _expand_compact(capacity, self.num_experts,
+                                            experts, slots, weights)
+        return l_aux, combine, dispatch, exp_counts
+
+    def apply_compact(self, params, x, rng=None, train=True):
+        """x: [S, d] → (l_aux, capacity, experts [S,k], slots [S,k],
+        weights [S,k], exp_counts) — no [S,E,C] materialization."""
         x32 = x.astype(jnp.float32)
         if train and self.noisy_gate_policy == "Jitter":
             if rng is None:
@@ -188,12 +243,12 @@ class TopKGate:
         logits = x32 @ params["wg"]
         cf = self.capacity_factor if train else self.eval_capacity_factor
         policy = self.noisy_gate_policy if train else None
-        rng = rng if train else None  # eval routing is deterministic
+        rng = rng if train else None
         if self.k == 1:
-            return top1gating(logits, cf, self.min_capacity,
-                              noisy_gate_policy=policy, rng=rng)
-        return top2gating(logits, cf, self.min_capacity, rng=rng,
-                          noisy_gate_policy=policy)
+            return top1gating_compact(logits, cf, self.min_capacity,
+                                      noisy_gate_policy=policy, rng=rng)
+        return top2gating_compact(logits, cf, self.min_capacity, rng=rng,
+                                  noisy_gate_policy=policy)
 
 
 class MOELayer:
@@ -203,10 +258,15 @@ class MOELayer:
     (the PipeLayer protocol) applied per-expert to [C, d] slot buffers.
     """
 
-    def __init__(self, gate: TopKGate, expert, num_local_experts_total: int):
+    def __init__(self, gate: TopKGate, expert, num_local_experts_total: int,
+                 dispatch_impl: str = "scatter"):
+        if dispatch_impl not in ("scatter", "einsum"):
+            raise ValueError(f"dispatch_impl must be 'scatter' or 'einsum', "
+                             f"got {dispatch_impl!r}")
         self.gate = gate
         self.expert = expert
         self.num_experts = num_local_experts_total
+        self.dispatch_impl = dispatch_impl
 
     def init_params(self, rng, x):
         gate_rng, exp_rng = jax.random.split(rng)
@@ -236,10 +296,62 @@ class MOELayer:
     def apply(self, params, x, rng=None, train=True):
         """x: [..., d] → (y [..., d], l_aux, exp_counts).
 
-        The einsum resharding realizes the reference's two all-to-alls
-        (sharded_moe.py:358,366): tokens (data-sharded) → slots
-        (expert-sharded) → tokens.
+        Two dispatch implementations (both lower the token→slot resharding
+        to the reference's two all-to-alls, sharded_moe.py:358,366):
+
+        - "scatter" (default): tokens scatter-add into their [E, C, d]
+          slots by flat slot id and gather back weighted — O(S·k·d)
+          working set, the TPU-idiomatic form at scale;
+        - "einsum": the GShard-paper [S, E, C] mask einsums — O(S·E·C)
+          memory, kept as the parity reference.
         """
+        if self.dispatch_impl == "scatter":
+            return self._apply_scatter(params, x, rng=rng, train=train)
+        return self._apply_einsum(params, x, rng=rng, train=train)
+
+    def _apply_scatter(self, params, x, rng=None, train=True):
+        orig_shape = x.shape
+        d_model = x.shape[-1]
+        tokens = x.reshape(-1, d_model)
+        s = tokens.shape[0]
+
+        l_aux, capacity, experts, slots, weights, exp_counts = \
+            self.gate.apply_compact(params["gate"], tokens, rng=rng,
+                                    train=train)
+        k = experts.shape[1]
+        e_total = self.num_experts
+        valid = weights > 0.0
+        # flat slot id; dropped tokens land in a dump row that is sliced off
+        flat_slot = jnp.where(valid, experts * capacity + slots,
+                              e_total * capacity)
+
+        # dispatch (all-to-all #1): scatter-add — valid (expert, slot)
+        # pairs are unique by construction, so add == set for them
+        flat = jnp.zeros((e_total * capacity + 1, d_model), x.dtype)
+        contrib = jnp.where(valid[..., None],
+                            jnp.broadcast_to(tokens[:, None, :],
+                                             (s, k, d_model)), 0)
+        flat = flat.at[flat_slot.reshape(-1)].add(
+            contrib.reshape(-1, d_model).astype(x.dtype))
+        dispatched = _constrain_expert(
+            flat[:e_total * capacity].reshape(e_total, capacity, d_model))
+
+        expert_out = jax.vmap(
+            lambda p, slot: self.expert.apply(p, slot, rng=None))(
+                params["experts"], dispatched)
+        expert_out = _constrain_expert(expert_out)
+
+        # combine (all-to-all #2): gather each token's k slot outputs and
+        # weight them; the dump row contributes zero weight
+        flat_out = jnp.concatenate(
+            [expert_out.reshape(e_total * capacity, d_model),
+             jnp.zeros((1, d_model), expert_out.dtype)], axis=0)
+        gathered = flat_out[flat_slot]                  # [S, k, d]
+        out = (weights[..., None].astype(gathered.dtype) * gathered).sum(
+            axis=1)
+        return out.astype(x.dtype).reshape(orig_shape), l_aux, exp_counts
+
+    def _apply_einsum(self, params, x, rng=None, train=True):
         orig_shape = x.shape
         d_model = x.shape[-1]
         tokens = x.reshape(-1, d_model)
