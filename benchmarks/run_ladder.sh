@@ -1,0 +1,10 @@
+#!/bin/bash
+# Run the full bench ladder strictly serially (the TPU tunnel admits one
+# claim at a time) and append JSON lines to benchmarks/ladder_results.jsonl.
+cd "$(dirname "$0")/.."
+out=benchmarks/ladder_results.jsonl
+for c in gpt2 bert_z2 moe decode longseq; do
+  echo "== $c $(date -u +%FT%TZ) ==" >&2
+  DS_BENCH_WATCHDOG=1300 timeout 1400 python bench.py --config "$c" \
+    2>/dev/null | tail -1 | tee -a "$out"
+done
