@@ -235,7 +235,8 @@ class GPT2Model:
         # (stale mesh, batch-1 decode); it is the same predicate scan gates
         # on internally, so the fold below only runs inside the manual
         # region.
-        streaming = stream is not None and stream.usable(h)
+        streaming = stream is not None and stream.usable(
+            h, params=params["h"])
 
         def body(carry, xs):
             if use_pld:

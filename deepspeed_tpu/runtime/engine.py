@@ -1204,3 +1204,34 @@ class DeepSpeedEngine:
             lambda tmpl, arr: jax.device_put(
                 jnp.asarray(arr, dtype=tmpl.dtype), tmpl.sharding),
             self.params, state_dict)
+
+    def save_fp16_model(self, save_dir, save_filename="model_weights.npz"):
+        """Consolidated half-precision model export for serving/hand-off
+        (reference: engine.py save_fp16_model, which gathers ZeRO-3 shards
+        layer-by-layer via _zero3_consolidated_fp16_state_dict:2432).
+
+        Writes one .npz of fp16 weights keyed by pytree path (fp16 is the
+        reference's export format and the only half type npz serializes
+        natively; bf16 leaves convert — weights sit well inside the fp16
+        range).  Multi-host: EVERY process must call this (the shard
+        gather is a collective); process 0 writes and returns the path."""
+        params = self.params
+        if jax.process_count() > 1:
+            # globally-sharded leaves are not addressable from one host
+            from jax.experimental import multihost_utils
+            params = multihost_utils.process_allgather(params, tiled=True)
+        if jax.process_index() != 0:
+            return None
+        os.makedirs(save_dir, exist_ok=True)
+        path = os.path.join(save_dir, save_filename)
+        arrays = {}
+        for name, arr in ckpt_mod._flatten(params).items():
+            # jnp.issubdtype also matches bf16 (np.issubdtype does NOT —
+            # ml_dtypes are void to numpy and would serialize as garbage)
+            if jnp.issubdtype(arr.dtype, jnp.floating):
+                arr = arr.astype(np.float16)
+            arrays[name] = arr
+        np.savez(path, **arrays)
+        log_dist(f"saved {len(arrays)} half-precision weight arrays to "
+                 f"{path}", ranks=[0])
+        return path

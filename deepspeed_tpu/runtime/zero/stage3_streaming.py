@@ -39,6 +39,7 @@ from typing import Any, Optional
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec
@@ -165,7 +166,21 @@ class Zero3StreamContext:
             key = jax.random.fold_in(key, lax.axis_index(ax))
         return key
 
-    def usable(self, init_carry, carry_batch_dim: int = 0) -> bool:
+    @staticmethod
+    def _has_cpu_hostile_half(tree) -> bool:
+        """True when any floating leaf is narrower than fp32 (bf16/fp16) —
+        on the CPU backend such leaves produce the collectives XLA's
+        AllReducePromotion pass aborts on."""
+        for leaf in jax.tree.leaves(tree):
+            dt = getattr(leaf, "dtype", None)
+            if dt is None:
+                continue
+            if jnp.issubdtype(dt, jnp.floating) and jnp.dtype(dt).itemsize < 4:
+                return True
+        return False
+
+    def usable(self, init_carry, carry_batch_dim: int = 0,
+               params=None) -> bool:
         """True when :meth:`scan` will actually stream.  Models MUST gate
         both the scan call and any fold_shard_index use on this — it is the
         same predicate scan applies internally (scan falls back to a plain
@@ -174,12 +189,30 @@ class Zero3StreamContext:
         Streaming cannot apply when: 1-way ZeRO mesh, the global mesh has
         moved on since install (the model object outlives the engine —
         e.g. reused for inference), or the batch doesn't divide the ZeRO
-        world (batch-1 decode)."""
+        world (batch-1 decode).
+
+        CPU-backend exception: half-precision streaming falls back to the
+        plain scan (GSPMD shard-at-use — numerically the same ZeRO-3,
+        minus the explicit schedule) because XLA CPU's AllReducePromotion
+        pass hard-aborts ('Invalid binary instruction opcode copy') on a
+        half-precision collective this region's backward produces.  The
+        explicit-streaming path stays covered on CPU by the fp32 tests;
+        TPU is unaffected."""
         if not self.active:
             return False
         from ...parallel import mesh as mesh_mod
         cur = mesh_mod.get_mesh_context(required=False)
         if cur is None or cur.mesh is not self.ctx.mesh:
+            return False
+        if jax.default_backend() == "cpu" and (
+                self._has_cpu_hostile_half(init_carry) or
+                self._has_cpu_hostile_half(params)):
+            if not getattr(self, "_cpu_half_warned", False):
+                log_dist(
+                    "ZeRO-3 explicit streaming disabled for half-precision "
+                    "on the CPU backend (XLA CPU collective-promotion bug); "
+                    "using GSPMD shard-at-use instead", ranks=[0])
+                self._cpu_half_warned = True
             return False
         zero_world = int(np.prod([self.axis_sizes[a] for a in self.manual]))
         for leaf in jax.tree.leaves(init_carry):

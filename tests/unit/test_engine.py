@@ -205,3 +205,108 @@ def test_train_batch_convenience():
     for _ in range(8):
         loss = engine.train_batch(it)
     assert loss < loss0
+
+
+def test_multi_output_model_uses_first_as_loss():
+    """Models returning (loss, aux...) train on out[0] (the reference's
+    multi_output_model.py coverage class)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import deepspeed_tpu as ds
+
+    w0 = jnp.ones((4,), jnp.float32)
+
+    def model(p, rng, x, y):
+        pred = x @ p["w"]
+        loss = jnp.mean((pred - y) ** 2)
+        return loss, pred.sum()  # aux output must be ignored by training
+
+    engine, _, _, _ = ds.initialize(
+        model=model, model_parameters={"w": w0},
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-1}},
+                "steps_per_print": 10 ** 9})
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 4).astype(np.float32)
+    y = (x @ np.array([1., 2., 3., 4.], np.float32)).astype(np.float32)
+    losses = []
+    for _ in range(10):
+        loss = engine.forward(x, y)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def _assert_fp16_export(engine, tmp_path):
+    import jax
+    import numpy as np
+    path = engine.save_fp16_model(str(tmp_path))
+    loaded = np.load(path)
+    flat = jax.tree_util.tree_flatten_with_path(engine.params)[0]
+    assert len(loaded.files) == len(flat)
+    import jax.numpy as jnp
+    for key_path, leaf in flat:
+        name = jax.tree_util.keystr(key_path)
+        arr = loaded[name]
+        host = np.asarray(leaf)
+        if jnp.issubdtype(host.dtype, jnp.floating):
+            assert arr.dtype == np.float16, (name, arr.dtype)
+            np.testing.assert_allclose(arr.astype(np.float32),
+                                       host.astype(np.float32), rtol=1e-2,
+                                       atol=1e-4)
+        else:
+            np.testing.assert_array_equal(arr, host)
+
+
+def test_save_fp16_model_export(tmp_path):
+    """Consolidated half-precision export (reference save_fp16_model):
+    one npz of fp16 weights, loadable and matching the live params —
+    including from a ZeRO-3 sharded engine."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT2Config, GPT2Model
+
+    cfg = GPT2Config(vocab_size=64, n_positions=16, hidden_size=16,
+                     num_layers=2, num_heads=2, bf16=True)
+    model = GPT2Model(cfg)
+    engine, _, _, _ = ds.initialize(
+        model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 3},
+                "steps_per_print": 10 ** 9})
+    _assert_fp16_export(engine, tmp_path)
+
+
+def test_save_fp16_model_export_bf16_offload(tmp_path):
+    """ZeRO-Offload stores DEVICE params in the compute dtype (bf16) —
+    the export must still emit readable fp16, not raw bf16 bytes (numpy
+    would silently serialize ml_dtypes as void)."""
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT2Config, GPT2Model
+
+    cfg = GPT2Config(vocab_size=64, n_positions=16, hidden_size=16,
+                     num_layers=2, num_heads=2, bf16=True)
+    model = GPT2Model(cfg)
+    engine, _, _, _ = ds.initialize(
+        model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "bf16": {"enabled": True},
+                "zero_optimization": {
+                    "stage": 2, "offload_optimizer": {"device": "cpu"}},
+                "steps_per_print": 10 ** 9})
+    assert any(jnp.issubdtype(l.dtype, jnp.bfloat16) or
+               l.dtype == jnp.bfloat16
+               for l in jax.tree.leaves(engine.params)), \
+        "offload engine should hold bf16 device params"
+    _assert_fp16_export(engine, tmp_path)

@@ -173,3 +173,33 @@ def test_streaming_with_tensor_parallel():
     np.testing.assert_allclose(losses, base_losses, rtol=1e-5)
     for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(base_params)):
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+
+def test_zero3_bf16_cpu_falls_back_to_gspmd():
+    """z3 + bf16 on the CPU backend must TRAIN (regression: XLA CPU's
+    AllReducePromotion hard-aborts on the half-precision collective the
+    explicit-streaming region emits; usable() falls back to GSPMD)."""
+    import jax
+    import numpy as np
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT2Config, GPT2Model
+
+    cfg = GPT2Config(vocab_size=64, n_positions=16, hidden_size=16,
+                     num_layers=2, num_heads=2, bf16=True)
+    model = GPT2Model(cfg)
+    engine, _, _, _ = ds.initialize(
+        model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 3},
+                "steps_per_print": 10 ** 9})
+    ids = np.random.RandomState(0).randint(0, 64, (8, 16)).astype(np.int32)
+    losses = []
+    for _ in range(5):
+        loss = engine.forward(ids)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
