@@ -259,7 +259,8 @@ class Zero3StreamContext:
         carry_batch_dim: dimension of each carry leaf sharded over the ZeRO
         axes (the batch dimension).
         """
-        if not self.usable(init_carry, carry_batch_dim):
+        if not self.usable(init_carry, carry_batch_dim,
+                           params=stacked_params):
             carry, _ = lax.scan(
                 lambda c, xs: body(c, xs),
                 init_carry, (stacked_params,) + tuple(extra_xs))
