@@ -34,6 +34,7 @@ declarative TP.
 """
 
 from dataclasses import dataclass
+from functools import partial
 from typing import Any, Optional
 
 import numpy as np
@@ -131,6 +132,40 @@ def _gather_dims(spec: PartitionSpec, manual: frozenset):
     return out
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _all_gather_f32grad(x, axes, dim):
+    """Tiled all-gather whose transpose reduce-scatters in float32.
+
+    Forward: identical to ``lax.all_gather(tiled=True)`` — shards move at
+    their native width (bf16 gathers cost bf16 bytes).  Backward: the layer
+    gradient is promoted to fp32 BEFORE the ``psum_scatter`` and demoted
+    back after, so the cross-shard gradient reduction accumulates in fp32
+    regardless of compute dtype (the reference reduces fp16 grads natively,
+    stage3.py:1908; fp32 accumulation strictly tightens that).  This also
+    keeps the manual region's only reduction collective out of XLA-CPU's
+    AllReducePromotion pass, which hard-aborts on half-precision reduction
+    collectives ('Invalid binary instruction opcode copy') — bf16 streaming
+    now runs identically on CPU and TPU."""
+    return lax.all_gather(x, axes, axis=dim, tiled=True)
+
+
+def _ag_fwd(x, axes, dim):
+    return _all_gather_f32grad(x, axes, dim), None
+
+
+def _ag_bwd(axes, dim, _, g):
+    half = (jnp.issubdtype(g.dtype, jnp.floating) and
+            jnp.dtype(g.dtype).itemsize < 4)
+    if half:
+        shard = lax.psum_scatter(g.astype(jnp.float32), axes,
+                                 scatter_dimension=dim, tiled=True)
+        return (shard.astype(g.dtype),)
+    return (lax.psum_scatter(g, axes, scatter_dimension=dim, tiled=True),)
+
+
+_all_gather_f32grad.defvjp(_ag_fwd, _ag_bwd)
+
+
 class Zero3StreamContext:
     """Installable streaming executor for stacked-layer models.
 
@@ -166,19 +201,6 @@ class Zero3StreamContext:
             key = jax.random.fold_in(key, lax.axis_index(ax))
         return key
 
-    @staticmethod
-    def _has_cpu_hostile_half(tree) -> bool:
-        """True when any floating leaf is narrower than fp32 (bf16/fp16) —
-        on the CPU backend such leaves produce the collectives XLA's
-        AllReducePromotion pass aborts on."""
-        for leaf in jax.tree.leaves(tree):
-            dt = getattr(leaf, "dtype", None)
-            if dt is None:
-                continue
-            if jnp.issubdtype(dt, jnp.floating) and jnp.dtype(dt).itemsize < 4:
-                return True
-        return False
-
     def usable(self, init_carry, carry_batch_dim: int = 0,
                params=None) -> bool:
         """True when :meth:`scan` will actually stream.  Models MUST gate
@@ -191,28 +213,17 @@ class Zero3StreamContext:
         e.g. reused for inference), or the batch doesn't divide the ZeRO
         world (batch-1 decode).
 
-        CPU-backend exception: half-precision streaming falls back to the
-        plain scan (GSPMD shard-at-use — numerically the same ZeRO-3,
-        minus the explicit schedule) because XLA CPU's AllReducePromotion
-        pass hard-aborts ('Invalid binary instruction opcode copy') on a
-        half-precision collective this region's backward produces.  The
-        explicit-streaming path stays covered on CPU by the fp32 tests;
-        TPU is unaffected."""
+        Half precision streams on every backend: the region's only
+        reduction collective (the gather's transpose) runs in fp32 via
+        ``_all_gather_f32grad``, which sidesteps XLA-CPU's half-precision
+        AllReducePromotion abort that used to force a GSPMD fallback
+        here."""
+        del params  # kept for call-site compatibility
         if not self.active:
             return False
         from ...parallel import mesh as mesh_mod
         cur = mesh_mod.get_mesh_context(required=False)
         if cur is None or cur.mesh is not self.ctx.mesh:
-            return False
-        if jax.default_backend() == "cpu" and (
-                self._has_cpu_hostile_half(init_carry) or
-                self._has_cpu_hostile_half(params)):
-            if not getattr(self, "_cpu_half_warned", False):
-                log_dist(
-                    "ZeRO-3 explicit streaming disabled for half-precision "
-                    "on the CPU backend (XLA CPU collective-promotion bug); "
-                    "using GSPMD shard-at-use instead", ranks=[0])
-                self._cpu_half_warned = True
             return False
         zero_world = int(np.prod([self.axis_sizes[a] for a in self.manual]))
         for leaf in jax.tree.leaves(init_carry):
@@ -295,11 +306,35 @@ class Zero3StreamContext:
             PartitionSpec(None, *list(_restrict_to_manual(s, manual)))
             for s in inner_specs]
         gathers = [_gather_dims(s, manual) for s in inner_specs]
+        # A leaf not sharded over EVERY manual axis enters the region
+        # replicated along the uncovered axes, so its gradient is a psum
+        # over those axes at the shard_map transpose boundary.  Such
+        # half-precision leaves are widened to fp32 at entry (cast back to
+        # their dtype at use) so that psum accumulates in fp32 — matching
+        # _all_gather_f32grad's fp32 reduce-scatter for the gathered dims,
+        # and keeping every reduction collective the region emits out of
+        # XLA-CPU's half-precision AllReducePromotion abort.  Leaves with
+        # uncovered axes are the ones too small to shard further, so the
+        # widened transfer is noise.
+        leaf_dtypes = [l.dtype for l in p_leaves]
+
+        def _covered_axes(dims):
+            cov = set()
+            for _, axes in dims:
+                cov.update(axes)
+            return cov
+
+        widen = [
+            _covered_axes(dims) != set(manual) and
+            jnp.issubdtype(dt, jnp.floating) and jnp.dtype(dt).itemsize < 4
+            for dims, dt in zip(gathers, leaf_dtypes)]
 
         def group_leaf(leaf):
             return leaf.reshape((steps, g) + tuple(leaf.shape[1:]))
 
-        grouped_params = [group_leaf(l) for l in p_leaves]
+        grouped_params = [
+            group_leaf(l.astype(jnp.float32) if w else l)
+            for l, w in zip(p_leaves, widen)]
         grouped_extras = jax.tree.map(group_leaf, extra_xs)
         # the group reshape shifts every dim by one: shift specs too
         def shift(spec):
@@ -323,10 +358,12 @@ class Zero3StreamContext:
             re-gathers instead (exactly the reference's backward re-fetch,
             stage3.py:546 PreBackwardFunction)."""
             full = []
-            for leaf, dims in zip(shards, gathers):
+            for leaf, dims, dt, w in zip(shards, gathers, leaf_dtypes,
+                                         widen):
                 for dim, axes in dims:
-                    leaf = lax.all_gather(leaf, axes, axis=dim + 1,
-                                          tiled=True)
+                    leaf = _all_gather_f32grad(leaf, axes, dim + 1)
+                if w:
+                    leaf = leaf.astype(dt)
                 full.append(checkpoint_name(leaf, "zero3_gathered"))
             return full
 

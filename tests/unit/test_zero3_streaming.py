@@ -175,17 +175,21 @@ def test_streaming_with_tensor_parallel():
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
 
 
-def test_zero3_bf16_cpu_falls_back_to_gspmd():
-    """z3 + bf16 on the CPU backend must TRAIN (regression: XLA CPU's
-    AllReducePromotion hard-aborts on the half-precision collective the
-    explicit-streaming region emits; usable() falls back to GSPMD)."""
+def test_zero3_bf16_streams_on_cpu():
+    """z3 + bf16 must run the EXPLICIT streaming path on every backend
+    (regression: XLA CPU's AllReducePromotion used to hard-abort on the
+    half-precision reduce-scatter the region's backward emits, forcing a
+    GSPMD fallback; _all_gather_f32grad now runs that collective in fp32)."""
     import jax
     import numpy as np
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import GPT2Config, GPT2Model
 
+    ds.reset_mesh_context()
+    mesh = ds.initialize_mesh(data=-1)
     cfg = GPT2Config(vocab_size=64, n_positions=16, hidden_size=16,
-                     num_layers=2, num_heads=2, bf16=True)
+                     num_layers=2, num_heads=2, bf16=True, embd_dropout=0.0,
+                     attn_dropout=0.0, hidden_dropout=0.0)
     model = GPT2Model(cfg)
     engine, _, _, _ = ds.initialize(
         model=model,
@@ -193,9 +197,24 @@ def test_zero3_bf16_cpu_falls_back_to_gspmd():
         config={"train_micro_batch_size_per_gpu": 1,
                 "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
                 "bf16": {"enabled": True},
-                "zero_optimization": {"stage": 3},
-                "steps_per_print": 10 ** 9})
+                "zero_optimization": {
+                    "stage": 3, "stage3_param_persistence_threshold": 0},
+                "steps_per_print": 10 ** 9},
+        mesh=mesh, rng=jax.random.PRNGKey(7))
+    stream = engine._zero3_stream
+    assert stream is not None and stream.active
+    # the streamed region really engages for the bf16 carry (no fallback)
+    dummy_carry = jax.numpy.zeros((8, 16, 16), "bfloat16")
+    assert stream.usable(dummy_carry, params=engine.params)
     ids = np.random.RandomState(0).randint(0, 64, (8, 16)).astype(np.int32)
+
+    # the compiled grad graph must contain the streaming all_gathers
+    def loss_fn(p):
+        return model.loss(p, None, ids)
+    jaxpr = str(jax.make_jaxpr(jax.grad(loss_fn))(engine.params))
+    assert jaxpr.count("all_gather") >= 2, \
+        "bf16 ZeRO-3 must take the explicit streaming path, not GSPMD"
+
     losses = []
     for _ in range(5):
         loss = engine.forward(ids)
