@@ -3,11 +3,30 @@
 Full-train-step timing with state feedback — the only reliable way to
 measure through the TPU tunnel (pure repeated-input microbenchmarks hit
 dispatch-latency floors and caching artifacts; see README.md).
+
+Importing this module installs SIGTERM/SIGINT handlers that raise
+SystemExit, so a `timeout`-killed profiling run exits CLEANLY (atexit +
+client teardown) and releases its TPU claim — a profiler killed by plain
+signal death is exactly what wedged the round-2 bench (stale claim held
+the tunnel's single slot for hours).
 """
 
+import signal
+import sys
 import time
 
 import jax
+
+
+def _clean_exit(signum, frame):
+    sys.exit(128 + signum)  # run atexit/destructors → release the TPU claim
+
+
+for _sig in (signal.SIGTERM, signal.SIGINT):
+    try:
+        signal.signal(_sig, _clean_exit)
+    except (ValueError, OSError):  # non-main thread / exotic platform
+        pass
 
 
 def time_step(name, make_step, params, flops, iters=15):

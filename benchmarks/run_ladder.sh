@@ -1,10 +1,16 @@
 #!/bin/bash
 # Run the full bench ladder strictly serially (the TPU tunnel admits one
 # claim at a time) and append JSON lines to benchmarks/ladder_results.jsonl.
+#
+# Per-row discipline: bench.py probes the slot in a killable subprocess and
+# waits out stale claims; `timeout` sends TERM first (bench.py emits its
+# diagnostic line and exits cleanly, releasing the claim) and KILLs only
+# 30 s later as a last resort.
 cd "$(dirname "$0")/.."
 out=benchmarks/ladder_results.jsonl
-for c in gpt2 bert_z2 moe decode longseq; do
+for c in gpt2 bert_z2 moe decode longseq offload infinity; do
   echo "== $c $(date -u +%FT%TZ) ==" >&2
-  DS_BENCH_WATCHDOG=1300 timeout 1400 python bench.py --config "$c" \
+  DS_BENCH_WATCHDOG=1200 DS_BENCH_RUN_MARGIN=700 \
+    timeout -k 30 1300 python bench.py --config "$c" \
     2>/dev/null | tail -1 | tee -a "$out"
 done

@@ -568,6 +568,8 @@ class DeepSpeedConfig:
                                                 C.STEPS_PER_PRINT_DEFAULT)
         self.dump_state = get_scalar_param(pd, C.DUMP_STATE,
                                            C.DUMP_STATE_DEFAULT)
+        self.prng_impl = get_scalar_param(pd, C.PRNG_IMPL,
+                                          C.PRNG_IMPL_DEFAULT)
         self.gradient_clipping = get_scalar_param(pd, C.GRADIENT_CLIPPING,
                                                   C.GRADIENT_CLIPPING_DEFAULT)
         self.sparse_gradients_enabled = get_scalar_param(

@@ -104,6 +104,14 @@ STEPS_PER_PRINT_DEFAULT = 10
 DUMP_STATE = "dump_state"
 DUMP_STATE_DEFAULT = False
 
+# Engine PRNG implementation for the default (no rng= passed) stream.
+# "rbg" is the fast TPU choice (~14 ms/step over threefry on the flagship
+# bench) but JAX documents rbg streams as NOT stable across backends or
+# JAX versions; set "threefry" for bit-reproducible default dropout/noise
+# across upgrades and CPU-vs-TPU runs.
+PRNG_IMPL = "prng_impl"
+PRNG_IMPL_DEFAULT = "rbg"
+
 VOCABULARY_SIZE = "vocabulary_size"
 VOCABULARY_SIZE_DEFAULT = None
 

@@ -1,0 +1,61 @@
+"""ds_ssh — run one command on every worker in the hostfile.
+
+Reference: bin/ds_ssh (pdsh fan-out over /job/hostfile).  TPU recasting:
+TPU-VM pods are usually driven via `gcloud compute tpus tpu-vm ssh
+--worker=all`, but the hostfile workflow matters for the on-prem /
+hostfile-launched case `dslaunch` supports — so ds_ssh mirrors the
+reference semantics: read the hostfile, fan the command out over ssh
+(pdsh when available, plain ssh loop otherwise), run locally when no
+hostfile exists.
+"""
+
+import argparse
+import shlex
+import shutil
+import subprocess
+import sys
+
+from .runner import DLTS_HOSTFILE, fetch_hostfile
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ds_ssh",
+        description="run a command on all hosts in the hostfile")
+    p.add_argument("-H", "--hostfile", default=DLTS_HOSTFILE,
+                   help="hostfile: one 'hostname slots=N' per line")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="command to run on every host")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if not args.command:
+        build_parser().print_usage(sys.stderr)
+        return 2
+    cmd = args.command
+    hosts = fetch_hostfile(args.hostfile)
+    if not hosts:
+        # reference behavior: no hostfile -> run locally
+        print(f"Missing hostfile at {args.hostfile}, executing command "
+              "locally", file=sys.stderr)
+        return subprocess.call(cmd)
+    # the remote shell re-parses one string — quote each arg so spaces
+    # and metacharacters survive the trip (shlex.join)
+    remote_cmd = shlex.join(cmd)
+    if shutil.which("pdsh"):
+        host_list = ",".join(hosts)
+        return subprocess.call(
+            ["pdsh", "-R", "ssh", "-w", host_list, remote_cmd])
+    rc = 0
+    for host in hosts:
+        print(f"== {host} ==", file=sys.stderr)
+        r = subprocess.call(["ssh", "-o", "StrictHostKeyChecking=no",
+                             host, remote_cmd])
+        rc = rc or r
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

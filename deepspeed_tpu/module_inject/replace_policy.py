@@ -137,5 +137,69 @@ class HFGPTNEOLayerPolicy(InjectBasePolicy):
         }
 
 
+class MegatronLayerPolicy(InjectBasePolicy):
+    """Megatron-LM ParallelTransformerLayer (reference:
+    replace_policy.py:146).
+
+    Megatron layers are pre-LN causal blocks whose projections are
+    nn.Linear ([out, in] — transposed into our [in, out] x@W layout).
+    Old Megatron exposes the attention block as ``.attention`` and stores
+    query_key_value q/k/v-contiguous [3H, H]; newer source renames it
+    ``.self_attention`` AND interleaves the stacking per head,
+    [heads, 3, head_dim] flattened over rows (the reference's
+    ``version``/megatron-v2 knob, replace_policy.py:146) — both are
+    accepted here, keyed off the attribute name, and the v2 layout is
+    de-interleaved back to the q/k/v-contiguous [3H, H] our engine's qkv
+    split (and state_dict_factory's merge/split) expects."""
+
+    LAYER_CLASS_NAMES = ("ParallelTransformerLayer",)
+    pre_layer_norm = True
+    causal = True
+
+    @staticmethod
+    def _deinterleave_qkv(arr, heads):
+        """[heads, 3, head_dim, ...] row blocks -> [3, heads, head_dim, ...]."""
+        rows = arr.shape[0]
+        hd = rows // (3 * heads)
+        rest = arr.shape[1:]
+        return (arr.reshape(heads, 3, hd, *rest)
+                .swapaxes(0, 1)
+                .reshape(rows, *rest))
+
+    def layer_params(self):
+        l = self.layer
+        att = getattr(l, "attention", None)
+        v2 = att is None  # .self_attention == new source == interleaved qkv
+        if v2:
+            att = l.self_attention
+
+        def bias_of(lin):
+            b = getattr(lin, "bias", None)
+            return (_np(b) if b is not None
+                    else np.zeros((lin.weight.shape[0],), np.float32))
+
+        qkvw = _np(att.query_key_value.weight)        # [3H, H] rows
+        qkvb = bias_of(att.query_key_value)
+        if v2:
+            heads = int(att.num_attention_heads)
+            qkvw = self._deinterleave_qkv(qkvw, heads)
+            qkvb = self._deinterleave_qkv(qkvb, heads)
+
+        return {
+            "attn_qkvw": qkvw.T,                      # [3H,H] -> [H,3H]
+            "attn_qkvb": qkvb,
+            "attn_ow": _np(att.dense.weight).T,
+            "attn_ob": bias_of(att.dense),
+            "norm_w": _np(l.input_layernorm.weight),          # pre-attn LN
+            "norm_b": _np(l.input_layernorm.bias),
+            "attn_nw": _np(l.post_attention_layernorm.weight),  # pre-MLP LN
+            "attn_nb": _np(l.post_attention_layernorm.bias),
+            "inter_w": _np(l.mlp.dense_h_to_4h.weight).T,
+            "inter_b": bias_of(l.mlp.dense_h_to_4h),
+            "output_w": _np(l.mlp.dense_4h_to_h.weight).T,
+            "output_b": bias_of(l.mlp.dense_4h_to_h),
+        }
+
+
 replace_policies: List[type] = [HFGPT2LayerPolicy, HFBertLayerPolicy,
-                                HFGPTNEOLayerPolicy]
+                                HFGPTNEOLayerPolicy, MegatronLayerPolicy]

@@ -152,14 +152,15 @@ def _resolve_blocks(q_len, k_len, block_q, block_k):
 
     Returns (usable, bq, bk): the largest ALIGNED divisors of the lengths
     at most the requested blocks (k lane-aligned, q sublane-aligned), so
-    e.g. 1536 fits as 512x768 and 1152 as 384x384; `usable` is False only
-    for pathological lengths (primes and such) with no aligned tiling,
-    where the dispatcher should take the XLA path instead of running
-    degenerate tiles."""
+    e.g. 1536 fits as 512x768 and 1152 as 384x384.  `usable` requires a
+    strictly lane/sublane-aligned tiling: a length with no such divisor
+    (primes, 1000, short whole lengths < the 128-lane width) dispatches to
+    XLA instead — masked lane reductions on partial tiles are exactly the
+    configuration the TPU-path tests cannot cover (interpret-mode tests
+    don't exercise lane masking), so the dispatcher never runs them."""
     bq = _fit_block(q_len, block_q, 8)
     bk = _fit_block(k_len, block_k, _LANES)
-    usable = ((bk % _LANES == 0 or bk == k_len) and
-              (bq % 8 == 0 or bq == q_len))
+    usable = bk % _LANES == 0 and bq % 8 == 0
     return usable, bq, bk
 
 
@@ -482,11 +483,25 @@ def flash_attention(q, k, v, causal: bool = False,
                     impl: str = "auto"):
     """Fused multi-head attention: q,k,v [B, H, S, D] -> [B, H, S, D].
 
-    impl: "auto" (default) and "pallas" run the Pallas flash kernel with
-    blocks fitted to the sequence lengths (_resolve_blocks), falling back
-    to the XLA reference only on CPU or pathological (prime-ish) lengths;
-    "xla" forces the reference.  Additive-bias attention always takes the
-    XLA path (the compiler fuses the bias add into the softmax)."""
+    impl: "auto" (default) runs the Pallas flash kernel with blocks fitted
+    to the sequence lengths (_resolve_blocks), falling back to the XLA
+    reference on CPU, unaligned lengths, or bias; "pallas" REQUIRES the
+    Pallas kernel and raises where auto would fall back (so ablation
+    harnesses can never silently measure the XLA path); "xla" forces the
+    reference.  Additive-bias attention always takes the XLA path (the
+    compiler fuses the bias add into the softmax)."""
+    if impl == "pallas":
+        if bias is not None:
+            raise ValueError(
+                "impl='pallas': the Pallas kernel does not take an additive "
+                "bias — use impl='auto'/'xla'")
+        if not _use_pallas(q.shape[2], k.shape[2], q.shape[3],
+                           block_q, block_k):
+            raise ValueError(
+                f"impl='pallas': no aligned tiling for seq lengths "
+                f"({q.shape[2]},{k.shape[2]}) or Pallas unavailable on this "
+                "backend — use impl='auto' for the XLA fallback")
+        return _flash(q, k, v, causal, sm_scale, block_q, block_k)
     if bias is not None:
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
                              bias=bias)

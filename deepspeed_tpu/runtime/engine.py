@@ -259,11 +259,16 @@ class DeepSpeedEngine:
         # ---- data ---------------------------------------------------- #
         self.training_dataloader = self._configure_dataloader(
             training_data, collate_fn)
-        # rbg PRNG: split/fold_in are cheap and mask generation vectorizes
-        # on the TPU VPU — measured ~14 ms/step faster than threefry on the
-        # flagship bench (benchmarks/profile_ablations2.py).  Typed key;
-        # callers passing their own `rng` keep whatever impl they chose.
-        self._rng = rng if rng is not None else jax.random.key(42, impl="rbg")
+        # Default-stream PRNG impl is a config knob ("prng_impl").  rbg:
+        # split/fold_in are cheap and mask generation vectorizes on the TPU
+        # VPU — measured ~14 ms/step faster than threefry on the flagship
+        # bench (benchmarks/profile_ablations2.py) — but JAX documents rbg
+        # streams as NOT stable across backends/versions; configs needing
+        # bit-reproducible default dropout across upgrades or CPU-vs-TPU
+        # set prng_impl="threefry".  Callers passing their own `rng` keep
+        # whatever impl they chose.
+        self._rng = (rng if rng is not None
+                     else jax.random.key(42, impl=self.config.prng_impl))
 
         # ---- training-dynamics subsystems ---------------------------- #
         # PLD (reference engine.py:1236,1487), curriculum seqlen

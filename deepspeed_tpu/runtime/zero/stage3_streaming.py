@@ -405,7 +405,12 @@ class Zero3StreamContext:
 
         # check_vma off: pallas_call outputs carry no varying-mesh-axes
         # metadata, so the vma analysis rejects any Pallas kernel (LN,
-        # flash attention) inside the manual region at trace time.
+        # flash attention) inside the manual region at trace time.  This
+        # also disables the analysis for Pallas-free bodies (the model
+        # decides what runs inside `body`, so it cannot be known here).
+        # TODO: re-enable check_vma once pallas_call propagates vma
+        # metadata upstream — it would catch cross-shard replication bugs
+        # in this manual-collective region at trace time.
         streamed = jax.shard_map(
             region_fn, mesh=mesh,
             in_specs=(carry_spec, in_specs_params, extras_specs),

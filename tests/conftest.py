@@ -25,3 +25,66 @@ def _reset_mesh():
     yield
     from deepspeed_tpu.parallel import reset_mesh_context
     reset_mesh_context()
+
+
+# ------------------------------------------------------------------------- #
+# Two-tier suite (VERDICT r2 #7; reference analog: CI gates on
+# `pytest --forked tests/unit`, .github/workflows/main.yml:50-52):
+#
+#   fast lane: python -m pytest tests/ -q -m "not slow"   (~4 min)
+#   full lane: python -m pytest tests/ -q                 (~25 min, 1 core)
+#
+# Tests measured >= ~8 s on this box (1-core CPU sim mesh; generated from
+# `pytest --durations=60`, 2026-07-30) are auto-marked `slow` below —
+# trajectory-equality matrices, multi-process runs, convergence loops.
+# Prefix match, so parametrized variants are covered.  Regenerate the list
+# with --durations after large suite changes.
+# ------------------------------------------------------------------------- #
+_SLOW_PREFIXES = (
+    "test_3d_matrix.py::test_composition_matches_baseline",
+    "test_3d_matrix.py::test_moe_zero_matches_zero0",
+    "test_bench_harness.py::test_sigterm_emits_one_diagnostic_json_line",
+    "test_checkpoint_matrix.py::test_roundtrip",
+    "test_convergence.py::test_gpt2_engine_converges",
+    "test_engine_couplings.py::test_eigenvalue_disabled_keeps_global_schedule",
+    "test_engine_couplings.py::test_eigenvalue_drives_moq_schedule",
+    "test_engine_couplings.py::test_sparse_gradients_matches_dense",
+    "test_fused_cross_entropy.py::test_gpt2_fused_loss_matches_naive",
+    "test_functionality_matrix.py::test_matrix_matches_baseline",
+    "test_inference.py::test_generate_matches_full_recompute",
+    "test_inference.py::test_hf_checkpoint_loader_path_greedy_decode_parity",
+    "test_inference.py::test_hf_gpt2_injection_parity",
+    "test_inference.py::test_megatron_layer_policy_parity",
+    "test_infinity.py::test_host_param_streaming_matches_resident",
+    "test_infinity.py::test_nvme_param_streaming_matches_resident",
+    "test_models.py::test_bert_attention_mask_changes_output",
+    "test_models.py::test_bert_mlm_loss_ignores_unmasked_positions",
+    "test_models.py::test_gpt2_activation_checkpointing_same_loss",
+    "test_models.py::test_gpt2_tensor_parallel_training_on_mesh",
+    "test_models.py::test_gpt2_trains_through_engine",
+    "test_moe.py::TestMOELayer::test_batched_input_shape",
+    "test_moe.py::TestScatterDispatch::test_scatter_gradients_match_einsum",
+    "test_moe.py::TestScatterDispatch::test_scatter_matches_einsum",
+    "test_one_f_one_b.py::test_1f1b_matches_gpipe_trajectory",
+    "test_one_f_one_b.py::test_1f1b_memory_does_not_scale_with_microbatches",
+    "test_ops.py::test_transformer_layer_shapes_and_determinism",
+    "test_profiler_launcher_tools.py::test_compressed_allreduce_error_feedback",
+    "test_profiler_launcher_tools.py::test_onebit_adam_converges_after_freeze",
+    "test_sequence_parallel.py::test_ring_attention_grad_flows",
+    "test_sharded_checkpoint.py::test_dp_resize_restore",
+    "test_sharded_checkpoint.py::test_two_process_distributed_checkpoint",
+    "test_sharded_checkpoint.py::test_two_process_distributed_training",
+    "test_sparse_attention.py::test_gpt2_with_sparse_attention_trains",
+    "test_training_dynamics.py::test_engine_pld_injected_into_gpt2",
+    "test_zero3_streaming.py::test_streaming_matches_baseline",
+    "test_zero3_streaming.py::test_streaming_with_tensor_parallel",
+    "test_zero3_streaming.py::test_zero3_bf16_streams_on_cpu",
+)
+
+
+def pytest_collection_modifyitems(config, items):
+    slow = pytest.mark.slow
+    for item in items:
+        rel = item.nodeid.rsplit("/", 1)[-1]  # "<file>.py::<test>[...]"
+        if rel.startswith(_SLOW_PREFIXES):
+            item.add_marker(slow)

@@ -1,0 +1,94 @@
+"""Worker for the 2-process jax.distributed TRAINING test (reference
+analog: tests/unit/common.py:16 distributed_test forks real workers for
+every training path, not just checkpointing).
+
+Each process owns 4 virtual CPU devices (global mesh = 8) and feeds ITS
+half of a fixed global batch via make_array_from_process_local_data; the
+test compares the loss trajectory and final global param norm against the
+same training run executed single-process on an 8-device mesh — the
+multi-process data/grad sharding must be numerically invisible.
+
+Usage: python distributed_train_worker.py <coord> <num_procs> <proc_id> <dir>
+"""
+
+import json
+import os
+import sys
+
+STEPS = 5
+
+
+def train_losses(engine, local_ids, steps=STEPS):
+    losses = []
+    for _ in range(steps):
+        loss = engine.forward(local_ids)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    return losses
+
+
+def global_param_norm(params):
+    import jax
+    import jax.numpy as jnp
+
+    total = 0.0
+    for leaf in jax.tree.leaves(params):
+        total += float(jnp.sum(jnp.asarray(leaf, jnp.float32) ** 2))
+    return float(total) ** 0.5
+
+
+def build(mesh_mod):
+    import jax
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT2Config, GPT2Model
+
+    mesh = ds.initialize_mesh(data=-1)
+    cfg = GPT2Config(vocab_size=64, n_positions=16, hidden_size=32,
+                     num_layers=2, num_heads=4, bf16=False, embd_dropout=0.0,
+                     attn_dropout=0.0, hidden_dropout=0.0)
+    model = GPT2Model(cfg)
+    conf = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = ds.initialize(
+        model=model, config=conf,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)),
+        mesh=mesh, rng=jax.random.PRNGKey(1))
+    return engine
+
+
+def main():
+    coord, nprocs, pid, workdir = (sys.argv[1], int(sys.argv[2]),
+                                   int(sys.argv[3]), sys.argv[4])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=4")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=nprocs, process_id=pid)
+    import numpy as np
+    import deepspeed_tpu as ds
+
+    engine = build(ds)
+    full = np.asarray(jax.random.randint(jax.random.PRNGKey(2), (8, 16),
+                                         0, 64), np.int32)
+    local = full[pid * 4:(pid + 1) * 4]  # engine._shard_batch uses
+    # make_array_from_process_local_data under jax.process_count() > 1
+    losses = train_losses(engine, local)
+    norm = global_param_norm(engine.params)
+
+    out = {"pid": pid, "losses": losses, "param_norm": norm}
+    with open(os.path.join(workdir, f"train_p{pid}.json"), "w") as f:
+        json.dump(out, f)
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("train_done")
+
+
+if __name__ == "__main__":
+    main()

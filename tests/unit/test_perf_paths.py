@@ -63,10 +63,14 @@ def test_flash_attention_impl_dispatch():
     q, k, v = (jax.random.normal(jax.random.fold_in(rng, i), (2, 2, 64, 16),
                                  jnp.float32) for i in range(3))
     ref = mha_reference(q, k, v, causal=True)
-    for impl in ("auto", "xla", "pallas"):  # pallas falls back to XLA on cpu
+    for impl in ("auto", "xla"):
         out = flash_attention(q, k, v, causal=True, impl=impl)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
+    # impl="pallas" is STRICT (advisor r2): no silent XLA fallback — on CPU
+    # (pallas unavailable) it must raise, never quietly measure XLA
+    with pytest.raises(ValueError, match="pallas"):
+        flash_attention(q, k, v, causal=True, impl="pallas")
     # tuned defaults: large blocks (grid overhead dominates small ones)
     assert DEFAULT_BLOCK_Q >= 512 and DEFAULT_BLOCK_K >= 512
 
@@ -84,8 +88,12 @@ def test_resolve_blocks_policy():
     usable, bq, bk = _resolve_blocks(1152, 1152, 512, 1024)
     assert usable and bq % 8 == 0 and bk % 128 == 0
     assert 1152 % bq == 0 and 1152 % bk == 0
-    # a short whole length is its own (single) block
-    assert _resolve_blocks(33, 33, 512, 1024) == (True, 33, 33)
+    # unaligned whole lengths are NOT usable (advisor r2: masked lane
+    # reductions on partial tiles are untestable off-TPU) -> XLA path
+    usable, bq, bk = _resolve_blocks(33, 33, 512, 1024)
+    assert usable is False and (bq, bk) == (33, 33)
+    usable, _, _ = _resolve_blocks(1000, 1000, 512, 1024)
+    assert usable is False
     # primes have no aligned tiling -> XLA path
     assert _resolve_blocks(1021, 1021, 512, 1024)[0] is False
     # explicit small blocks remain honored (kernel-parity tests rely on it)
