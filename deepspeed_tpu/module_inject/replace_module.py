@@ -95,8 +95,10 @@ def replace_transformer_layer(model, policy: Optional[type] = None,
         cfg = GPT2Config(
             vocab_size=wte.shape[0], n_positions=wpe.shape[0],
             hidden_size=h, num_layers=len(layers),
-            num_heads=getattr(cfg_src, "n_head",
-                              getattr(cfg_src, "num_heads", 12)),
+            num_heads=next(
+                (int(getattr(cfg_src, a)) for a in
+                 ("n_head", "num_heads", "num_attention_heads")
+                 if getattr(cfg_src, a, None) is not None), 12),
             intermediate_size=stacked["inter_w"].shape[-1],
             layer_norm_eps=getattr(cfg_src, "layer_norm_epsilon", 1e-5),
             embd_dropout=0.0, attn_dropout=0.0, hidden_dropout=0.0,

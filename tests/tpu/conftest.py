@@ -1,0 +1,30 @@
+"""Real-TPU kernel-parity lane (reference analog: test_cuda_forward.py:333 /
+test_cuda_backward.py:335 run fused kernels against reference numerics on
+real hardware at fp16/fp32 tolerances).
+
+Unlike tests/unit (which forces the 8-device CPU sim mesh), this lane runs
+on the DEFAULT backend and skips itself entirely when that backend is not a
+TPU.  Run it manually on the chip:
+
+    python -m pytest tests/tpu -q
+
+CAUTION (this harness): the tunnel admits ONE claim — never run this lane
+concurrently with bench.py or any profiler.
+"""
+
+import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    try:
+        import jax
+
+        backend = jax.default_backend()
+    except Exception as e:  # noqa: BLE001 — no backend at all
+        backend = f"unavailable ({e})"
+    if backend not in ("tpu", "axon"):
+        skip = pytest.mark.skip(
+            reason=f"TPU kernel-parity lane needs a real TPU backend "
+                   f"(default backend: {backend})")
+        for item in items:
+            item.add_marker(skip)

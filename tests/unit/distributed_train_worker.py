@@ -38,7 +38,7 @@ def global_param_norm(params):
     return float(total) ** 0.5
 
 
-def build(mesh_mod):
+def build():
     import jax
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import GPT2Config, GPT2Model
@@ -75,7 +75,7 @@ def main():
     import numpy as np
     import deepspeed_tpu as ds
 
-    engine = build(ds)
+    engine = build()
     full = np.asarray(jax.random.randint(jax.random.PRNGKey(2), (8, 16),
                                          0, 64), np.int32)
     local = full[pid * 4:(pid + 1) * 4]  # engine._shard_batch uses

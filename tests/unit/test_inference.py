@@ -325,15 +325,3 @@ def test_megatron_layer_policy_parity(dp_mesh, version):
         ref = hf(torch.tensor(ids)).logits.numpy()
     got = np.asarray(eng.forward(jnp.asarray(ids, jnp.int32)))
     np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
-
-
-def test_ds_ssh_local_fallback(tmp_path, capsys):
-    """ds_ssh (reference: bin/ds_ssh): no hostfile -> run locally; with a
-    hostfile it fans out over ssh/pdsh (not exercisable here)."""
-    from deepspeed_tpu.launcher.ds_ssh import build_parser, main
-
-    rc = main(["-H", str(tmp_path / "none"), "echo", "hello_ds_ssh"])
-    assert rc == 0
-    # parser surfaces the hostfile flag and trailing command
-    args = build_parser().parse_args(["-H", "hf", "uptime", "-a"])
-    assert args.hostfile == "hf" and args.command == ["uptime", "-a"]

@@ -299,3 +299,15 @@ def test_init_distributed_single_process(monkeypatch):
         monkeypatch.delenv(var, raising=False)
     dist_mod.init_distributed()  # no env: single-process no-op
     assert dist_mod._INITIALIZED
+
+
+def test_ds_ssh_local_fallback(tmp_path, capsys):
+    """ds_ssh (reference: bin/ds_ssh): no hostfile -> run locally; with a
+    hostfile it fans out over ssh/pdsh (not exercisable here)."""
+    from deepspeed_tpu.launcher.ds_ssh import build_parser, main
+
+    rc = main(["-H", str(tmp_path / "none"), "echo", "hello_ds_ssh"])
+    assert rc == 0
+    # parser surfaces the hostfile flag and trailing command
+    args = build_parser().parse_args(["-H", "hf", "uptime", "-a"])
+    assert args.hostfile == "hf" and args.command == ["uptime", "-a"]
