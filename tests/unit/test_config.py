@@ -162,3 +162,25 @@ def test_mesh_config():
     assert cfg.mesh_config.model == 2
     assert cfg.mesh_config.pipe == 2
     assert cfg.mesh_config.data == -1
+
+
+def test_add_config_arguments_roundtrip():
+    """CLI argument surface (reference: deepspeed/__init__.py:216 +
+    tests/unit/test_ds_arguments.py): add_config_arguments wires
+    --deepspeed/--deepspeed_config into an existing parser without
+    clobbering user args."""
+    import argparse
+
+    import deepspeed_tpu as ds
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--user_flag", type=int, default=3)
+    parser = ds.add_config_arguments(parser)
+    args = parser.parse_args(
+        ["--user_flag", "7", "--deepspeed", "--deepspeed_config", "c.json"])
+    assert args.user_flag == 7
+    assert args.deepspeed is True
+    assert args.deepspeed_config == "c.json"
+    # defaults: off
+    args2 = parser.parse_args([])
+    assert args2.deepspeed is False and args2.deepspeed_config is None
