@@ -14,3 +14,8 @@ for c in gpt2 bert_z2 moe decode longseq offload infinity; do
     timeout -k 30 1300 python bench.py --config "$c" \
     2>/dev/null | tail -1 | tee -a "$out"
 done
+# offload amortization row: grads cross d2h only at the gas boundary
+echo "== offload gas=8 $(date -u +%FT%TZ) ==" >&2
+DS_BENCH_GAS=8 DS_BENCH_WATCHDOG=1200 DS_BENCH_RUN_MARGIN=700 \
+  timeout -k 30 1300 python bench.py --config offload \
+  2>/dev/null | tail -1 | tee -a "$out"

@@ -12,10 +12,22 @@ CAUTION (this harness): the tunnel admits ONE claim — never run this lane
 concurrently with bench.py or any profiler.
 """
 
+import os
+
 import pytest
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
 
 
 def pytest_collection_modifyitems(config, items):
+    """Skip THIS DIRECTORY's tests off-TPU.  The hook receives every item
+    in the session (conftest hooks are not directory-scoped), so filter
+    by path — otherwise a `pytest tests/` run would skip the whole
+    suite."""
+    tpu_items = [i for i in items
+                 if str(getattr(i, "fspath", "")).startswith(_HERE + os.sep)]
+    if not tpu_items:
+        return
     try:
         import jax
 
@@ -26,5 +38,5 @@ def pytest_collection_modifyitems(config, items):
         skip = pytest.mark.skip(
             reason=f"TPU kernel-parity lane needs a real TPU backend "
                    f"(default backend: {backend})")
-        for item in items:
+        for item in tpu_items:
             item.add_marker(skip)

@@ -89,3 +89,21 @@ def test_sigterm_emits_one_diagnostic_json_line():
     assert payload["metric"] == "gpt2_124m_train_tokens_per_sec_1chip"
     assert payload["value"] == 0.0
     assert "signal" in payload["error"]
+
+
+def test_time_steps_gas_alignment(monkeypatch):
+    """DS_BENCH_ITERS overrides are re-rounded to the accumulation
+    boundary (align=gas), keeping whole optimizer steps in the window."""
+    calls = {"n": 0}
+
+    def step():
+        calls["n"] += 1
+        return 0.0
+
+    monkeypatch.setenv("DS_BENCH_ITERS", "12")
+    dt, _, n = bench._time_steps(step, warmup=1, iters=10, align=8)
+    assert n == 16 and calls["n"] == 17  # 12 rounded up to 2 full cycles
+    calls["n"] = 0
+    monkeypatch.delenv("DS_BENCH_ITERS")
+    dt, _, n = bench._time_steps(step, warmup=1, iters=10, align=3)
+    assert n == 12 and calls["n"] == 13
