@@ -39,6 +39,9 @@ class BertConfig:
     hidden_act: str = "gelu"         # HF BERT default: exact erf gelu
     initializer_range: float = 0.02
     bf16: bool = True
+    # attention kernel layout: "bhsd" (classic) or "bshd"
+    # (transpose-free; opt-in until Mosaic-measured)
+    attn_layout: str = "bhsd"
     pre_layer_norm: bool = True      # reference supports both (preln/postln)
     activation_checkpointing: bool = False
     sparse_attention: Optional[object] = None  # a SparsityConfig
@@ -78,6 +81,7 @@ class BertConfig:
             causal=False,
             activation=self.hidden_act,
             sparsity_config=self.sparse_attention,
+            attn_layout=self.attn_layout,
         )
 
     def num_params(self, include_embeddings: bool = True) -> int:

@@ -43,6 +43,9 @@ class GPT2Config:
     layer_norm_eps: float = 1e-5
     initializer_range: float = 0.02
     bf16: bool = True
+    # attention kernel layout: "bhsd" (classic) or "bshd"
+    # (transpose-free; opt-in until Mosaic-measured)
+    attn_layout: str = "bhsd"
     activation_checkpointing: bool = False
     sparse_attention: Optional[object] = None  # a SparsityConfig
     tie_word_embeddings: bool = True
@@ -83,6 +86,7 @@ class GPT2Config:
             pre_layer_norm=True,
             causal=True,
             sparsity_config=self.sparse_attention,
+            attn_layout=self.attn_layout,
         )
 
     def num_params(self, include_embeddings: bool = True) -> int:

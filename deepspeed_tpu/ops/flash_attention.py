@@ -67,6 +67,23 @@ def mha_reference(q, k, v, causal: bool = False,
 # --------------------------------------------------------------------------- #
 # Pallas kernel
 # --------------------------------------------------------------------------- #
+def _ld(ref):
+    """Load a [rows, d] tile from either layout's block:
+    (1, 1, rows, d) — the classic [B, H, S, D] path — or (1, rows, 1, d)
+    — the [B, S, heads, d] ("bsh") path that indexes the head dim in the
+    BlockSpec so callers never materialize a transpose."""
+    if ref.shape[1] == 1:
+        return ref[0, 0]
+    return ref[0, :, 0, :]
+
+
+def _st(ref, val):
+    if ref.shape[1] == 1:
+        ref[0, 0] = val
+    else:
+        ref[0, :, 0, :] = val
+
+
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
                causal: bool, sm_scale: float, block_q: int, block_k: int,
                num_k_blocks: int):
@@ -90,8 +107,8 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
     def _compute():
         # bf16 operands straight into the MXU; fp32 accumulation via
         # preferred_element_type (upcasting first would force an fp32 matmul).
-        q = q_ref[0, 0]                               # [bq, d]
-        k = k_ref[0, 0]                               # [bk, d]
+        q = _ld(q_ref)                               # [bq, d]
+        k = _ld(k_ref)                               # [bk, d]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # [bq, bk] fp32
@@ -114,7 +131,7 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         m_scr[...] = m_next
         l_scr[...] = jnp.broadcast_to(l_next[:, :1], l_scr.shape)
 
-        v_blk = v_ref[0, 0]                           # [bk, d]
+        v_blk = _ld(v_ref)                           # [bk, d]
         pv = jax.lax.dot_general(
             p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)       # [bq, d]
@@ -125,7 +142,7 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         l = l_scr[...][:, :1]
         # Fully-masked rows have l == 0; emit zeros not NaN.
         l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        _st(o_ref, (acc_scr[...] / l).astype(o_ref.dtype))
         # logsumexp residual for the backward pass (FlashAttention-2 style)
         lse = m_scr[...][:, :1] + jnp.log(l_scr[...][:, :1] + 1e-37)
         lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
@@ -164,18 +181,48 @@ def _resolve_blocks(q_len, k_len, block_q, block_k):
     return usable, bq, bk
 
 
+def _dims(arr, layout):
+    """(batch, heads, seq, d) for either layout."""
+    if layout == "bhsd":
+        b, h, s, d = arr.shape
+    else:  # "bshd": [B, S, heads, d] — head dim indexed in the BlockSpec
+        b, s, h, d = arr.shape
+    return b, h, s, d
+
+
+def _tile_spec(rows, d, layout, seq_of):
+    """BlockSpec for one [rows, d] tile per (b, h) grid cell; `seq_of`
+    picks which grid index walks the sequence dim ('i' or 'j')."""
+    if layout == "bhsd":
+        if seq_of == "i":
+            return pl.BlockSpec((1, 1, rows, d),
+                                lambda b, h, i, j: (b, h, i, 0))
+        return pl.BlockSpec((1, 1, rows, d), lambda b, h, i, j: (b, h, j, 0))
+    if seq_of == "i":
+        return pl.BlockSpec((1, rows, 1, d), lambda b, h, i, j: (b, i, h, 0))
+    return pl.BlockSpec((1, rows, 1, d), lambda b, h, i, j: (b, j, h, 0))
+
+
 def flash_attention_pallas(q, k, v, causal: bool = False,
                            sm_scale: Optional[float] = None,
                            block_q: int = 512, block_k: int = 1024,
-                           interpret: bool = False, return_lse: bool = False):
-    """Pallas flash attention. q,k,v: [B, H, S, D] -> [B, H, S, D]
-    (+ logsumexp [B, H, S] when return_lse)."""
+                           interpret: bool = False, return_lse: bool = False,
+                           layout: str = "bhsd"):
+    """Pallas flash attention.
+
+    layout="bhsd" (default): q,k,v [B, H, S, D] -> [B, H, S, D].
+    layout="bshd": q,k,v [B, S, heads, D] -> [B, S, heads, D] — the head
+    dim is indexed inside the BlockSpecs, so callers coming from a
+    [B, S, hidden] activation never materialize the [B,H,S,D] transpose
+    (a Pallas call otherwise forces it: custom calls take concrete
+    layouts, costing two full HBM passes per tensor per direction).
+    logsumexp (when return_lse) is [B, H, S] in BOTH layouts."""
     if pltpu is None:
         raise RuntimeError(
             "pallas TPU support unavailable in this jax install — use "
             "mha_reference / the public flash_attention dispatcher instead")
-    batch, heads, q_len, d = q.shape
-    k_len = k.shape[2]
+    batch, heads, q_len, d = _dims(q, layout)
+    k_len = _dims(k, layout)[2]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
     # fit to the lengths (largest aligned divisors <= requested blocks);
@@ -208,12 +255,12 @@ def flash_attention_pallas(q, k, v, causal: bool = False,
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0)),
+            _tile_spec(block_q, d, layout, "i"),
+            _tile_spec(block_k, d, layout, "j"),
+            _tile_spec(block_k, d, layout, "j"),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0)),
+            _tile_spec(block_q, d, layout, "i"),
             pl.BlockSpec((1, 1, block_q, _STATS_LANES),
                          lambda b, h, i, j: (b, h, i, 0)),
         ],
@@ -249,10 +296,10 @@ def _fa_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(should_compute)
     def _compute():
-        q = q_ref[0, 0]                               # [bq, d]
-        k = k_ref[0, 0]                               # [bk, d]
-        v = v_ref[0, 0]                               # [bk, d]
-        do = do_ref[0, 0]                             # [bq, d]
+        q = _ld(q_ref)                               # [bq, d]
+        k = _ld(k_ref)                               # [bk, d]
+        v = _ld(v_ref)                               # [bk, d]
+        do = _ld(do_ref)                             # [bq, d]
         lse = lse_ref[0, 0][:, :1]                    # [bq, 1]
         delta = delta_ref[0, 0][:, :1]                # [bq, 1]
 
@@ -281,8 +328,8 @@ def _fa_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(qi == num_q_blocks - 1)
     def _finalize():
-        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
-        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+        _st(dk_ref, dk_scr[...].astype(dk_ref.dtype))
+        _st(dv_ref, dv_scr[...].astype(dv_ref.dtype))
 
 
 def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -301,10 +348,10 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(should_compute)
     def _compute():
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
-        v = v_ref[0, 0]
-        do = do_ref[0, 0]
+        q = _ld(q_ref)
+        k = _ld(k_ref)
+        v = _ld(v_ref)
+        do = _ld(do_ref)
         lse = lse_ref[0, 0][:, :1]
         delta = delta_ref[0, 0][:, :1]
 
@@ -328,16 +375,18 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(ki == num_k_blocks - 1)
     def _finalize():
-        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+        _st(dq_ref, dq_scr[...].astype(dq_ref.dtype))
 
 
 def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
                                sm_scale: Optional[float] = None,
                                block_q: int = 512, block_k: int = 1024,
-                               interpret: bool = False):
-    """Block-wise dq, dk, dv — no [S, S] materialization in HBM."""
-    batch, heads, q_len, d = q.shape
-    k_len = k.shape[2]
+                               interpret: bool = False,
+                               layout: str = "bhsd"):
+    """Block-wise dq, dk, dv — no [S, S] materialization in HBM.  Inputs
+    and grads follow `layout` (lse is always [B, H, S])."""
+    batch, heads, q_len, d = _dims(q, layout)
+    k_len = _dims(k, layout)[2]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
     # fit to the lengths (largest aligned divisors <= requested blocks);
@@ -351,24 +400,25 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
             f"— use the flash_attention dispatcher (XLA fallback)")
     nq, nk = q_len // block_q, k_len // block_k
 
-    # delta_i = rowsum(dO_i * O_i)  (cheap elementwise; leave to XLA)
+    # delta_i = rowsum(dO_i * O_i)  (cheap elementwise; leave to XLA).
+    # The stats ride [B, H, S, lanes] in both layouts (tiny tensors).
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)                           # [B, H, S]
+                    axis=-1)
+    if layout == "bshd":
+        delta = delta.transpose(0, 2, 1)               # [B,S,H] -> [B,H,S]
     stats_shape = (*delta.shape, _STATS_LANES)
     delta = jnp.broadcast_to(delta[..., None], stats_shape)
     lse = jnp.broadcast_to(lse[..., None], stats_shape)
 
-    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda b, h, i, j: (b, h, i, 0))
-    k_spec = pl.BlockSpec((1, 1, block_k, d), lambda b, h, i, j: (b, h, j, 0))
-    r_spec = pl.BlockSpec((1, 1, block_q, _STATS_LANES),
-                          lambda b, h, i, j: (b, h, i, 0))
     params = {}
     if not interpret:
         params["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary"))
 
-    # dk/dv: grid over k blocks, inner loop over q blocks
+    # dk/dv: grid over k blocks (grid dim 2), inner loop over q blocks
+    # (grid dim 3) — _tile_spec's "i"/"j" name grid dims 2/3, so q/do tiles
+    # use "j" here
     dkdv_kernel = functools.partial(
         _fa_bwd_dkdv_kernel, causal=causal, sm_scale=float(sm_scale),
         block_q=block_q, block_k=block_k, num_q_blocks=nq)
@@ -376,18 +426,18 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
         dkdv_kernel,
         grid=(batch, heads, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, j, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, j, i: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, j, i: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, j, i: (b, h, i, 0)),
+            _tile_spec(block_q, d, layout, "j"),
+            _tile_spec(block_k, d, layout, "i"),
+            _tile_spec(block_k, d, layout, "i"),
+            _tile_spec(block_q, d, layout, "j"),
             pl.BlockSpec((1, 1, block_q, _STATS_LANES),
                          lambda b, h, j, i: (b, h, i, 0)),
             pl.BlockSpec((1, 1, block_q, _STATS_LANES),
                          lambda b, h, j, i: (b, h, i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, j, i: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, j, i: (b, h, j, 0)),
+            _tile_spec(block_k, d, layout, "i"),
+            _tile_spec(block_k, d, layout, "i"),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
@@ -402,14 +452,22 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
     )(q, k, v, do, lse, delta)
 
     # dq: grid over q blocks, inner loop over k blocks
+    r_spec = pl.BlockSpec((1, 1, block_q, _STATS_LANES),
+                          lambda b, h, i, j: (b, h, i, 0))
     dq_kernel = functools.partial(
         _fa_bwd_dq_kernel, causal=causal, sm_scale=float(sm_scale),
         block_q=block_q, block_k=block_k, num_k_blocks=nk)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(batch, heads, nq, nk),
-        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
-        out_specs=q_spec,
+        in_specs=[
+            _tile_spec(block_q, d, layout, "i"),
+            _tile_spec(block_k, d, layout, "j"),
+            _tile_spec(block_k, d, layout, "j"),
+            _tile_spec(block_q, d, layout, "i"),
+            r_spec, r_spec,
+        ],
+        out_specs=_tile_spec(block_q, d, layout, "i"),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
@@ -422,9 +480,9 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
 # --------------------------------------------------------------------------- #
 # Differentiable public entry point
 # --------------------------------------------------------------------------- #
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k):
-    return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, layout="bhsd"):
+    return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, layout)[0]
 
 
 def _use_pallas(q_len, k_len, d, block_q, block_k):
@@ -435,27 +493,42 @@ def _use_pallas(q_len, k_len, d, block_q, block_k):
     return usable
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
-    if _use_pallas(q.shape[2], k.shape[2], q.shape[3], block_q, block_k):
-        _, bq, bk = _resolve_blocks(q.shape[2], k.shape[2], block_q, block_k)
+def _t_bhsd(t):
+    """[B, S, heads, d] <-> [B, H, S, D] (its own inverse)."""
+    return t.transpose(0, 2, 1, 3)
+
+
+def _ref_in_layout(q, k, v, causal, sm_scale, layout):
+    """XLA fallback in the caller's layout."""
+    if layout == "bhsd":
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    return _t_bhsd(mha_reference(_t_bhsd(q), _t_bhsd(k), _t_bhsd(v),
+                                 causal=causal, sm_scale=sm_scale))
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, layout="bhsd"):
+    q_len, k_len = _dims(q, layout)[2], _dims(k, layout)[2]
+    if _use_pallas(q_len, k_len, q.shape[3], block_q, block_k):
+        _, bq, bk = _resolve_blocks(q_len, k_len, block_q, block_k)
         out, lse = flash_attention_pallas(
             q, k, v, causal=causal, sm_scale=sm_scale,
-            block_q=bq, block_k=bk, return_lse=True)
+            block_q=bq, block_k=bk, return_lse=True, layout=layout)
         return out, (q, k, v, out, lse)
-    out = mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    out = _ref_in_layout(q, k, v, causal, sm_scale, layout)
     return out, (q, k, v, None, None)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, res, g):
+def _flash_bwd(causal, sm_scale, block_q, block_k, layout, res, g):
     q, k, v, out, lse = res
     if lse is not None:
-        _, bq, bk = _resolve_blocks(q.shape[2], k.shape[2], block_q, block_k)
+        q_len, k_len = _dims(q, layout)[2], _dims(k, layout)[2]
+        _, bq, bk = _resolve_blocks(q_len, k_len, block_q, block_k)
         return flash_attention_bwd_pallas(
             q, k, v, out, lse, g, causal=causal, sm_scale=sm_scale,
-            block_q=bq, block_k=bk)
+            block_q=bq, block_k=bk, layout=layout)
     _, vjp = jax.vjp(
-        lambda q_, k_, v_: mha_reference(q_, k_, v_, causal=causal,
-                                         sm_scale=sm_scale), q, k, v)
+        lambda q_, k_, v_: _ref_in_layout(q_, k_, v_, causal, sm_scale,
+                                          layout), q, k, v)
     return vjp(g)
 
 
@@ -507,4 +580,38 @@ def flash_attention(q, k, v, causal: bool = False,
                              bias=bias)
     if impl == "xla":
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
-    return _flash(q, k, v, causal, sm_scale, block_q, block_k)
+    return _flash(q, k, v, causal, sm_scale, block_q, block_k, "bhsd")
+
+
+def flash_attention_bsh(q, k, v, causal: bool = False,
+                        sm_scale: Optional[float] = None, bias=None,
+                        block_q: int = DEFAULT_BLOCK_Q,
+                        block_k: int = DEFAULT_BLOCK_K,
+                        impl: str = "auto"):
+    """Fused attention over [B, S, heads, d] — the transpose-free path.
+
+    Callers holding [B, S, hidden] activations reshape (free) to
+    [B, S, heads, d] and never materialize the [B, H, S, D] layout: the
+    Pallas BlockSpecs index the head dim directly, which saves two full
+    HBM read+write passes per tensor per direction around the kernel
+    (the classic path's transposes are forced because a Pallas call
+    takes concrete layouts).  Semantics are identical to
+    flash_attention — including impl='pallas' strictness — with
+    bias/impl='xla'/unusable lengths falling back to the transposed XLA
+    reference."""
+    if impl == "pallas":
+        if bias is not None:
+            raise ValueError(
+                "impl='pallas': the Pallas kernel does not take an additive "
+                "bias — use impl='auto'/'xla'")
+        if not _use_pallas(q.shape[1], k.shape[1], q.shape[3],
+                           block_q, block_k):
+            raise ValueError(
+                f"impl='pallas': no aligned tiling for seq lengths "
+                f"({q.shape[1]},{k.shape[1]}) or Pallas unavailable on this "
+                "backend — use impl='auto' for the XLA fallback")
+    if bias is not None or impl == "xla":
+        return _t_bhsd(mha_reference(_t_bhsd(q), _t_bhsd(k), _t_bhsd(v),
+                                     causal=causal, sm_scale=sm_scale,
+                                     bias=bias))
+    return _flash(q, k, v, causal, sm_scale, block_q, block_k, "bshd")

@@ -160,3 +160,92 @@ def test_tp_partition_specs_cover_all_params():
     params = layer.init_params(jax.random.PRNGKey(0))
     specs = DeepSpeedTransformerLayer.param_partition_specs()
     assert set(specs) == set(params)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_bsh_layout_matches_reference(causal):
+    """The transpose-free [B, S, heads, d] layout (BlockSpecs index the
+    head dim) must be numerically identical to the classic [B, H, S, D]
+    path — forward and backward."""
+    from deepspeed_tpu.ops.flash_attention import flash_attention_bwd_pallas
+    q, k, v = _qkv(s=128)
+
+    def to_bsh(t):
+        return t.transpose(0, 2, 1, 3)  # [B,H,S,D] -> [B,S,H,D]
+
+    ref = mha_reference(q, k, v, causal=causal)
+    out = flash_attention_pallas(
+        to_bsh(q), to_bsh(k), to_bsh(v), causal=causal, block_q=64,
+        block_k=64, interpret=True, layout="bshd")
+    np.testing.assert_allclose(np.asarray(to_bsh(out)), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    do = jax.random.normal(jax.random.PRNGKey(7), q.shape, q.dtype)
+    out_b, lse = flash_attention_pallas(
+        to_bsh(q), to_bsh(k), to_bsh(v), causal=causal, block_q=64,
+        block_k=64, interpret=True, return_lse=True, layout="bshd")
+    dq, dk, dv = flash_attention_bwd_pallas(
+        to_bsh(q), to_bsh(k), to_bsh(v), out_b, lse, to_bsh(do),
+        causal=causal, block_q=64, block_k=64, interpret=True,
+        layout="bshd")
+
+    def ref_loss(q_, k_, v_):
+        r = mha_reference(q_, k_, v_, causal=causal).astype(jnp.float32)
+        return jnp.vdot(r, do.astype(jnp.float32))
+
+    rq, rk, rv = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(to_bsh(dq)), np.asarray(rq),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(to_bsh(dk)), np.asarray(rk),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(to_bsh(dv)), np.asarray(rv),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_flash_attention_bsh_public_fallback_and_grad():
+    """flash_attention_bsh on CPU (pallas unusable) falls back to the
+    transposed XLA reference and stays differentiable."""
+    from deepspeed_tpu.ops.flash_attention import flash_attention_bsh
+    q, k, v = _qkv(s=64)
+
+    def to_bsh(t):
+        return t.transpose(0, 2, 1, 3)
+
+    out = flash_attention_bsh(to_bsh(q), to_bsh(k), to_bsh(v), causal=True)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(to_bsh(out)), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    def loss(q_):
+        o = flash_attention_bsh(to_bsh(q_), to_bsh(k), to_bsh(v),
+                                causal=True)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def ref_l(q_):
+        return jnp.sum(mha_reference(q_, k, v,
+                                     causal=True).astype(jnp.float32) ** 2)
+
+    np.testing.assert_allclose(np.asarray(jax.grad(loss)(q)),
+                               np.asarray(jax.grad(ref_l)(q)),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_transformer_layer_bshd_layout_matches_bhsd():
+    """attn_layout='bshd' (transpose-free) must be numerically identical
+    to the classic layout at the LAYER level — both routes feed the same
+    reference math on CPU and the same kernel pair on TPU."""
+    from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
+                                               DeepSpeedTransformerLayer)
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 64, 32), jnp.float32)
+
+    outs = []
+    for layout in ("bhsd", "bshd"):
+        cfg = DeepSpeedTransformerConfig(
+            hidden_size=32, heads=4, attn_dropout_ratio=0.0,
+            hidden_dropout_ratio=0.0, bf16=False, causal=True,
+            attn_layout=layout)
+        layer = DeepSpeedTransformerLayer(cfg)
+        params = layer.init_params(jax.random.PRNGKey(1))
+        outs.append(np.asarray(layer(params, x, deterministic=True)))
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-5, atol=1e-5)
