@@ -11,11 +11,20 @@ signal death is exactly what wedged the round-2 bench (stale claim held
 the tunnel's single slot for hours).
 """
 
+import os
 import signal
 import sys
 import time
 
 import jax
+
+try:  # persistent compile cache: profilers re-run often; skip recompiles
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("DS_BENCH_COMPILE_CACHE", "/tmp/ds_jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+except Exception:  # noqa: BLE001 — older jax without the knobs
+    pass
 
 
 def _clean_exit(signum, frame):

@@ -30,6 +30,8 @@ fi
 
 if [ -z "${SKIP_PROFILES:-}" ]; then
   echo "== [$(stamp)] profiles (MFU push)" | tee -a "$OUT/session.log"
+  timeout -k 30 900 python benchmarks/profile_layout.py \
+    > "$OUT/layout_ab.log" 2>&1
   timeout -k 30 900 python benchmarks/profile_ce_sweep.py \
     > "$OUT/ce_sweep.log" 2>&1
   timeout -k 30 1200 python benchmarks/profile_ablations2.py \
