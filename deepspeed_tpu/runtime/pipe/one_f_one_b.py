@@ -210,17 +210,20 @@ def schedule_efficiency(tables: TickTables) -> dict:
       lane_slots         — T*S per lane (what the compiled program runs)
       useful_fwd/bwd     — M*S (what a perfectly gated program would run)
       lane_utilization   — useful / executed per lane = M/T exactly
-      aux_chain_ticks    — T*S executions of the embed + head chains vs the
-                           M*S a gated program would need
+      aux_chain_ticks    — T executions of the embed + head chains (they
+                           run ONCE per tick, not per stage lane — the
+                           tick body computes them outside the vmap) vs
+                           the M a gated program would need
 
     Measured utilization: (M=4,S=8) 21%, (M=8,S=4) 47%, (M=32,S=4) 60%,
     asymptote 2/3 as M→∞ — i.e. in the standard M >> S regime the masked
-    overhead costs ~1.5-1.6x the FLOPs of a perfectly gated 1F1B.  This is
-    a known cost of the branch-free SPMD design (every device executes the
-    same per-tick program); recovering it requires per-device divergent
-    control flow (lax.cond under shard_map on axis_index), which trades
-    compile simplicity and is future work — the memory bound (max
-    in-flight activations, test_one_f_one_b.py:113) is unaffected.
+    overhead costs ~1.5-1.6x the FLOPs of a perfectly gated 1F1B (the
+    aux chains carry the same T/M ≈ 1.5x factor, NOT an extra S×).  This
+    is a known cost of the branch-free SPMD design (every device executes
+    the same per-tick program); recovering it requires per-device
+    divergent control flow (lax.cond under shard_map on axis_index),
+    which trades compile simplicity and is future work — the memory bound
+    (max in-flight activations, test_one_f_one_b.py:113) is unaffected.
     """
     T, S, M = tables.num_ticks, tables.num_stages, tables.micro_batches
     useful_fwd = int(tables.fwd_active.sum())
@@ -231,8 +234,8 @@ def schedule_efficiency(tables: TickTables) -> dict:
         "useful_fwd": useful_fwd,
         "useful_bwd": useful_bwd,
         "lane_utilization": (useful_fwd + useful_bwd) / (2.0 * T * S),
-        "aux_chain_ticks": T * S,
-        "aux_chain_useful": M * S,
+        "aux_chain_ticks": T,
+        "aux_chain_useful": M,
     }
 
 
