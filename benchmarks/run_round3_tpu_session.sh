@@ -5,9 +5,10 @@
 #
 #   SKIP_LADDER=1 SKIP_TPUTESTS=1 SKIP_CAP=1 SKIP_PROFILES=1
 #
-# Order: cheap proof first (kernel parity), then the ladder (the round
-# contract), then profiling for the MFU push, then the long infinity
-# capability run last (it monopolizes the tunnel for ~20-40 min).
+# Order: the LADDER first (the round-contract numbers — in case the
+# tunnel dies again), then kernel parity, then profiling for the MFU
+# push, then the long infinity capability run last (it monopolizes the
+# tunnel for ~20-40 min).
 set -u
 cd "$(dirname "$0")/.."
 OUT=benchmarks/session_r3
@@ -15,17 +16,17 @@ mkdir -p "$OUT"
 
 stamp() { date -u +%FT%TZ; }
 
+if [ -z "${SKIP_LADDER:-}" ]; then
+  echo "== [$(stamp)] bench ladder" | tee -a "$OUT/session.log"
+  bash benchmarks/run_ladder.sh 2> "$OUT/ladder.stderr"
+  python benchmarks/render_results.py | tee -a "$OUT/session.log"
+fi
+
 if [ -z "${SKIP_TPUTESTS:-}" ]; then
   echo "== [$(stamp)] tests/tpu kernel-parity lane" | tee -a "$OUT/session.log"
   timeout -k 30 1800 python -m pytest tests/tpu -q \
     > "$OUT/tpu_tests.log" 2>&1
   tail -2 "$OUT/tpu_tests.log" | tee -a "$OUT/session.log"
-fi
-
-if [ -z "${SKIP_LADDER:-}" ]; then
-  echo "== [$(stamp)] bench ladder" | tee -a "$OUT/session.log"
-  bash benchmarks/run_ladder.sh 2> "$OUT/ladder.stderr"
-  python benchmarks/render_results.py | tee -a "$OUT/session.log"
 fi
 
 if [ -z "${SKIP_PROFILES:-}" ]; then
