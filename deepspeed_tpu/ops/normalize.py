@@ -12,6 +12,7 @@ import functools
 from typing import Optional
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
@@ -42,6 +43,16 @@ def _ln_kernel(x_ref, g_ref, b_ref, o_ref, *, eps):
                   b_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
 
 
+def _pick_block_rows(rows: int, block_rows: int) -> int:
+    """Largest divisor of rows <= block_rows — keeps each block VMEM-sized
+    (never one giant block).  Shared by the forward and backward kernels
+    so their block policies cannot diverge."""
+    block_rows = min(block_rows, rows)
+    while rows % block_rows:
+        block_rows -= 1
+    return block_rows
+
+
 def layer_norm_pallas(x, gamma, beta, eps: float = 1e-5,
                       block_rows: int = 256, interpret: bool = False):
     """Pallas LN over the last dim of a 2-D [rows, hidden] view."""
@@ -49,9 +60,7 @@ def layer_norm_pallas(x, gamma, beta, eps: float = 1e-5,
     hidden = orig_shape[-1]
     x2 = x.reshape(-1, hidden)
     rows = x2.shape[0]
-    block_rows = min(block_rows, rows)
-    while rows % block_rows:  # largest divisor of rows <= block_rows keeps
-        block_rows -= 1       # each block VMEM-sized (never one giant block)
+    block_rows = _pick_block_rows(rows, block_rows)
     kernel = functools.partial(_ln_kernel, eps=eps)
     out = pl.pallas_call(
         kernel,
@@ -66,6 +75,71 @@ def layer_norm_pallas(x, gamma, beta, eps: float = 1e-5,
         interpret=interpret,
     )(x2, gamma, beta)
     return out.reshape(orig_shape)
+
+
+def _ln_bwd_kernel(x_ref, g_ref, dy_ref, dx_ref, dgp_ref, dbp_ref, *, eps):
+    """One-pass LN backward per row block (the normalize_kernels.cu
+    backward's role): recompute the fp32 statistics, produce dx and this
+    block's PARTIAL dgamma/dbeta row sums (finalized by a tiny XLA sum
+    over blocks)."""
+    x = x_ref[...].astype(jnp.float32)                 # [rows, hidden]
+    dy = dy_ref[...].astype(jnp.float32)
+    gamma = g_ref[...].astype(jnp.float32)             # [hidden]
+    n = x.shape[-1]
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mean
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = xc * rstd
+    dyg = dy * gamma
+    m1 = jnp.sum(dyg, axis=-1, keepdims=True) / n
+    m2 = jnp.sum(dyg * xhat, axis=-1, keepdims=True) / n
+    dx = (dyg - m1 - xhat * m2) * rstd
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+    dgp_ref[...] = jnp.sum(dy * xhat, axis=0, keepdims=True)
+    dbp_ref[...] = jnp.sum(dy, axis=0, keepdims=True)
+
+
+def layer_norm_bwd_pallas(x, gamma, dy, eps: float = 1e-5,
+                          block_rows: int = 256, interpret: bool = False):
+    """Pallas LN backward over the last dim: returns (dx, dgamma, dbeta)
+    with fp32 gamma/beta grads (their accumulation dtype)."""
+    orig_shape = x.shape
+    hidden = orig_shape[-1]
+    x2 = x.reshape(-1, hidden)
+    dy2 = dy.reshape(-1, hidden)
+    rows = x2.shape[0]
+    block_rows = _pick_block_rows(rows, block_rows)
+    nb = rows // block_rows
+    if block_rows < 8:
+        # awkward row counts (no divisor <= target) would degrade to a
+        # per-row grid with x-sized fp32 partial buffers — the XLA vjp is
+        # strictly better there
+        raise ValueError(
+            f"layer_norm_bwd_pallas: rows={rows} has no usable block "
+            "tiling — use the XLA backward")
+    kernel = functools.partial(_ln_bwd_kernel, eps=eps)
+    dx, dgp, dbp = pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((block_rows, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((hidden,), lambda i: (0,)),
+            pl.BlockSpec((block_rows, hidden), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_rows, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((1, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((1, hidden), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(x2.shape, x.dtype),
+            jax.ShapeDtypeStruct((nb, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((nb, hidden), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2, gamma, dy2)
+    return (dx.reshape(orig_shape), dgp.sum(axis=0), dbp.sum(axis=0))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -84,6 +158,12 @@ def _fused_ln_fwd(x, gamma, beta, eps):
 
 def _fused_ln_bwd(eps, res, g):
     x, gamma, beta = res
+    from .dispatch import pallas_available
+    rows = int(np.prod(x.shape[:-1])) if x.ndim > 1 else 1
+    if pallas_available() and _pick_block_rows(rows, 256) >= 8:
+        dx, dgamma, dbeta = layer_norm_bwd_pallas(x, gamma, g, eps)
+        return (dx, dgamma.astype(jnp.asarray(gamma).dtype),
+                dbeta.astype(jnp.asarray(beta).dtype))
     _, vjp = jax.vjp(
         lambda x_, g_, b_: layer_norm_reference(x_, g_, b_, eps),
         x, gamma, beta)
