@@ -8,5 +8,7 @@ standalone.
 
 from .gpt2 import GPT2Config, GPT2Model
 from .bert import BertConfig, BertModel
+from .gpt_moe import GPTMoEConfig, GPTMoEModel
 
-__all__ = ["GPT2Config", "GPT2Model", "BertConfig", "BertModel"]
+__all__ = ["GPT2Config", "GPT2Model", "BertConfig", "BertModel",
+           "GPTMoEConfig", "GPTMoEModel"]

@@ -65,6 +65,12 @@ class DeepSpeedTransformerConfig:
     # through SparseSelfAttention (the reference wires this via
     # bert_sparse_self_attention.py:78; here it's one config field)
     sparsity_config: Optional[object] = None
+    # "dense" (default) = the fused inter/output FFN; "none" = attention
+    # sublayer only (no FFN params) — the GShard/Megatron-MoE pattern
+    # replaces the FFN of alternating layers with an expert layer
+    # (reference: moe/layer.py MoE wraps the FFN position), so the MoE
+    # model composes [attention-only layer] + [gated expert FFN block]
+    ffn: str = "dense"
 
     @property
     def gelu_approximate(self) -> bool:
@@ -79,6 +85,10 @@ class DeepSpeedTransformerConfig:
     def __post_init__(self):
         if self.intermediate_size == -1 and self.hidden_size != -1:
             self.intermediate_size = 4 * self.hidden_size
+        if self.ffn not in ("dense", "none"):
+            raise ValueError(
+                f"ffn={self.ffn!r}: must be 'dense' or 'none' "
+                "(init/forward/specs all key on it)")
 
     @property
     def dtype(self):
@@ -112,41 +122,52 @@ class DeepSpeedTransformerLayer:
         std = cfg.initializer_range
         keys = jax.random.split(rng, 4)
         init = jax.nn.initializers.normal(std)
-        return {
+        params = {
             "attn_qkvw": init(keys[0], (h, 3 * h), jnp.float32),
             "attn_qkvb": jnp.zeros((3 * h,), jnp.float32),
             "attn_ow": init(keys[1], (h, h), jnp.float32),
             "attn_ob": jnp.zeros((h,), jnp.float32),
-            "attn_nw": jnp.ones((h,), jnp.float32),
-            "attn_nb": jnp.zeros((h,), jnp.float32),
-            "inter_w": init(keys[2], (h, inter), jnp.float32),
-            "inter_b": jnp.zeros((inter,), jnp.float32),
-            "output_w": init(keys[3], (inter, h), jnp.float32),
-            "output_b": jnp.zeros((h,), jnp.float32),
             "norm_w": jnp.ones((h,), jnp.float32),
             "norm_b": jnp.zeros((h,), jnp.float32),
         }
+        if cfg.ffn == "dense":
+            params.update({
+                "attn_nw": jnp.ones((h,), jnp.float32),
+                "attn_nb": jnp.zeros((h,), jnp.float32),
+                "inter_w": init(keys[2], (h, inter), jnp.float32),
+                "inter_b": jnp.zeros((inter,), jnp.float32),
+                "output_w": init(keys[3], (inter, h), jnp.float32),
+                "output_b": jnp.zeros((h,), jnp.float32),
+            })
+        return params
 
     @staticmethod
-    def param_partition_specs():
+    def param_partition_specs(ffn: str = "dense"):
         """Megatron-style TP: qkv/inter column-split, out/output row-split
         over the "model" axis (the role the external Megatron mpu plays in
         the reference — engine.py:739-770)."""
-        return {
+        specs = {
             "attn_qkvw": P(None, MODEL_AXIS),
             "attn_qkvb": P(MODEL_AXIS),
             "attn_ow": P(MODEL_AXIS, None),
             "attn_ob": P(),
-            "attn_nw": P(), "attn_nb": P(),
-            "inter_w": P(None, MODEL_AXIS),
-            "inter_b": P(MODEL_AXIS),
-            "output_w": P(MODEL_AXIS, None),
-            "output_b": P(),
             "norm_w": P(), "norm_b": P(),
         }
+        if ffn == "dense":
+            specs.update({
+                "attn_nw": P(), "attn_nb": P(),
+                "inter_w": P(None, MODEL_AXIS),
+                "inter_b": P(MODEL_AXIS),
+                "output_w": P(MODEL_AXIS, None),
+                "output_b": P(),
+            })
+        return specs
 
     def num_params(self):
         h, i = self.config.hidden_size, self.config.intermediate_size
+        if self.config.ffn != "dense":
+            # qkvw+ow (4h^2) + qkvb+ob (4h) + pre-attn LN (2h)
+            return 4 * h * h + 6 * h
         return 4 * h * h + 2 * h * i + 9 * h + i
 
     # -- forward ------------------------------------------------------- #
@@ -223,6 +244,13 @@ class DeepSpeedTransformerLayer:
         attn_out = bias_dropout_residual(
             attn_out, params["attn_ob"].astype(attn_out.dtype), residual,
             cfg.hidden_dropout_ratio, r_hid1, deterministic)
+
+        if cfg.ffn == "none":
+            # attention sublayer only — the caller owns the FFN position
+            # (MoE expert block); pre-LN residual form required
+            if not cfg.pre_layer_norm:
+                raise ValueError("ffn='none' requires pre_layer_norm")
+            return attn_out
 
         if cfg.pre_layer_norm:
             mlp_in = fused_layer_norm(attn_out, params["attn_nw"],

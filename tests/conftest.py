@@ -51,6 +51,8 @@ _SLOW_PREFIXES = (
     "test_engine_couplings.py::test_sparse_gradients_matches_dense",
     "test_fused_cross_entropy.py::test_gpt2_fused_loss_matches_naive",
     "test_functionality_matrix.py::test_matrix_matches_baseline",
+    "test_gpt_moe.py::test_engine_training_converges",
+    "test_gpt_moe.py::test_expert_params_sharded_over_expert_axis",
     "test_inference.py::test_generate_matches_full_recompute",
     "test_inference.py::test_hf_checkpoint_loader_path_greedy_decode_parity",
     "test_inference.py::test_hf_gpt2_injection_parity",
