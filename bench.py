@@ -19,7 +19,7 @@ backend init is retried with backoff; ANY failure still emits a single
 diagnostic JSON line instead of a bare traceback.
 
 Ladder: `python bench.py --config
-{gpt2|bert_z2|decode|moe|longseq|offload|infinity}` selects other
+{gpt2|bert_z2|decode|moe|gpt_moe|longseq|offload|infinity}` selects other
 BASELINE.md anchor points; default is the flagship gpt2.
 DS_BENCH_ITERS overrides the timing iteration count (CI smoke).
 """
@@ -386,6 +386,52 @@ def bench_moe():
     }
 
 
+def bench_gpt_moe():
+    """GPT-MoE model family: GPT-2-small backbone with 8-expert top-2
+    FFNs on alternating layers (~350M params, ~124M active/token) on one
+    chip — the Megatron-MoE/GShard interleave as a first-class model
+    (models/gpt_moe.py), complementing the single-layer `moe` row."""
+    import jax
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPTMoEConfig, GPTMoEModel
+
+    batch, seq = 8, 1024
+    mesh = ds.initialize_mesh(data=-1)
+    cfg = GPTMoEConfig(n_positions=seq, bf16=True, num_experts=8, top_k=2,
+                       moe_every=2)
+    model = GPTMoEModel(cfg)
+    engine, _, _, _ = ds.initialize(
+        model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)),
+        config={"train_micro_batch_size_per_gpu": batch,
+                "optimizer": {"type": "AdamW",
+                              "params": {"lr": 6e-4, "weight_decay": 0.1}},
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 2},
+                "steps_per_print": 10 ** 9},
+        mesh=mesh)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32)
+
+    def step():
+        loss = engine.forward(ids)
+        engine.backward(loss)
+        engine.step()
+        return loss
+
+    dt, final_loss, n = _time_steps(step, warmup=2, iters=10)
+    tokens_per_sec = n * batch * seq / dt
+    return {
+        "metric": "gpt_moe_8e_top2_train_tokens_per_sec_1chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,  # no single-chip MoE-model anchor in BASELINE
+        "num_experts": 8, "top_k": 2,
+        "total_params": cfg.num_params(),
+        "final_loss": round(final_loss, 4),
+    }
+
+
 def bench_longseq():
     """GPT-2 124M at S=8192, batch 2 — EXACT causal attention at 8x the
     reference's practical sequence length on one chip, enabled by the O(S)
@@ -562,6 +608,7 @@ def bench_infinity():
 
 BENCHES = {"gpt2": bench_gpt2, "bert_z2": bench_bert_z2,
            "decode": bench_decode, "moe": bench_moe,
+           "gpt_moe": bench_gpt_moe,
            "longseq": bench_longseq, "offload": bench_offload,
            "infinity": bench_infinity}
 METRIC_NAMES = {  # error-path metric must match the success-path name
@@ -569,6 +616,8 @@ METRIC_NAMES = {  # error-path metric must match the success-path name
     "bert_z2": ("bert_large_z2_samples_per_sec_1chip", "samples/s"),
     "decode": ("gpt2_124m_decode_tokens_per_sec_1chip", "tokens/s"),
     "moe": ("moe_top2_train_tokens_per_sec_1chip", "tokens/s"),
+    "gpt_moe": ("gpt_moe_8e_top2_train_tokens_per_sec_1chip",
+                "tokens/s"),
     "longseq": ("gpt2_124m_seq8192_train_tokens_per_sec_1chip",
                 "tokens/s"),
     "offload": ("gpt2_124m_offload_cpu_adam_tokens_per_sec_1chip",

@@ -8,7 +8,7 @@
 # 30 s later as a last resort.
 cd "$(dirname "$0")/.."
 out=benchmarks/ladder_results.jsonl
-for c in gpt2 bert_z2 moe decode longseq offload infinity; do
+for c in gpt2 bert_z2 moe gpt_moe decode longseq offload infinity; do
   echo "== $c $(date -u +%FT%TZ) ==" >&2
   DS_BENCH_WATCHDOG=1200 DS_BENCH_RUN_MARGIN=700 \
     timeout -k 30 1300 python bench.py --config "$c" \
