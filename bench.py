@@ -388,8 +388,8 @@ def bench_moe():
 
 def bench_gpt_moe():
     """GPT-MoE model family: GPT-2-small backbone with 8-expert top-2
-    FFNs on alternating layers (~350M params, ~124M active/token) on one
-    chip — the Megatron-MoE/GShard interleave as a first-class model
+    FFNs on alternating layers (~323M params, ~153M active/token at
+    top-2) on one chip — the Megatron-MoE/GShard interleave as a first-class model
     (models/gpt_moe.py), complementing the single-layer `moe` row."""
     import jax
     import deepspeed_tpu as ds
