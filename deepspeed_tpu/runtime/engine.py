@@ -1100,6 +1100,12 @@ class DeepSpeedEngine:
             "curriculum": (self.curriculum_scheduler.state_dict()
                            if self.curriculum_scheduler is not None
                            else None),
+            # engine PRNG stream position: resuming restores dropout/gate
+            # noise bit-exactly (the torch reference loses RNG streams on
+            # resume; saving 8 ints is strictly better)
+            "engine_rng": np.asarray(
+                jax.random.key_data(self._rng)).tolist(),
+            "engine_rng_impl": str(jax.random.key_impl(self._rng)),
         })
         if self._sharded_checkpoints():
             # per-process shard files keyed by global slice (reference:
@@ -1182,8 +1188,17 @@ class DeepSpeedEngine:
                     "curriculum"):
                 self.curriculum_scheduler.load_state_dict(
                     client["curriculum"])
-        load_path = os.path.join(load_dir, str(
-            tag or ckpt_mod.read_latest_tag(load_dir)))
+            if client.get("engine_rng") is not None:
+                # restore the PRNG stream position for bit-exact resume of
+                # dropout/gate-noise trajectories
+                try:
+                    self._rng = jax.random.wrap_key_data(
+                        jnp.asarray(np.asarray(client["engine_rng"],
+                                               np.uint32)),
+                        impl=client.get("engine_rng_impl", "threefry2x32"))
+                except Exception as e:  # noqa: BLE001 — old/foreign ckpt
+                    log_dist(f"engine_rng restore skipped: {e}", ranks=[0])
+        load_path = os.path.join(load_dir, str(resolved_tag))
         log_dist(f"loaded checkpoint {load_path}", ranks=[0])
         return load_path, client
 
