@@ -453,7 +453,12 @@ class ZeroInfinityEngine:
         client = dict(client_state or {})
         client.update({"global_steps": self.global_steps,
                        "micro_steps": self.micro_steps,
-                       "skipped_steps": self.skipped_steps})
+                       "skipped_steps": self.skipped_steps,
+                       # bit-exact dropout resume (same as DeepSpeedEngine)
+                       "engine_rng": np.asarray(
+                           jax.random.key_data(self._rng)).tolist(),
+                       "engine_rng_impl": str(
+                           jax.random.key_impl(self._rng))})
         return ckpt_mod.save_checkpoint_state(
             save_dir, tag, module_state={"module": self.module_state_dict()},
             optimizer_state={"optimizer": self._opt.state_dict()},
@@ -480,4 +485,12 @@ class ZeroInfinityEngine:
         self.global_steps = client.get("global_steps", 0)
         self.micro_steps = client.get("micro_steps", 0)
         self.skipped_steps = client.get("skipped_steps", 0)
+        if client.get("engine_rng") is not None:
+            try:
+                self._rng = jax.random.wrap_key_data(
+                    jnp.asarray(np.asarray(client["engine_rng"],
+                                           np.uint32)),
+                    impl=client.get("engine_rng_impl", "threefry2x32"))
+            except Exception as e:  # noqa: BLE001 — old/foreign ckpt
+                log_dist(f"engine_rng restore skipped: {e}", ranks=[0])
         return load_dir, client
