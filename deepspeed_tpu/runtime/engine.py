@@ -267,8 +267,10 @@ class DeepSpeedEngine:
         # bit-reproducible default dropout across upgrades or CPU-vs-TPU
         # set prng_impl="threefry".  Callers passing their own `rng` keep
         # whatever impl they chose.
+        prng_impl = {"threefry": "threefry2x32"}.get(
+            self.config.prng_impl, self.config.prng_impl)
         self._rng = (rng if rng is not None
-                     else jax.random.key(42, impl=self.config.prng_impl))
+                     else jax.random.key(42, impl=prng_impl))
 
         # ---- training-dynamics subsystems ---------------------------- #
         # PLD (reference engine.py:1236,1487), curriculum seqlen

