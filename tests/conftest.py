@@ -52,6 +52,8 @@ _SLOW_PREFIXES = (
     "test_fused_cross_entropy.py::test_gpt2_fused_loss_matches_naive",
     "test_functionality_matrix.py::test_matrix_matches_baseline",
     "test_gpt_moe.py::test_engine_training_converges",
+    "test_gpt_moe.py::test_engine_training_tp_times_ep",
+    "test_gpt_moe.py::test_engine_training_zero3",
     "test_gpt_moe.py::test_expert_params_sharded_over_expert_axis",
     "test_inference.py::test_generate_matches_full_recompute",
     "test_inference.py::test_hf_checkpoint_loader_path_greedy_decode_parity",
