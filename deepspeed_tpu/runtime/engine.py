@@ -16,8 +16,10 @@ the engine owns
                    stage2.py:781 is the compiler's job here),
       _acc_fn    — gradient accumulation add (micro-batching),
       _apply_fn  — unscale → overflow check → optax update → loss-scale
-                   update, all under lax.cond so an overflow skips the step
-                   on-device exactly like stage2.py:1783-1850.
+                   update; the overflow skip is per-leaf selects (not
+                   lax.cond) so donated buffers alias in place while an
+                   overflow still skips the step on-device exactly like
+                   stage2.py:1783-1850.
 The user-facing forward/backward/step protocol is preserved: forward runs the
 compiled grad step and caches grads; backward accumulates; step applies at
 gradient-accumulation boundaries.
@@ -31,7 +33,6 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-import optax
 from jax import lax
 
 # The ZeRO apply step donates the grad tree purely as scratch (no output
@@ -57,7 +58,7 @@ from ..utils.logging import log_dist, logger
 from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 from . import checkpoint as ckpt_mod
 from .dataloader import DeepSpeedDataLoader
-from .fp16.loss_scaler import (LossScaleState, create_loss_scaler,
+from .fp16.loss_scaler import (create_loss_scaler,
                                update_loss_scale)
 from .lr_schedules import get_lr_schedule
 from .optimizers import build_optimizer

@@ -13,7 +13,6 @@ bf16 operands.
 
 from typing import Any, List, Tuple
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
