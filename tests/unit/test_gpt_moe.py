@@ -208,3 +208,25 @@ def test_engine_training_tp_times_ep():
         assert losses[-1] < losses[0]
     finally:
         ds.reset_mesh_context()
+
+
+def test_fp16_consolidated_export(ep_mesh, tmp_path):
+    """save_fp16_model flattens the heterogeneous per-layer tuple tree
+    (expert-sharded leaves gathered) into one serving .npz."""
+    cfg = _cfg(num_layers=2)
+    model = GPTMoEModel(cfg)
+    engine, _, _, _ = ds.initialize(
+        model=model,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)),
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2},
+                "steps_per_print": 10 ** 9})
+    path = engine.save_fp16_model(str(tmp_path))
+    data = np.load(path)
+    n = sum(int(np.prod(v.shape)) for v in data.values())
+    assert n == cfg.num_params()
+    # an expert leaf made it out whole (unsharded) in fp16
+    expert_keys = [k for k in data.files if "moe" in k and "wi" in k]
+    assert expert_keys and data[expert_keys[0]].dtype == np.float16
+    assert data[expert_keys[0]].shape[0] == cfg.num_experts
