@@ -153,12 +153,15 @@ def test_engine_trains_with_sequence_parallel_attention():
             losses.append(float(loss))
         return losses
 
-    sp_losses = run(
-        lambda q, k, v: sequence_parallel_attention(q, k, v, mode="ring",
-                                                    causal=True),
-        dict(data=4, seq=2))
     dense_losses = run(
         lambda q, k, v: mha_reference(q, k, v, causal=True),
         dict(data=4, seq=2))
-    assert sp_losses[-1] < sp_losses[0]
-    np.testing.assert_allclose(sp_losses, dense_losses, rtol=2e-4, atol=2e-5)
+    for mode in ("ring", "ulysses"):
+        sp_losses = run(
+            lambda q, k, v, m=mode: sequence_parallel_attention(
+                q, k, v, mode=m, causal=True),
+            dict(data=4, seq=2))
+        assert sp_losses[-1] < sp_losses[0], mode
+        # both SP modes are exact — trajectories match dense attention
+        np.testing.assert_allclose(sp_losses, dense_losses, rtol=2e-4,
+                                   atol=2e-5, err_msg=mode)
