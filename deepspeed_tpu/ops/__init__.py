@@ -6,13 +6,16 @@ everything XLA already fuses well (bias+gelu, bias+dropout+residual, Adam
 elementwise math) is expressed as plain jnp and left to the compiler.
 """
 
-from .flash_attention import flash_attention, mha_reference
+from .flash_attention import (flash_attention,
+                              flash_attention_bsh,
+                              mha_reference)
 from .normalize import fused_layer_norm, layer_norm_reference
 from .activations import bias_gelu, bias_dropout_residual, gelu
 from .transformer import (DeepSpeedTransformerConfig, DeepSpeedTransformerLayer)
 
 __all__ = [
-    "flash_attention", "mha_reference", "fused_layer_norm",
+    "flash_attention", "flash_attention_bsh", "mha_reference",
+    "fused_layer_norm",
     "layer_norm_reference", "bias_gelu", "bias_dropout_residual", "gelu",
     "DeepSpeedTransformerConfig", "DeepSpeedTransformerLayer",
 ]

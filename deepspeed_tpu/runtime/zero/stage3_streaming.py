@@ -25,8 +25,10 @@ over the ZeRO ("data","expert") axes:
     trace-based lookahead, without needing a trace (the scan order IS the
     trace);
   - the backward of a tiled all-gather over the ZeRO axes is a
-    psum-scatter: layer gradients leave the region already reduce-scattered
-    to their owner shard (stage3.py:1908 grad partitioning, for free).
+    psum-scatter — run in fp32 regardless of compute dtype
+    (_all_gather_f32grad): layer gradients leave the region already
+    reduce-scattered to their owner shard with fp32 accumulation
+    (stage3.py:1908 grad partitioning, tightened).
 
 Tensor-parallel ("model") and any other non-ZeRO axes stay *automatic*
 (GSPMD) inside the region — explicit ZeRO streaming composes with
