@@ -197,7 +197,11 @@ def bench_gpt2():
     from deepspeed_tpu.models import GPT2Config, GPT2Model
 
     batch, seq = 8, 1024
-    cfg = GPT2Config(n_positions=seq, bf16=True)  # GPT-2 124M
+    # DS_BENCH_ATTN_LAYOUT=bshd A/Bs the transpose-free kernel layout
+    # without a code change (default stays the Mosaic-proven bhsd)
+    cfg = GPT2Config(n_positions=seq, bf16=True,  # GPT-2 124M
+                     attn_layout=os.environ.get("DS_BENCH_ATTN_LAYOUT",
+                                                "bhsd"))
     model = GPT2Model(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
 
