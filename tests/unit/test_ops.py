@@ -323,3 +323,38 @@ def test_fused_ln_bwd_dispatch_via_pallas(monkeypatch):
                                atol=2e-1)
     np.testing.assert_allclose(np.asarray(gb), np.asarray(rb), rtol=2e-2,
                                atol=2e-1)
+
+
+def test_transformer_layer_bshd_under_tensor_parallel():
+    """attn_layout='bshd' with Megatron-split qkv over the model axis:
+    the head dim the BlockSpecs index is the SHARDED dim under TP, so
+    parity with the bhsd path on a model=2 mesh de-risks the layout flip
+    for TP configs."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
+                                               DeepSpeedTransformerLayer)
+    from jax.sharding import NamedSharding
+
+    ds.reset_mesh_context()
+    ctx = ds.initialize_mesh(data=-1, model=2)
+    try:
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 64, 32),
+                              jnp.float32)
+        outs = []
+        for layout in ("bhsd", "bshd"):
+            cfg = DeepSpeedTransformerConfig(
+                hidden_size=32, heads=4, attn_dropout_ratio=0.0,
+                hidden_dropout_ratio=0.0, bf16=False, causal=True,
+                attn_layout=layout)
+            layer = DeepSpeedTransformerLayer(cfg)
+            params = layer.init_params(jax.random.PRNGKey(1))
+            specs = DeepSpeedTransformerLayer.param_partition_specs()
+            sharded = {
+                k: jax.device_put(v, NamedSharding(ctx.mesh, specs[k]))
+                for k, v in params.items()}
+            out = jax.jit(lambda p, xx: layer(p, xx, deterministic=True))(
+                sharded, x)
+            outs.append(np.asarray(out))
+        np.testing.assert_allclose(outs[0], outs[1], rtol=1e-5, atol=1e-5)
+    finally:
+        ds.reset_mesh_context()
