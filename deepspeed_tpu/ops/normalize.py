@@ -47,10 +47,22 @@ def _pick_block_rows(rows: int, block_rows: int) -> int:
     """Largest divisor of rows <= block_rows — keeps each block VMEM-sized
     (never one giant block).  Shared by the forward and backward kernels
     so their block policies cannot diverge."""
+    if rows <= 0:
+        return 0
     block_rows = min(block_rows, rows)
     while rows % block_rows:
         block_rows -= 1
     return block_rows
+
+
+def _ln_tiling_ok(rows: int, hidden: int, block_rows: int) -> bool:
+    """Mosaic requires the last two block dims divisible by (8, 128) or
+    equal to the respective array dims; reject shapes that would fail
+    lowering so the dispatcher can fall back to the XLA vjp instead of
+    erroring.  Every block here spans the full hidden dim (== array dim,
+    always legal), so only the row tiling needs checking."""
+    del hidden
+    return rows > 0 and (block_rows % 8 == 0 or block_rows == rows)
 
 
 def layer_norm_pallas(x, gamma, beta, eps: float = 1e-5,
@@ -61,6 +73,10 @@ def layer_norm_pallas(x, gamma, beta, eps: float = 1e-5,
     x2 = x.reshape(-1, hidden)
     rows = x2.shape[0]
     block_rows = _pick_block_rows(rows, block_rows)
+    if not _ln_tiling_ok(rows, hidden, block_rows):
+        raise ValueError(
+            f"layer_norm_pallas: rows={rows}, hidden={hidden} has no "
+            "usable block tiling — use layer_norm_reference")
     kernel = functools.partial(_ln_kernel, eps=eps)
     out = pl.pallas_call(
         kernel,
@@ -77,11 +93,13 @@ def layer_norm_pallas(x, gamma, beta, eps: float = 1e-5,
     return out.reshape(orig_shape)
 
 
-def _ln_bwd_kernel(x_ref, g_ref, dy_ref, dx_ref, dgp_ref, dbp_ref, *, eps):
+def _ln_bwd_kernel(x_ref, g_ref, dy_ref, dx_ref, dg_ref, db_ref, *, eps):
     """One-pass LN backward per row block (the normalize_kernels.cu
-    backward's role): recompute the fp32 statistics, produce dx and this
-    block's PARTIAL dgamma/dbeta row sums (finalized by a tiny XLA sum
-    over blocks)."""
+    backward's role): recompute the fp32 statistics, produce dx, and
+    accumulate dgamma/dbeta row sums across the sequential TPU grid into
+    a single [1, hidden] block (block == array dims, which satisfies the
+    Mosaic tiling rule that a (1, hidden) window over an (nb, hidden)
+    array does not)."""
     x = x_ref[...].astype(jnp.float32)                 # [rows, hidden]
     dy = dy_ref[...].astype(jnp.float32)
     gamma = g_ref[...].astype(jnp.float32)             # [hidden]
@@ -96,8 +114,14 @@ def _ln_bwd_kernel(x_ref, g_ref, dy_ref, dx_ref, dgp_ref, dbp_ref, *, eps):
     m2 = jnp.sum(dyg * xhat, axis=-1, keepdims=True) / n
     dx = (dyg - m1 - xhat * m2) * rstd
     dx_ref[...] = dx.astype(dx_ref.dtype)
-    dgp_ref[...] = jnp.sum(dy * xhat, axis=0, keepdims=True)
-    dbp_ref[...] = jnp.sum(dy, axis=0, keepdims=True)
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dg_ref[...] = jnp.zeros_like(dg_ref)
+        db_ref[...] = jnp.zeros_like(db_ref)
+
+    dg_ref[...] += jnp.sum(dy * xhat, axis=0, keepdims=True)
+    db_ref[...] += jnp.sum(dy, axis=0, keepdims=True)
 
 
 def layer_norm_bwd_pallas(x, gamma, dy, eps: float = 1e-5,
@@ -110,16 +134,15 @@ def layer_norm_bwd_pallas(x, gamma, dy, eps: float = 1e-5,
     dy2 = dy.reshape(-1, hidden)
     rows = x2.shape[0]
     block_rows = _pick_block_rows(rows, block_rows)
-    nb = rows // block_rows
-    if block_rows < 8:
-        # awkward row counts (no divisor <= target) would degrade to a
-        # per-row grid with x-sized fp32 partial buffers — the XLA vjp is
+    if not _ln_tiling_ok(rows, hidden, block_rows):
+        # awkward row counts would fail Mosaic lowering — the XLA vjp is
         # strictly better there
         raise ValueError(
-            f"layer_norm_bwd_pallas: rows={rows} has no usable block "
-            "tiling — use the XLA backward")
+            f"layer_norm_bwd_pallas: rows={rows}, hidden={hidden} has no "
+            "usable block tiling — use the XLA backward")
+    nb = rows // block_rows
     kernel = functools.partial(_ln_bwd_kernel, eps=eps)
-    dx, dgp, dbp = pl.pallas_call(
+    dx, dg, db = pl.pallas_call(
         kernel,
         grid=(nb,),
         in_specs=[
@@ -129,17 +152,17 @@ def layer_norm_bwd_pallas(x, gamma, dy, eps: float = 1e-5,
         ],
         out_specs=[
             pl.BlockSpec((block_rows, hidden), lambda i: (i, 0)),
-            pl.BlockSpec((1, hidden), lambda i: (i, 0)),
-            pl.BlockSpec((1, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((1, hidden), lambda i: (0, 0)),
+            pl.BlockSpec((1, hidden), lambda i: (0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(x2.shape, x.dtype),
-            jax.ShapeDtypeStruct((nb, hidden), jnp.float32),
-            jax.ShapeDtypeStruct((nb, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((1, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((1, hidden), jnp.float32),
         ],
         interpret=interpret,
     )(x2, gamma, dy2)
-    return (dx.reshape(orig_shape), dgp.sum(axis=0), dbp.sum(axis=0))
+    return (dx.reshape(orig_shape), dg[0], db[0])
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -147,9 +170,17 @@ def _fused_ln(x, gamma, beta, eps):
     return _fused_ln_fwd(x, gamma, beta, eps)[0]
 
 
-def _fused_ln_fwd(x, gamma, beta, eps):
+def _fused_ln_usable(x) -> bool:
     from .dispatch import pallas_available
-    if pallas_available():
+    if not pallas_available():
+        return False
+    rows = int(np.prod(x.shape[:-1])) if x.ndim > 1 else 1
+    hidden = x.shape[-1]
+    return _ln_tiling_ok(rows, hidden, _pick_block_rows(rows, 256))
+
+
+def _fused_ln_fwd(x, gamma, beta, eps):
+    if _fused_ln_usable(x):
         out = layer_norm_pallas(x, gamma, beta, eps)
     else:
         out = layer_norm_reference(x, gamma, beta, eps)
@@ -158,9 +189,7 @@ def _fused_ln_fwd(x, gamma, beta, eps):
 
 def _fused_ln_bwd(eps, res, g):
     x, gamma, beta = res
-    from .dispatch import pallas_available
-    rows = int(np.prod(x.shape[:-1])) if x.ndim > 1 else 1
-    if pallas_available() and _pick_block_rows(rows, 256) >= 8:
+    if _fused_ln_usable(x):
         dx, dgamma, dbeta = layer_norm_bwd_pallas(x, gamma, g, eps)
         return (dx, dgamma.astype(jnp.asarray(gamma).dtype),
                 dbeta.astype(jnp.asarray(beta).dtype))
