@@ -28,17 +28,17 @@ from ..flash_attention import DEFAULT_MASK_VALUE
 from .sparsity_config import SparsityConfig
 
 
-def layout_to_gather_indices(layout: np.ndarray
-                             ) -> Tuple[np.ndarray, np.ndarray]:
-    """[H, nb, nb] bool -> (idx [H, nb, max_deg] int32, valid bool).
-
-    idx[h, i, j] is the j-th allowed k-block of q-block i (padded with 0
-    where valid is False)."""
+def _gather_core(layout: np.ndarray, pad_last_valid: bool,
+                 allow_empty_rows: bool) -> Tuple[np.ndarray, np.ndarray]:
+    """Shared gather-index builder: [H, nb, nb] bool ->
+    (idx [H, nb, max_deg] int32, valid bool).  pad_last_valid repeats the
+    row's last allowed block into the padding (so a sequential consumer
+    revisits the same block and elides the DMA); otherwise padding is 0."""
     h, nb, _ = layout.shape
     degrees = layout.sum(-1)
-    if (degrees == 0).any():
+    if not allow_empty_rows and (degrees == 0).any():
         raise ValueError("layout has a query block with no allowed k-blocks")
-    max_deg = int(degrees.max())
+    max_deg = max(int(degrees.max()), 1)
     idx = np.zeros((h, nb, max_deg), np.int32)
     valid = np.zeros((h, nb, max_deg), bool)
     for hh in range(h):
@@ -46,7 +46,18 @@ def layout_to_gather_indices(layout: np.ndarray
             cols = np.nonzero(layout[hh, i])[0]
             idx[hh, i, :len(cols)] = cols
             valid[hh, i, :len(cols)] = True
+            if pad_last_valid and len(cols):
+                idx[hh, i, len(cols):] = cols[-1]
     return idx, valid
+
+
+def layout_to_gather_indices(layout: np.ndarray
+                             ) -> Tuple[np.ndarray, np.ndarray]:
+    """[H, nb, nb] bool -> (idx [H, nb, max_deg] int32, valid bool).
+
+    idx[h, i, j] is the j-th allowed k-block of q-block i (padded with 0
+    where valid is False)."""
+    return _gather_core(layout, pad_last_valid=False, allow_empty_rows=False)
 
 
 @functools.partial(jax.jit, static_argnames=("block", "causal", "sm_scale"))
@@ -94,35 +105,70 @@ def _sparse_attention_impl(q, k, v, idx, valid, block: int,
 class SparseSelfAttention:
     """Layout-driven attention module (reference:
     sparse_self_attention.py:14).  Layout/gather indices are cached per
-    sequence length."""
+    sequence length.
+
+    Two execution paths, dispatched per call:
+    - the Pallas block-sparse flash kernel (block_sparse_flash.py) when the
+      layout block is lane-aligned and Pallas is available — streaming
+      softmax, no score materialization;
+    - the gather-einsum path (_sparse_attention_impl) elsewhere (CPU, odd
+      block sizes) — same O(S·deg·block) compute, but scores materialize.
+    """
 
     def __init__(self, sparsity_config: SparsityConfig,
-                 attn_mask_mode: str = "add"):
+                 attn_mask_mode: str = "add", impl: str = "auto"):
+        if impl not in ("auto", "pallas", "gather"):
+            raise ValueError(f"impl={impl!r} not in auto|pallas|gather")
         self.sparsity_config = sparsity_config
         self.attn_mask_mode = attn_mask_mode
+        self.impl = impl
         self._cache = {}
 
     def layout_for(self, seq_len: int):
         if seq_len not in self._cache:
+            from .block_sparse_flash import layout_gather
             layout = self.sparsity_config.make_layout(seq_len)
             idx, valid = layout_to_gather_indices(layout)
+            fidx, fvalid = layout_gather(layout)
+            tidx, tvalid = layout_gather(layout, transpose=True)
             self._cache[seq_len] = (layout, jnp.asarray(idx),
-                                    jnp.asarray(valid))
+                                    jnp.asarray(valid),
+                                    tuple(jnp.asarray(a) for a in
+                                          (fidx, fvalid, tidx, tvalid)))
         return self._cache[seq_len]
 
     def density(self, seq_len: int) -> float:
-        layout, _, _ = self.layout_for(seq_len)
+        layout = self.layout_for(seq_len)[0]
         return float(layout.mean())
+
+    def _use_pallas(self) -> bool:
+        if self.impl == "gather":
+            return False
+        from ..dispatch import pallas_available
+        from .block_sparse_flash import sparse_tiling_ok
+        ok = pallas_available() and sparse_tiling_ok(
+            self.sparsity_config.block)
+        if self.impl == "pallas" and not ok:
+            raise ValueError(
+                f"impl='pallas': block={self.sparsity_config.block} not "
+                "lane-aligned or Pallas unavailable on this backend")
+        return ok
 
     def __call__(self, q, k, v, causal: bool = False,
                  sm_scale: Optional[float] = None):
         """q, k, v: [B, H, S, D] -> [B, H, S, D]."""
         s = q.shape[2]
         block = self.sparsity_config.block
-        _, idx, valid = self.layout_for(s)
+        _, idx, valid, flash_idx = self.layout_for(s)
         if q.shape[1] != self.sparsity_config.num_heads:
             raise ValueError(
                 f"q has {q.shape[1]} heads, layout built for "
                 f"{self.sparsity_config.num_heads}")
+        if self._use_pallas():
+            from .block_sparse_flash import block_sparse_flash_attention
+            fidx, fvalid, tidx, tvalid = flash_idx
+            return block_sparse_flash_attention(
+                q, k, v, fidx, fvalid, tidx, tvalid, block, causal=causal,
+                sm_scale=sm_scale)
         return _sparse_attention_impl(q, k, v, idx, valid, block, causal,
                                       sm_scale)

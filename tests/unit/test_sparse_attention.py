@@ -52,7 +52,7 @@ ALL_CONFIGS = [
 def test_sparse_matches_dense_masked(cfg):
     q, k, v = _qkv()
     attn = SparseSelfAttention(cfg)
-    layout, _, _ = attn.layout_for(S)
+    layout = attn.layout_for(S)[0]
     causal = getattr(cfg, "attention", "bidirectional") == "unidirectional"
     out = attn(q, k, v, causal=causal)
     ref = _dense_with_layout_mask(q, k, v, layout, BLOCK, causal)
@@ -143,3 +143,95 @@ def test_rejects_bad_seq_len():
     cfg = FixedSparsityConfig(num_heads=1, block=16)
     with pytest.raises(ValueError, match="divisible"):
         cfg.make_layout(100)
+
+
+# --------------------------------------------------------------------------- #
+# Pallas block-sparse flash kernel (interpret mode) vs the gather impl
+# --------------------------------------------------------------------------- #
+FLASH_CONFIGS = [
+    FixedSparsityConfig(num_heads=H, block=BLOCK, num_local_blocks=4,
+                        num_global_blocks=1),
+    BigBirdSparsityConfig(num_heads=H, block=BLOCK, num_random_blocks=1,
+                          num_sliding_window_blocks=3, num_global_blocks=1),
+    BSLongformerSparsityConfig(num_heads=H, block=BLOCK,
+                               num_sliding_window_blocks=3,
+                               global_block_indices=[0]),
+]
+
+
+@pytest.mark.parametrize("cfg", FLASH_CONFIGS, ids=lambda c: type(c).__name__)
+@pytest.mark.parametrize("causal", [False, True])
+def test_block_sparse_flash_matches_dense_masked(cfg, causal):
+    from deepspeed_tpu.ops.sparse_attention.block_sparse_flash import (
+        block_sparse_flash_attention, layout_gather)
+    q, k, v = _qkv()
+    layout = cfg.make_layout(S)
+    fidx, fvalid = layout_gather(layout)
+    tidx, tvalid = layout_gather(layout, transpose=True)
+    out = block_sparse_flash_attention(q, k, v, fidx, fvalid, tidx, tvalid,
+                                       cfg.block, causal=causal,
+                                       interpret=True)
+    ref = _dense_with_layout_mask(q, k, v, layout, cfg.block, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_block_sparse_flash_grads_match_gather_impl(causal):
+    from deepspeed_tpu.ops.sparse_attention.block_sparse_flash import (
+        block_sparse_flash_attention, layout_gather)
+    cfg = FixedSparsityConfig(num_heads=H, block=BLOCK, num_local_blocks=4,
+                              num_global_blocks=1)
+    q, k, v = _qkv(seed=3)
+    layout = cfg.make_layout(S)
+    fidx, fvalid = layout_gather(layout)
+    tidx, tvalid = layout_gather(layout, transpose=True)
+
+    def loss_flash(q, k, v):
+        o = block_sparse_flash_attention(q, k, v, fidx, fvalid, tidx, tvalid,
+                                         cfg.block, causal=causal,
+                                         interpret=True)
+        return jnp.sum(o * o)
+
+    def loss_ref(q, k, v):
+        o = _dense_with_layout_mask(q, k, v, layout, cfg.block, causal)
+        return jnp.sum(o * o)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_layout_gather_pads_with_last_valid():
+    from deepspeed_tpu.ops.sparse_attention.block_sparse_flash import (
+        layout_gather)
+    layout = np.zeros((1, 4, 4), bool)
+    layout[0, 0, [0, 2]] = True
+    layout[0, 1, 1] = True
+    layout[0, 2, :] = True
+    layout[0, 3, 3] = True
+    idx, valid = layout_gather(layout)
+    assert idx.shape == (1, 4, 4)
+    assert list(idx[0, 0]) == [0, 2, 2, 2]       # padded with last valid
+    assert list(valid[0, 0]) == [1, 1, 0, 0]
+    assert list(idx[0, 1]) == [1, 1, 1, 1]
+    # transpose direction: who attends k-block 3? rows 2 and 3
+    tidx, tvalid = layout_gather(layout, transpose=True)
+    assert list(tidx[0, 3][: int(tvalid[0, 3].sum())]) == [2, 3]
+
+
+def test_sparse_self_attention_impl_dispatch():
+    """impl='pallas' must raise when the block is not lane-aligned (16 on
+    this CPU run) instead of silently running the gather path."""
+    cfg = FixedSparsityConfig(num_heads=H, block=BLOCK, num_local_blocks=4,
+                              num_global_blocks=1)
+    attn = SparseSelfAttention(cfg, impl="pallas")
+    q, k, v = _qkv()
+    with pytest.raises(ValueError, match="pallas"):
+        attn(q, k, v)
+    # gather impl always works
+    attn2 = SparseSelfAttention(cfg, impl="gather")
+    out = attn2(q, k, v)
+    assert out.shape == q.shape
