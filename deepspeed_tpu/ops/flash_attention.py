@@ -44,11 +44,17 @@ _STATS_LANES = 8
 # Reference implementation (also the backward path and the CPU fallback)
 # --------------------------------------------------------------------------- #
 def mha_reference(q, k, v, causal: bool = False,
-                  sm_scale: Optional[float] = None, bias=None):
+                  sm_scale: Optional[float] = None, bias=None,
+                  dropout_rate: float = 0.0, dropout_seed=None):
     """Plain-XLA multi-head attention: q,k,v [B, H, S, D] -> [B, H, S, D].
 
     fp32 softmax regardless of input dtype (matches the reference kernels,
-    which upcast for the softmax — softmax_kernels.cu attn_softmax)."""
+    which upcast for the softmax — softmax_kernels.cu attn_softmax).
+    dropout_rate > 0 applies PROBABILITY dropout (on the normalized
+    softmax, the reference's attn-dropout semantics —
+    dropout_kernels.cu:868) keyed by the int32 dropout_seed; the mask
+    stream differs from the Pallas kernel's in-kernel PRNG, so the two
+    paths agree in distribution, not bit-for-bit."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
@@ -60,8 +66,15 @@ def mha_reference(q, k, v, causal: bool = False,
         idx_q = jax.lax.broadcasted_iota(jnp.int32, (q_len, k_len), 0)
         idx_k = jax.lax.broadcasted_iota(jnp.int32, (q_len, k_len), 1)
         s = jnp.where(idx_k > idx_q, DEFAULT_MASK_VALUE, s)
-    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    p = jax.nn.softmax(s, axis=-1)
+    if dropout_rate > 0.0:
+        if dropout_seed is None:
+            raise ValueError("dropout_rate > 0 requires dropout_seed")
+        keep = jax.random.bernoulli(
+            jax.random.PRNGKey(jnp.asarray(dropout_seed, jnp.int32)),
+            1.0 - dropout_rate, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
 # --------------------------------------------------------------------------- #
@@ -84,9 +97,35 @@ def _st(ref, val):
         ref[0, :, 0, :] = val
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
+def causal_keep_mask(qi_block, ki_block, block_q, block_k):
+    """[block_q, block_k] keep mask (col <= row) from ABSOLUTE block
+    indices — the one causal-tile mask shared by the dense fwd/bwd kernels
+    and the block-sparse kernels (block_sparse_flash.py)."""
+    row = qi_block * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    col = ki_block * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return col <= row
+
+
+def _dropout_keep(seed_ref, b, h, qi, ki, rate, block_q, block_k):
+    """Regenerable per-tile keep mask: the PRNG is reseeded from the step
+    seed and the tile's ABSOLUTE coordinates, so the forward kernel and
+    both backward kernels (whose grids order (qi, ki) differently)
+    reproduce the identical mask — the TPU analog of the reference's
+    philox-offset dropout (dropout_kernels.cu:868)."""
+    pltpu.prng_seed(seed_ref[0], b, h, qi, ki)
+    bits = pltpu.prng_random_bits((block_q, block_k))
+    threshold = np.uint32(min(int((1.0 - rate) * 2 ** 32), 2 ** 32 - 1))
+    return bits.astype(jnp.uint32) < threshold
+
+
+def _fa_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+               m_scr, l_scr, acc_scr, *,
                causal: bool, sm_scale: float, block_q: int, block_k: int,
-               num_k_blocks: int):
+               num_k_blocks: int, dropout_rate: float):
+    b = pl.program_id(0)
+    h = pl.program_id(1)
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -114,11 +153,8 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
             preferred_element_type=jnp.float32) * sm_scale  # [bq, bk] fp32
 
         if causal:
-            row = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            col = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(col > row, DEFAULT_MASK_VALUE, s)
+            s = jnp.where(causal_keep_mask(qi, ki, block_q, block_k),
+                          s, DEFAULT_MASK_VALUE)
 
         m_prev = m_scr[...]                           # [bq, LANES]
         l_prev = l_scr[...]
@@ -130,6 +166,15 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         l_next = l_corr + jnp.sum(p, axis=-1, keepdims=True)
         m_scr[...] = m_next
         l_scr[...] = jnp.broadcast_to(l_next[:, :1], l_scr.shape)
+
+        if dropout_rate > 0.0:
+            # probability dropout: the PV input is masked+rescaled but the
+            # normalizer l accumulates the RAW p (softmax normalizes true
+            # probabilities; dropout applies to the normalized P, which
+            # commutes with the final /l)
+            keep = _dropout_keep(seed_ref, b, h, qi, ki, dropout_rate,
+                                 block_q, block_k)
+            p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
 
         v_blk = _ld(v_ref)                           # [bk, d]
         pv = jax.lax.dot_general(
@@ -146,6 +191,13 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         # logsumexp residual for the backward pass (FlashAttention-2 style)
         lse = m_scr[...][:, :1] + jnp.log(l_scr[...][:, :1] + 1e-37)
         lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
+
+
+def _seed_arg(dropout_seed):
+    """int32[1] scalar-prefetch operand (0 when dropout is off)."""
+    if dropout_seed is None:
+        return jnp.zeros((1,), jnp.int32)
+    return jnp.asarray(dropout_seed, jnp.int32).reshape((1,))
 
 
 def _fit_block(length: int, target: int, align: int) -> int:
@@ -192,22 +244,28 @@ def _dims(arr, layout):
 
 def _tile_spec(rows, d, layout, seq_of):
     """BlockSpec for one [rows, d] tile per (b, h) grid cell; `seq_of`
-    picks which grid index walks the sequence dim ('i' or 'j')."""
+    picks which grid index walks the sequence dim ('i' or 'j').  The
+    trailing *_ absorbs the scalar-prefetch ref (the dropout seed) that
+    PrefetchScalarGridSpec appends to every index_map."""
     if layout == "bhsd":
         if seq_of == "i":
             return pl.BlockSpec((1, 1, rows, d),
-                                lambda b, h, i, j: (b, h, i, 0))
-        return pl.BlockSpec((1, 1, rows, d), lambda b, h, i, j: (b, h, j, 0))
+                                lambda b, h, i, j, *_: (b, h, i, 0))
+        return pl.BlockSpec((1, 1, rows, d),
+                            lambda b, h, i, j, *_: (b, h, j, 0))
     if seq_of == "i":
-        return pl.BlockSpec((1, rows, 1, d), lambda b, h, i, j: (b, i, h, 0))
-    return pl.BlockSpec((1, rows, 1, d), lambda b, h, i, j: (b, j, h, 0))
+        return pl.BlockSpec((1, rows, 1, d),
+                            lambda b, h, i, j, *_: (b, i, h, 0))
+    return pl.BlockSpec((1, rows, 1, d),
+                        lambda b, h, i, j, *_: (b, j, h, 0))
 
 
 def flash_attention_pallas(q, k, v, causal: bool = False,
                            sm_scale: Optional[float] = None,
                            block_q: int = 512, block_k: int = 1024,
                            interpret: bool = False, return_lse: bool = False,
-                           layout: str = "bhsd"):
+                           layout: str = "bhsd", dropout_rate: float = 0.0,
+                           dropout_seed=None):
     """Pallas flash attention.
 
     layout="bhsd" (default): q,k,v [B, H, S, D] -> [B, H, S, D].
@@ -235,12 +293,17 @@ def flash_attention_pallas(q, k, v, causal: bool = False,
             f"seq lengths ({q_len},{k_len}) only tile into 1-wide blocks "
             f"— use the flash_attention dispatcher (XLA fallback)")
     nq, nk = q_len // block_q, k_len // block_k
+    if dropout_rate > 0.0 and interpret:
+        raise ValueError(
+            "in-kernel dropout needs the TPU PRNG (pltpu.prng_seed has no "
+            "CPU lowering) — interpret-mode callers must use rate 0")
+    seed = _seed_arg(dropout_seed)
 
     kernel = functools.partial(
         _fa_kernel, causal=causal, sm_scale=float(sm_scale),
-        block_q=block_q, block_k=block_k, num_k_blocks=nk)
+        block_q=block_q, block_k=block_k, num_k_blocks=nk,
+        dropout_rate=float(dropout_rate))
 
-    grid = (batch, heads, nq, nk)
     scratch = [
         pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
         pltpu.VMEM((block_q, _LANES), jnp.float32),  # running sum
@@ -253,35 +316,40 @@ def flash_attention_pallas(q, k, v, causal: bool = False,
                                  "arbitrary"))
     out, lse = pl.pallas_call(
         kernel,
-        grid=grid,
-        in_specs=[
-            _tile_spec(block_q, d, layout, "i"),
-            _tile_spec(block_k, d, layout, "j"),
-            _tile_spec(block_k, d, layout, "j"),
-        ],
-        out_specs=[
-            _tile_spec(block_q, d, layout, "i"),
-            pl.BlockSpec((1, 1, block_q, _STATS_LANES),
-                         lambda b, h, i, j: (b, h, i, 0)),
-        ],
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(batch, heads, nq, nk),
+            in_specs=[
+                _tile_spec(block_q, d, layout, "i"),
+                _tile_spec(block_k, d, layout, "j"),
+                _tile_spec(block_k, d, layout, "j"),
+            ],
+            out_specs=[
+                _tile_spec(block_q, d, layout, "i"),
+                pl.BlockSpec((1, 1, block_q, _STATS_LANES),
+                             lambda b, h, i, j, *_: (b, h, i, 0)),
+            ],
+            scratch_shapes=scratch),
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
             jax.ShapeDtypeStruct((batch, heads, q_len, _STATS_LANES),
                                  jnp.float32),
         ],
-        scratch_shapes=scratch,
         interpret=interpret,
         **params,
-    )(q, k, v)
+    )(seed, q, k, v)
     return (out, lse[..., 0]) if return_lse else out
 
 
 # --------------------------------------------------------------------------- #
 # Pallas backward kernels (FlashAttention-2 style)
 # --------------------------------------------------------------------------- #
-def _fa_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                        dk_ref, dv_ref, dk_scr, dv_scr, *,
-                        causal, sm_scale, block_q, block_k, num_q_blocks):
+def _fa_bwd_dkdv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                        delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                        causal, sm_scale, block_q, block_k, num_q_blocks,
+                        dropout_rate):
+    b = pl.program_id(0)
+    h = pl.program_id(1)
     ki = pl.program_id(2)
     qi = pl.program_id(3)
 
@@ -308,18 +376,24 @@ def _fa_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32) * sm_scale   # [bq, bk]
         p = jnp.exp(s - lse)                          # [bq, bk] fp32
         if causal:
-            row = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            col = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            p = jnp.where(col > row, 0.0, p)
+            p = jnp.where(causal_keep_mask(qi, ki, block_q, block_k),
+                          p, 0.0)
 
-        pt = p.astype(do.dtype)
-        dv_scr[...] += jax.lax.dot_general(            # p^T @ do -> [bk, d]
-            pt, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(                      # do @ v^T -> [bq, bk]
             do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            # same (qi, ki) seeding as the forward — identical mask.
+            # dV sees the DROPPED probabilities; dS = P*(D.dp - delta)
+            keep = _dropout_keep(seed_ref, b, h, qi, ki, dropout_rate,
+                                 block_q, block_k)
+            p_drop = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
+        else:
+            p_drop = p
+
+        dv_scr[...] += jax.lax.dot_general(            # p^T @ do -> [bk, d]
+            p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale               # [bq, bk] fp32
         dk_scr[...] += jax.lax.dot_general(            # ds^T @ q -> [bk, d]
@@ -332,9 +406,12 @@ def _fa_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         _st(dv_ref, dv_scr[...].astype(dv_ref.dtype))
 
 
-def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dq_ref, dq_scr, *,
-                      causal, sm_scale, block_q, block_k, num_k_blocks):
+def _fa_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                      delta_ref, dq_ref, dq_scr, *,
+                      causal, sm_scale, block_q, block_k, num_k_blocks,
+                      dropout_rate):
+    b = pl.program_id(0)
+    h = pl.program_id(1)
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -360,14 +437,15 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32) * sm_scale
         p = jnp.exp(s - lse)
         if causal:
-            row = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            col = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            p = jnp.where(col > row, 0.0, p)
+            p = jnp.where(causal_keep_mask(qi, ki, block_q, block_k),
+                          p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(seed_ref, b, h, qi, ki, dropout_rate,
+                                 block_q, block_k)
+            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
         ds = p * (dp - delta) * sm_scale
         dq_scr[...] += jax.lax.dot_general(            # ds @ k -> [bq, d]
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
@@ -382,7 +460,9 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
                                sm_scale: Optional[float] = None,
                                block_q: int = 512, block_k: int = 1024,
                                interpret: bool = False,
-                               layout: str = "bhsd"):
+                               layout: str = "bhsd",
+                               dropout_rate: float = 0.0,
+                               dropout_seed=None):
     """Block-wise dq, dk, dv — no [S, S] materialization in HBM.  Inputs
     and grads follow `layout` (lse is always [B, H, S])."""
     batch, heads, q_len, d = _dims(q, layout)
@@ -399,8 +479,15 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
             f"seq lengths ({q_len},{k_len}) only tile into 1-wide blocks "
             f"— use the flash_attention dispatcher (XLA fallback)")
     nq, nk = q_len // block_q, k_len // block_k
+    if dropout_rate > 0.0 and interpret:
+        raise ValueError(
+            "in-kernel dropout needs the TPU PRNG (pltpu.prng_seed has no "
+            "CPU lowering) — interpret-mode callers must use rate 0")
+    seed = _seed_arg(dropout_seed)
 
     # delta_i = rowsum(dO_i * O_i)  (cheap elementwise; leave to XLA).
+    # With dropout this stays correct: rowsum(dO*O) = sum_j A_ij dA_ij for
+    # A = dropout(P), which is exactly the subtrahend in dS = P*(D.dp - δ).
     # The stats ride [B, H, S, lanes] in both layouts (tiny tensors).
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)
@@ -421,58 +508,64 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
     # use "j" here
     dkdv_kernel = functools.partial(
         _fa_bwd_dkdv_kernel, causal=causal, sm_scale=float(sm_scale),
-        block_q=block_q, block_k=block_k, num_q_blocks=nq)
+        block_q=block_q, block_k=block_k, num_q_blocks=nq,
+        dropout_rate=float(dropout_rate))
     dk, dv = pl.pallas_call(
         dkdv_kernel,
-        grid=(batch, heads, nk, nq),
-        in_specs=[
-            _tile_spec(block_q, d, layout, "j"),
-            _tile_spec(block_k, d, layout, "i"),
-            _tile_spec(block_k, d, layout, "i"),
-            _tile_spec(block_q, d, layout, "j"),
-            pl.BlockSpec((1, 1, block_q, _STATS_LANES),
-                         lambda b, h, j, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, _STATS_LANES),
-                         lambda b, h, j, i: (b, h, i, 0)),
-        ],
-        out_specs=[
-            _tile_spec(block_k, d, layout, "i"),
-            _tile_spec(block_k, d, layout, "i"),
-        ],
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(batch, heads, nk, nq),
+            in_specs=[
+                _tile_spec(block_q, d, layout, "j"),
+                _tile_spec(block_k, d, layout, "i"),
+                _tile_spec(block_k, d, layout, "i"),
+                _tile_spec(block_q, d, layout, "j"),
+                pl.BlockSpec((1, 1, block_q, _STATS_LANES),
+                             lambda b, h, j, i, *_: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, _STATS_LANES),
+                             lambda b, h, j, i, *_: (b, h, i, 0)),
+            ],
+            out_specs=[
+                _tile_spec(block_k, d, layout, "i"),
+                _tile_spec(block_k, d, layout, "i"),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, d), jnp.float32),
+                pltpu.VMEM((block_k, d), jnp.float32),
+            ]),
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((block_k, d), jnp.float32),
-            pltpu.VMEM((block_k, d), jnp.float32),
-        ],
         interpret=interpret,
         **params,
-    )(q, k, v, do, lse, delta)
+    )(seed, q, k, v, do, lse, delta)
 
     # dq: grid over q blocks, inner loop over k blocks
     r_spec = pl.BlockSpec((1, 1, block_q, _STATS_LANES),
-                          lambda b, h, i, j: (b, h, i, 0))
+                          lambda b, h, i, j, *_: (b, h, i, 0))
     dq_kernel = functools.partial(
         _fa_bwd_dq_kernel, causal=causal, sm_scale=float(sm_scale),
-        block_q=block_q, block_k=block_k, num_k_blocks=nk)
+        block_q=block_q, block_k=block_k, num_k_blocks=nk,
+        dropout_rate=float(dropout_rate))
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(batch, heads, nq, nk),
-        in_specs=[
-            _tile_spec(block_q, d, layout, "i"),
-            _tile_spec(block_k, d, layout, "j"),
-            _tile_spec(block_k, d, layout, "j"),
-            _tile_spec(block_q, d, layout, "i"),
-            r_spec, r_spec,
-        ],
-        out_specs=_tile_spec(block_q, d, layout, "i"),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(batch, heads, nq, nk),
+            in_specs=[
+                _tile_spec(block_q, d, layout, "i"),
+                _tile_spec(block_k, d, layout, "j"),
+                _tile_spec(block_k, d, layout, "j"),
+                _tile_spec(block_q, d, layout, "i"),
+                r_spec, r_spec,
+            ],
+            out_specs=_tile_spec(block_q, d, layout, "i"),
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)]),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
         **params,
-    )(q, k, v, do, lse, delta)
+    )(seed, q, k, v, do, lse, delta)
 
     return dq, dk, dv
 
@@ -480,9 +573,11 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
 # --------------------------------------------------------------------------- #
 # Differentiable public entry point
 # --------------------------------------------------------------------------- #
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k, layout="bhsd"):
-    return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, layout)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, seed, causal, sm_scale, block_q, block_k,
+           layout="bhsd", dropout_rate=0.0):
+    return _flash_fwd(q, k, v, seed, causal, sm_scale, block_q, block_k,
+                      layout, dropout_rate)[0]
 
 
 def _use_pallas(q_len, k_len, d, block_q, block_k):
@@ -498,38 +593,50 @@ def _t_bhsd(t):
     return t.transpose(0, 2, 1, 3)
 
 
-def _ref_in_layout(q, k, v, causal, sm_scale, layout):
+def _ref_in_layout(q, k, v, causal, sm_scale, layout, dropout_rate=0.0,
+                   dropout_seed=None):
     """XLA fallback in the caller's layout."""
     if layout == "bhsd":
-        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
+                             dropout_rate=dropout_rate,
+                             dropout_seed=dropout_seed)
     return _t_bhsd(mha_reference(_t_bhsd(q), _t_bhsd(k), _t_bhsd(v),
-                                 causal=causal, sm_scale=sm_scale))
+                                 causal=causal, sm_scale=sm_scale,
+                                 dropout_rate=dropout_rate,
+                                 dropout_seed=dropout_seed))
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, layout="bhsd"):
+def _flash_fwd(q, k, v, seed, causal, sm_scale, block_q, block_k,
+               layout="bhsd", dropout_rate=0.0):
     q_len, k_len = _dims(q, layout)[2], _dims(k, layout)[2]
     if _use_pallas(q_len, k_len, q.shape[3], block_q, block_k):
         _, bq, bk = _resolve_blocks(q_len, k_len, block_q, block_k)
         out, lse = flash_attention_pallas(
             q, k, v, causal=causal, sm_scale=sm_scale,
-            block_q=bq, block_k=bk, return_lse=True, layout=layout)
-        return out, (q, k, v, out, lse)
-    out = _ref_in_layout(q, k, v, causal, sm_scale, layout)
-    return out, (q, k, v, None, None)
+            block_q=bq, block_k=bk, return_lse=True, layout=layout,
+            dropout_rate=dropout_rate, dropout_seed=seed)
+        return out, (q, k, v, seed, out, lse)
+    out = _ref_in_layout(q, k, v, causal, sm_scale, layout, dropout_rate,
+                         seed[0])
+    return out, (q, k, v, seed, None, None)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, layout, res, g):
-    q, k, v, out, lse = res
+def _flash_bwd(causal, sm_scale, block_q, block_k, layout, dropout_rate,
+               res, g):
+    q, k, v, seed, out, lse = res
     if lse is not None:
         q_len, k_len = _dims(q, layout)[2], _dims(k, layout)[2]
         _, bq, bk = _resolve_blocks(q_len, k_len, block_q, block_k)
-        return flash_attention_bwd_pallas(
+        dq, dk, dv = flash_attention_bwd_pallas(
             q, k, v, out, lse, g, causal=causal, sm_scale=sm_scale,
-            block_q=bq, block_k=bk, layout=layout)
+            block_q=bq, block_k=bk, layout=layout,
+            dropout_rate=dropout_rate, dropout_seed=seed)
+        return dq, dk, dv, None
     _, vjp = jax.vjp(
         lambda q_, k_, v_: _ref_in_layout(q_, k_, v_, causal, sm_scale,
-                                          layout), q, k, v)
-    return vjp(g)
+                                          layout, dropout_rate, seed[0]),
+        q, k, v)
+    return (*vjp(g), None)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -553,7 +660,8 @@ def flash_attention(q, k, v, causal: bool = False,
                     sm_scale: Optional[float] = None, bias=None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
-                    impl: str = "auto"):
+                    impl: str = "auto", dropout_rate: float = 0.0,
+                    dropout_seed=None):
     """Fused multi-head attention: q,k,v [B, H, S, D] -> [B, H, S, D].
 
     impl: "auto" (default) runs the Pallas flash kernel with blocks fitted
@@ -562,7 +670,17 @@ def flash_attention(q, k, v, causal: bool = False,
     Pallas kernel and raises where auto would fall back (so ablation
     harnesses can never silently measure the XLA path); "xla" forces the
     reference.  Additive-bias attention always takes the XLA path (the
-    compiler fuses the bias add into the softmax)."""
+    compiler fuses the bias add into the softmax).
+
+    dropout_rate > 0 applies PROBABILITY dropout to the normalized
+    attention (the reference's attn-dropout, dropout_kernels.cu:868) —
+    IN-KERNEL on the Pallas path (the mask is regenerated from
+    dropout_seed + tile coordinates in the backward, never stored) and via
+    jax.random on the XLA path.  dropout_seed is a per-step int32 (array
+    or scalar); the two paths use different PRNG streams."""
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_rate > 0 requires dropout_seed")
+    seed = _seed_arg(dropout_seed)
     if impl == "pallas":
         if bias is not None:
             raise ValueError(
@@ -574,20 +692,26 @@ def flash_attention(q, k, v, causal: bool = False,
                 f"impl='pallas': no aligned tiling for seq lengths "
                 f"({q.shape[2]},{k.shape[2]}) or Pallas unavailable on this "
                 "backend — use impl='auto' for the XLA fallback")
-        return _flash(q, k, v, causal, sm_scale, block_q, block_k)
+        return _flash(q, k, v, seed, causal, sm_scale, block_q, block_k,
+                      "bhsd", dropout_rate)
     if bias is not None:
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
-                             bias=bias)
+                             bias=bias, dropout_rate=dropout_rate,
+                             dropout_seed=seed[0])
     if impl == "xla":
-        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
-    return _flash(q, k, v, causal, sm_scale, block_q, block_k, "bhsd")
+        return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
+                             dropout_rate=dropout_rate,
+                             dropout_seed=seed[0])
+    return _flash(q, k, v, seed, causal, sm_scale, block_q, block_k,
+                  "bhsd", dropout_rate)
 
 
 def flash_attention_bsh(q, k, v, causal: bool = False,
                         sm_scale: Optional[float] = None, bias=None,
                         block_q: int = DEFAULT_BLOCK_Q,
                         block_k: int = DEFAULT_BLOCK_K,
-                        impl: str = "auto"):
+                        impl: str = "auto", dropout_rate: float = 0.0,
+                        dropout_seed=None):
     """Fused attention over [B, S, heads, d] — the transpose-free path.
 
     Callers holding [B, S, hidden] activations reshape (free) to
@@ -598,7 +722,10 @@ def flash_attention_bsh(q, k, v, causal: bool = False,
     takes concrete layouts).  Semantics are identical to
     flash_attention — including impl='pallas' strictness — with
     bias/impl='xla'/unusable lengths falling back to the transposed XLA
-    reference."""
+    reference.  dropout_rate/dropout_seed as in flash_attention."""
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_rate > 0 requires dropout_seed")
+    seed = _seed_arg(dropout_seed)
     if impl == "pallas":
         if bias is not None:
             raise ValueError(
@@ -613,5 +740,7 @@ def flash_attention_bsh(q, k, v, causal: bool = False,
     if bias is not None or impl == "xla":
         return _t_bhsd(mha_reference(_t_bhsd(q), _t_bhsd(k), _t_bhsd(v),
                                      causal=causal, sm_scale=sm_scale,
-                                     bias=bias))
-    return _flash(q, k, v, causal, sm_scale, block_q, block_k, "bshd")
+                                     bias=bias, dropout_rate=dropout_rate,
+                                     dropout_seed=seed[0]))
+    return _flash(q, k, v, seed, causal, sm_scale, block_q, block_k,
+                  "bshd", dropout_rate)

@@ -37,7 +37,8 @@ try:  # pragma: no cover
 except ImportError:  # pragma: no cover
     pltpu = None
 
-from ..flash_attention import DEFAULT_MASK_VALUE, _STATS_LANES, _LANES
+from ..flash_attention import (DEFAULT_MASK_VALUE, _STATS_LANES, _LANES,
+                               causal_keep_mask)
 
 
 def layout_gather(layout: np.ndarray, transpose: bool = False
@@ -56,15 +57,6 @@ def layout_gather(layout: np.ndarray, transpose: bool = False
     idx, valid = _gather_core(layout, pad_last_valid=True,
                               allow_empty_rows=True)
     return idx, valid.astype(np.int32)
-
-
-def _causal_pmask(qi_block, ki_block, block):
-    """Within-tile causal mask given absolute block indices."""
-    row = qi_block * block + jax.lax.broadcasted_iota(
-        jnp.int32, (block, block), 0)
-    col = ki_block * block + jax.lax.broadcasted_iota(
-        jnp.int32, (block, block), 1)
-    return col <= row
 
 
 def _bsf_fwd_kernel(idx_ref, val_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
@@ -93,7 +85,8 @@ def _bsf_fwd_kernel(idx_ref, val_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # [block, block]
         if causal:
-            s = jnp.where(_causal_pmask(qi, ki, block), s, DEFAULT_MASK_VALUE)
+            s = jnp.where(causal_keep_mask(qi, ki, block, block), s,
+                          DEFAULT_MASK_VALUE)
         m_prev = m_scr[...]
         l_prev = l_scr[...]
         m_curr = jnp.max(s, axis=-1, keepdims=True)
@@ -147,7 +140,7 @@ def _bsf_dq_kernel(idx_ref, val_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             preferred_element_type=jnp.float32) * sm_scale
         p = jnp.exp(s - lse)
         if causal:
-            p = jnp.where(_causal_pmask(qi, ki, block), p, 0.0)
+            p = jnp.where(causal_keep_mask(qi, ki, block, block), p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -191,7 +184,7 @@ def _bsf_dkdv_kernel(idx_ref, val_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             preferred_element_type=jnp.float32) * sm_scale
         p = jnp.exp(s - lse)
         if causal:
-            p = jnp.where(_causal_pmask(qi, ki, block), p, 0.0)
+            p = jnp.where(causal_keep_mask(qi, ki, block, block), p, 0.0)
         pt = p.astype(do.dtype)
         dv_scr[...] += jax.lax.dot_general(
             pt, do, (((0,), (0,)), ((), ())),

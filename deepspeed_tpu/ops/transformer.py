@@ -203,6 +203,20 @@ class DeepSpeedTransformerLayer:
             params["attn_qkvb"].astype(attn_in.dtype)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
+        # attention-probability dropout runs INSIDE the flash kernel
+        # (reference semantics: dropout_kernels.cu attn-dropout on the
+        # softmax output; saves the extra [B,S,H] mask pass the old
+        # ctx-level dropout cost).  Sparse attention keeps ctx-level
+        # dropout (its kernel has no PRNG path yet), so the seed draw
+        # lives in the dense branches only — r_attn is consumed exactly
+        # once on every path.
+        attn_rate = 0.0 if deterministic else cfg.attn_dropout_ratio
+
+        def attn_seed():
+            if attn_rate == 0.0:
+                return None
+            return jax.random.randint(r_attn, (), 0, 2 ** 31 - 1, jnp.int32)
+
         if self._sparse_attn is not None:
             if attn_mask is not None:
                 raise NotImplementedError(
@@ -215,6 +229,7 @@ class DeepSpeedTransformerLayer:
             ctx = self._sparse_attn(to_heads(q), to_heads(k), to_heads(v),
                                     causal=cfg.causal)
             ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h)
+            ctx = dropout(ctx, cfg.attn_dropout_ratio, r_attn, deterministic)
         elif cfg.attn_layout == "bshd":
             # transpose-free: reshape [B,S,H] -> [B,S,heads,d] is a view;
             # the kernel's BlockSpecs index the head dim directly, saving
@@ -227,7 +242,8 @@ class DeepSpeedTransformerLayer:
                 split_heads(q), split_heads(k), split_heads(v),
                 causal=cfg.causal, bias=attn_mask,
                 block_q=cfg.block_q, block_k=cfg.block_k,
-                impl=cfg.attn_impl)
+                impl=cfg.attn_impl, dropout_rate=attn_rate,
+                dropout_seed=attn_seed())
             ctx = ctx.reshape(b, s, h)
         else:
             def to_heads(t):
@@ -236,9 +252,9 @@ class DeepSpeedTransformerLayer:
             ctx = flash_attention(
                 to_heads(q), to_heads(k), to_heads(v), causal=cfg.causal,
                 bias=attn_mask, block_q=cfg.block_q, block_k=cfg.block_k,
-                impl=cfg.attn_impl)
+                impl=cfg.attn_impl, dropout_rate=attn_rate,
+                dropout_seed=attn_seed())
             ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h)
-        ctx = dropout(ctx, cfg.attn_dropout_ratio, r_attn, deterministic)
 
         attn_out = matmul_maybe_int8(ctx, params["attn_ow"])
         attn_out = bias_dropout_residual(

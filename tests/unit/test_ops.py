@@ -358,3 +358,52 @@ def test_transformer_layer_bshd_under_tensor_parallel():
         np.testing.assert_allclose(outs[0], outs[1], rtol=1e-5, atol=1e-5)
     finally:
         ds.reset_mesh_context()
+
+
+def test_flash_attention_dropout_xla_path():
+    """CPU (XLA fallback) probability-dropout semantics: deterministic per
+    seed, ~rate fraction of attention entries dropped (visible through a
+    ones-valued v), exact equality at rate 0, seed requirement."""
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (2, 2, 64, 16), jnp.float32)
+               for kk in ks)
+    ones_v = jnp.ones_like(v)
+
+    with pytest.raises(ValueError, match="dropout_seed"):
+        flash_attention(q, k, v, dropout_rate=0.1)
+
+    o1 = flash_attention(q, k, ones_v, dropout_rate=0.2, dropout_seed=7)
+    o2 = flash_attention(q, k, ones_v, dropout_rate=0.2, dropout_seed=7)
+    o3 = flash_attention(q, k, ones_v, dropout_rate=0.2, dropout_seed=8)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    assert float(jnp.max(jnp.abs(o1 - o3))) > 0.0
+    # rows of dropout(P)/keep against ones-v have mean 1 in expectation
+    assert abs(float(jnp.mean(o1)) - 1.0) < 0.05
+
+    o0 = flash_attention(q, k, v, dropout_rate=0.0)
+    onodrop = flash_attention(q, k, v)
+    np.testing.assert_array_equal(np.asarray(o0), np.asarray(onodrop))
+
+    # grads flow and are finite through the dropout path
+    g = jax.grad(lambda q_: jnp.sum(
+        flash_attention(q_, k, v, dropout_rate=0.2, dropout_seed=7) ** 2))(q)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_transformer_layer_training_uses_attention_dropout():
+    """In training mode the layer's attention dropout changes the output
+    (vs deterministic) and stays reproducible for a fixed rng."""
+    from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
+                                               DeepSpeedTransformerLayer)
+    cfg = DeepSpeedTransformerConfig(
+        hidden_size=32, heads=4, attn_dropout_ratio=0.3,
+        hidden_dropout_ratio=0.0, bf16=False, causal=True)
+    layer = DeepSpeedTransformerLayer(cfg)
+    params = layer.init_params(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 32))
+    rng = jax.random.PRNGKey(2)
+    det = layer(params, x, deterministic=True)
+    tr1 = layer(params, x, rng=rng, deterministic=False)
+    tr2 = layer(params, x, rng=rng, deterministic=False)
+    np.testing.assert_array_equal(np.asarray(tr1), np.asarray(tr2))
+    assert float(jnp.max(jnp.abs(tr1 - det))) > 1e-3
