@@ -6,5 +6,6 @@ from .sparse_self_attention import (SparseSelfAttention,
                                     layout_to_gather_indices)
 from .block_sparse_flash import (block_sparse_flash_attention,
                                  layout_gather)
-from .sparse_attention_utils import (pad_to_block_size,
+from .sparse_attention_utils import (extend_position_embedding,
+                                     pad_to_block_size,
                                      unpad_sequence_output)

@@ -39,3 +39,26 @@ def unpad_sequence_output(pad_len: int, sequence_output):
     if pad_len == 0:
         return sequence_output
     return sequence_output[:, :-pad_len]
+
+
+def extend_position_embedding(params: dict, new_max_positions: int):
+    """Grow a trained checkpoint's position-embedding table ("wpe") to
+    support longer sparse-attention sequences by tiling the trained rows
+    (reference: sparse_attention_utils.py extend_position_embedding — it
+    replicates the learned table until the new length is covered, which
+    preserves the local positional geometry the model trained on).
+
+    Returns a NEW param dict; requires new_max_positions to be a multiple
+    of the current table length, like the reference."""
+    if "wpe" not in params:
+        raise ValueError("params has no 'wpe' position-embedding table")
+    wpe = params["wpe"]
+    cur = wpe.shape[0]
+    if new_max_positions % cur:
+        raise ValueError(
+            f"new_max_positions {new_max_positions} must be a multiple of "
+            f"the trained length {cur} (reference semantics)")
+    reps = new_max_positions // cur
+    out = dict(params)
+    out["wpe"] = jnp.tile(wpe, (reps, 1))
+    return out

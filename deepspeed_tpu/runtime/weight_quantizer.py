@@ -41,10 +41,8 @@ def quantize_weight(w, num_groups: int = 1) -> QuantizedWeight:
 
 
 def dequantize_weight(qw: QuantizedWeight) -> jnp.ndarray:
-    rows = qw.qweight.shape[0]
-    groups = qw.scale.shape[0]
-    q = qw.qweight.reshape(groups, rows // groups, -1).astype(jnp.float32)
-    return (q * qw.scale[:, :, None]).reshape(rows, -1)
+    from ..ops.quant import dequant
+    return dequant(qw, jnp.float32)
 
 
 class WeightQuantization:

@@ -264,3 +264,22 @@ def test_flash_inkernel_dropout_tpu():
         an = float(jnp.sum(g * u))
         assert abs(fd - an) / (abs(fd) + abs(an) + 1e-6) < 5e-2, \
             (i, fd, an)
+
+
+def test_fused_dequant_matmul_parity_tpu():
+    """Compiled-Mosaic parity of the fused int8 dequant-matmul at the
+    decode shapes (M=8 GEMV-ish) and a prefill shape."""
+    from deepspeed_tpu.ops.quant import (QuantizedWeight,
+                                         fused_dequant_matmul, dequant)
+    rng = np.random.RandomState(2)
+    for (m, k, n, groups) in [(8, 768, 2304, 8), (256, 768, 3072, 8)]:
+        x = jnp.asarray(rng.standard_normal((m, k)).astype(np.float32),
+                        jnp.bfloat16)
+        qw = jnp.asarray(rng.randint(-127, 128, (k, n)).astype(np.int8))
+        scale = jnp.asarray(
+            np.abs(rng.standard_normal((groups, 1))).astype(np.float32))
+        w = QuantizedWeight(qw, scale)
+        out = jax.jit(lambda a: fused_dequant_matmul(a, w))(x)
+        ref = x.astype(jnp.float32) @ dequant(w, jnp.float32)
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref), rtol=2e-2, atol=2.0)

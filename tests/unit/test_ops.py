@@ -407,3 +407,87 @@ def test_transformer_layer_training_uses_attention_dropout():
     tr2 = layer(params, x, rng=rng, deterministic=False)
     np.testing.assert_array_equal(np.asarray(tr1), np.asarray(tr2))
     assert float(jnp.max(jnp.abs(tr1 - det))) > 1e-3
+
+
+def test_fused_dequant_matmul_interpret_parity():
+    """Pallas fused dequant-matmul (interpret) vs the XLA dequant path and
+    vs exact fp math, across tiling-friendly and fitted shapes."""
+    from deepspeed_tpu.ops.quant import (QuantizedWeight,
+                                         fused_dequant_matmul, dequant)
+    rng = np.random.RandomState(0)
+    for (m, k, n, groups) in [(8, 256, 384, 4), (16, 768, 2304, 8),
+                              (128, 128, 128, 1)]:
+        x = jnp.asarray(rng.standard_normal((m, k)).astype(np.float32))
+        qw = jnp.asarray(rng.randint(-127, 128, (k, n)).astype(np.int8))
+        scale = jnp.asarray(
+            np.abs(rng.standard_normal((groups, 1))).astype(np.float32))
+        w = QuantizedWeight(qw, scale)
+        out = fused_dequant_matmul(x, w, interpret=True)
+        ref = x @ dequant(w, jnp.float32)
+        # blocked-K accumulation reorders fp32 sums vs the single dot
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-3, atol=1e-2)
+
+
+def test_matmul_maybe_int8_nd_and_plain():
+    from deepspeed_tpu.ops.quant import QuantizedWeight, matmul_maybe_int8
+    rng = np.random.RandomState(1)
+    x3 = jnp.asarray(rng.standard_normal((2, 4, 64)).astype(np.float32))
+    qw = jnp.asarray(rng.randint(-127, 128, (64, 96)).astype(np.int8))
+    scale = jnp.ones((4, 1), jnp.float32) * 0.5
+    w = QuantizedWeight(qw, scale)
+    out = matmul_maybe_int8(x3, w)
+    assert out.shape == (2, 4, 96)
+    ref = jnp.einsum("bsk,kn->bsn", x3, qw.astype(jnp.float32) * 0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-4)
+    # plain (unquantized) weights unchanged
+    wplain = jnp.asarray(rng.standard_normal((64, 96)).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(matmul_maybe_int8(x3, wplain)),
+                               np.asarray(jnp.einsum("bsk,kn->bsn", x3,
+                                                     wplain)), rtol=1e-5)
+    # stacked (3-D) quantized weights rejected loudly
+    import pytest as _pytest
+    wbad = QuantizedWeight(jnp.zeros((2, 64, 96), jnp.int8),
+                           jnp.ones((2, 4, 1)))
+    with _pytest.raises(ValueError, match="2-D"):
+        matmul_maybe_int8(x3, wbad)
+
+
+def test_fused_dequant_matmul_grad():
+    """Differentiation through the fused path (custom VJP: XLA matmul
+    backward) matches the plain dequant matmul gradient."""
+    from deepspeed_tpu.ops.quant import (QuantizedWeight, _fused_dq,
+                                         dequant)
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.standard_normal((8, 128)).astype(np.float32))
+    qw = jnp.asarray(rng.randint(-127, 128, (128, 256)).astype(np.int8))
+    scale = jnp.ones((2, 1), jnp.float32) * 0.1
+    w = QuantizedWeight(qw, scale)
+
+    # interpret-mode forward is exercised elsewhere; on CPU the public
+    # dispatcher uses the XLA path, so drive the custom-vjp wrapper with
+    # the kernel monkeypatched to interpret mode for the fwd
+    import deepspeed_tpu.ops.quant as qmod
+    import functools as ft
+    orig = qmod.fused_dequant_matmul
+    qmod.fused_dequant_matmul = ft.partial(orig, interpret=True)
+    try:
+        g1 = jax.grad(lambda a: jnp.sum(
+            _fused_dq(a, w.qweight, w.scale) ** 2))(x)
+    finally:
+        qmod.fused_dequant_matmul = orig
+    g2 = jax.grad(lambda a: jnp.sum((a @ dequant(w, jnp.float32)) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=1e-3, atol=1e-2)
+
+
+def test_dequantize_weight_delegates():
+    from deepspeed_tpu.runtime.weight_quantizer import (quantize_weight,
+                                                        dequantize_weight)
+    rng = np.random.RandomState(4)
+    wfull = rng.standard_normal((64, 32)).astype(np.float32)
+    qw = quantize_weight(jnp.asarray(wfull), num_groups=4)
+    deq = dequantize_weight(qw)
+    assert deq.shape == (64, 32)
+    np.testing.assert_allclose(np.asarray(deq), wfull, atol=0.05)

@@ -235,3 +235,30 @@ def test_sparse_self_attention_impl_dispatch():
     attn2 = SparseSelfAttention(cfg, impl="gather")
     out = attn2(q, k, v)
     assert out.shape == q.shape
+
+
+def test_extend_position_embedding():
+    from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils import (
+        extend_position_embedding)
+    from deepspeed_tpu.models import GPT2Config, GPT2Model
+    cfg = GPT2Config(vocab_size=64, n_positions=32, hidden_size=16,
+                     num_layers=1, num_heads=2, bf16=False)
+    model = GPT2Model(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    ext = extend_position_embedding(params, 128)
+    assert ext["wpe"].shape == (128, 16)
+    np.testing.assert_array_equal(np.asarray(ext["wpe"][:32]),
+                                  np.asarray(params["wpe"]))
+    np.testing.assert_array_equal(np.asarray(ext["wpe"][32:64]),
+                                  np.asarray(params["wpe"]))
+    # original untouched; non-multiple rejected
+    assert params["wpe"].shape == (32, 16)
+    with pytest.raises(ValueError, match="multiple"):
+        extend_position_embedding(params, 100)
+    # extended model actually runs at the longer length
+    cfg_long = GPT2Config(vocab_size=64, n_positions=128, hidden_size=16,
+                          num_layers=1, num_heads=2, bf16=False)
+    model_long = GPT2Model(cfg_long)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 128), 0, 64)
+    out = model_long.loss(ext, None, ids)
+    assert np.isfinite(float(out))
