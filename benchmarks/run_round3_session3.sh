@@ -1,0 +1,106 @@
+#!/bin/bash
+# Round-3 session-3 measurement pass, run after the hardware-validation
+# fixes to the session-2 kernels (in-kernel dropout seed arity, fused
+# dequant layout/dtype, bshd boundary conversion).
+#
+# Order: cheap profilers first (they also re-certify the fixed kernels
+# compile), then the re-measured flagship rows, then the never-measured
+# rows, with the wedge-prone offload rows last (device->host traffic
+# through the 0.02 GB/s tunnel is what wedged session 2).
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/session_r3
+mkdir -p "$OUT"
+stamp() { date -u +%FT%TZ; }
+
+probe() { timeout -k 10 75 python -c "import jax; jax.devices()[0]" \
+          > /dev/null 2>&1; }
+
+waitslot() {  # $1 = max probes (45 s apart + probe time)
+  local max=${1:-40}
+  for i in $(seq 1 "$max"); do
+    if probe; then
+      echo "   slot ok after $i probe(s) [$(stamp)]" | tee -a "$OUT/session.log"
+      return 0
+    fi
+    sleep 45
+  done
+  echo "   slot NEVER freed after $max probes [$(stamp)]" \
+    | tee -a "$OUT/session.log"
+  return 1
+}
+
+row() {  # $1 = config, extra env via caller; appends to ladder_results.jsonl
+  echo "== row $1 $(stamp)" | tee -a "$OUT/session.log"
+  local out
+  out=$(DS_BENCH_WATCHDOG="${WATCHDOG:-1200}" DS_BENCH_RUN_MARGIN=700 \
+    timeout -k 30 "${ROWTIMEOUT:-1300}" python bench.py --config "$1" \
+    2>> "$OUT/row_$1.stderr.log" | tail -1)
+  # only a complete JSON line reaches the results log (a timeout-killed
+  # bench can emit nothing or a truncated line)
+  if echo "$out" | python -c \
+      'import json,sys; json.loads(sys.stdin.read())' 2>/dev/null; then
+    echo "$out" | tee -a benchmarks/ladder_results.jsonl
+  else
+    echo "   row $1 produced no JSON (see row_$1.stderr.log) [$(stamp)]" \
+      | tee -a "$OUT/session.log"
+  fi
+}
+
+echo "== session-3 start $(stamp)" | tee -a "$OUT/session.log"
+waitslot 40 || exit 1
+
+if [ -z "${SKIP_PROFILES:-}" ]; then
+  echo "== profiles $(stamp)" | tee -a "$OUT/session.log"
+  timeout -k 30 900 python benchmarks/profile_layout.py \
+    > "$OUT/layout_ab.log" 2>&1
+  waitslot 10
+  timeout -k 30 900 python benchmarks/profile_ce_sweep.py \
+    > "$OUT/ce_sweep.log" 2>&1
+  waitslot 10
+  timeout -k 30 1200 python benchmarks/profile_ablations2.py \
+    > "$OUT/ablations2.log" 2>&1
+  waitslot 10
+  timeout -k 30 900 python benchmarks/profile_gpt2.py \
+    > "$OUT/profile_gpt2.log" 2>&1
+  waitslot 10
+fi
+
+if [ -z "${SKIP_ROWS:-}" ]; then
+  # flagship re-measures first (post in-kernel-dropout / LN-bwd / dequant)
+  row gpt2
+  waitslot 10
+  row decode
+  waitslot 10
+  row sparse_longseq
+  waitslot 10
+  row infinity
+  waitslot 10
+fi
+
+if [ -z "${SKIP_CAP:-}" ]; then
+  echo "== infinity capability $(stamp)" | tee -a "$OUT/session.log"
+  timeout -k 60 5400 python benchmarks/infinity_capability.py \
+    > "$OUT/infinity_capability.log" 2>&1
+  last=$(tail -1 "$OUT/infinity_capability.log")
+  if echo "$last" | python -c \
+      'import json,sys; json.loads(sys.stdin.read())' 2>/dev/null; then
+    echo "$last" >> benchmarks/ladder_results.jsonl
+    echo "$last" | tee -a "$OUT/session.log"
+  else
+    echo "infinity_capability produced no JSON (see log)" \
+      | tee -a "$OUT/session.log"
+  fi
+  waitslot 10
+fi
+
+if [ -z "${SKIP_OFFLOAD:-}" ]; then
+  # wedge-prone rows last, with a wider watchdog for the slow tunnel
+  WATCHDOG=1500 ROWTIMEOUT=1700 row offload
+  waitslot 20
+  DS_BENCH_GAS=8 WATCHDOG=1500 ROWTIMEOUT=1700 row offload
+  waitslot 20
+fi
+
+python benchmarks/render_results.py | tee -a "$OUT/session.log"
+echo "== session-3 done $(stamp)" | tee -a "$OUT/session.log"

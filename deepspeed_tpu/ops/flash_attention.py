@@ -81,20 +81,12 @@ def mha_reference(q, k, v, causal: bool = False,
 # Pallas kernel
 # --------------------------------------------------------------------------- #
 def _ld(ref):
-    """Load a [rows, d] tile from either layout's block:
-    (1, 1, rows, d) — the classic [B, H, S, D] path — or (1, rows, 1, d)
-    — the [B, S, heads, d] ("bsh") path that indexes the head dim in the
-    BlockSpec so callers never materialize a transpose."""
-    if ref.shape[1] == 1:
-        return ref[0, 0]
-    return ref[0, :, 0, :]
+    """Load the [rows, d] tile from a (1, 1, rows, d) block."""
+    return ref[0, 0]
 
 
 def _st(ref, val):
-    if ref.shape[1] == 1:
-        ref[0, 0] = val
-    else:
-        ref[0, :, 0, :] = val
+    ref[0, 0] = val
 
 
 def causal_keep_mask(qi_block, ki_block, block_q, block_k):
@@ -108,13 +100,27 @@ def causal_keep_mask(qi_block, ki_block, block_q, block_k):
     return col <= row
 
 
-def _dropout_keep(seed_ref, b, h, qi, ki, rate, block_q, block_k):
+def _dropout_keep(seed_ref, b, h, qi, ki, rate, block_q, block_k,
+                  num_k_blocks):
     """Regenerable per-tile keep mask: the PRNG is reseeded from the step
     seed and the tile's ABSOLUTE coordinates, so the forward kernel and
     both backward kernels (whose grids order (qi, ki) differently)
     reproduce the identical mask — the TPU analog of the reference's
-    philox-offset dropout (dropout_kernels.cu:868)."""
-    pltpu.prng_seed(seed_ref[0], b, h, qi, ki)
+    philox-offset dropout (dropout_kernels.cu:868).
+
+    Mosaic on current TPUs rejects prng_seed with more than 2 values, so
+    the coordinates are folded exactly into two: (seed, b, h) -> value 1
+    (grid dim 1 is the head axis in all three kernels, so num_programs(1)
+    is the head count) and (qi, ki, seed) -> value 2 via the static
+    k-block count.  The seed rides in BOTH values: with value 1 alone,
+    sequential per-step seeds (the natural dropout_seed=step usage) would
+    alias step s+1/head h with step s/head h+1 and recycle whole mask
+    patterns.  Mixing seed*40503 into value 2 breaks the alias: a
+    collision now needs seed' - seed == bh - bh' AND tile' - tile ==
+    (seed - seed')*40503, impossible while the per-head tile count stays
+    below 40503 (S < ~146k at the default 512x1024 blocks)."""
+    pltpu.prng_seed(seed_ref[0] + b * pl.num_programs(1) + h,
+                    qi * num_k_blocks + ki + seed_ref[0] * 40503)
     bits = pltpu.prng_random_bits((block_q, block_k))
     threshold = np.uint32(min(int((1.0 - rate) * 2 ** 32), 2 ** 32 - 1))
     return bits.astype(jnp.uint32) < threshold
@@ -173,7 +179,7 @@ def _fa_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             # probabilities; dropout applies to the normalized P, which
             # commutes with the final /l)
             keep = _dropout_keep(seed_ref, b, h, qi, ki, dropout_rate,
-                                 block_q, block_k)
+                                 block_q, block_k, num_k_blocks)
             p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
 
         v_blk = _ld(v_ref)                           # [bk, d]
@@ -242,22 +248,23 @@ def _dims(arr, layout):
     return b, h, s, d
 
 
-def _tile_spec(rows, d, layout, seq_of):
-    """BlockSpec for one [rows, d] tile per (b, h) grid cell; `seq_of`
-    picks which grid index walks the sequence dim ('i' or 'j').  The
-    trailing *_ absorbs the scalar-prefetch ref (the dropout seed) that
-    PrefetchScalarGridSpec appends to every index_map."""
-    if layout == "bhsd":
-        if seq_of == "i":
-            return pl.BlockSpec((1, 1, rows, d),
-                                lambda b, h, i, j, *_: (b, h, i, 0))
-        return pl.BlockSpec((1, 1, rows, d),
-                            lambda b, h, i, j, *_: (b, h, j, 0))
+def _tile_spec(rows, d, seq_of):
+    """[B, H, S, D] BlockSpec for one [rows, d] tile per (b, h) grid
+    cell; `seq_of` picks which grid index walks the sequence dim ('i' or
+    'j').  The trailing *_ absorbs the scalar-prefetch ref (the dropout
+    seed) that PrefetchScalarGridSpec appends to every index_map.
+
+    (A native [B, S, heads, d] tiling — block (1, rows, 1, d) indexing
+    the head dim — is Mosaic-ILLEGAL: the block's last two dims are then
+    (1, d) over a (heads, d) axis pair, and 1 is neither a multiple of 8
+    nor the full head count.  Measured round 3 on v5e: such specs fail
+    Pallas lowering outright, so the "bshd" layout transposes at the
+    kernel boundary instead — see flash_attention_pallas.)"""
     if seq_of == "i":
-        return pl.BlockSpec((1, rows, 1, d),
-                            lambda b, h, i, j, *_: (b, i, h, 0))
-    return pl.BlockSpec((1, rows, 1, d),
-                        lambda b, h, i, j, *_: (b, j, h, 0))
+        return pl.BlockSpec((1, 1, rows, d),
+                            lambda b, h, i, j, *_: (b, h, i, 0))
+    return pl.BlockSpec((1, 1, rows, d),
+                        lambda b, h, i, j, *_: (b, h, j, 0))
 
 
 def flash_attention_pallas(q, k, v, causal: bool = False,
@@ -269,11 +276,12 @@ def flash_attention_pallas(q, k, v, causal: bool = False,
     """Pallas flash attention.
 
     layout="bhsd" (default): q,k,v [B, H, S, D] -> [B, H, S, D].
-    layout="bshd": q,k,v [B, S, heads, D] -> [B, S, heads, D] — the head
-    dim is indexed inside the BlockSpecs, so callers coming from a
-    [B, S, hidden] activation never materialize the [B,H,S,D] transpose
-    (a Pallas call otherwise forces it: custom calls take concrete
-    layouts, costing two full HBM passes per tensor per direction).
+    layout="bshd": q,k,v [B, S, heads, D] -> [B, S, heads, D], converted
+    to the kernel's [B, H, S, D] at this boundary.  (A native bshd
+    BlockSpec — (1, rows, 1, d) indexing the head dim — is Mosaic-illegal
+    and fails Pallas lowering on real TPUs, measured round 3; the
+    transposes here are cheap relative to the attention itself and XLA
+    fuses them into neighbors where it can.)
     logsumexp (when return_lse) is [B, H, S] in BOTH layouts."""
     if pltpu is None:
         raise RuntimeError(
@@ -281,6 +289,8 @@ def flash_attention_pallas(q, k, v, causal: bool = False,
             "mha_reference / the public flash_attention dispatcher instead")
     batch, heads, q_len, d = _dims(q, layout)
     k_len = _dims(k, layout)[2]
+    if layout == "bshd":
+        q, k, v = _t_bhsd(q), _t_bhsd(k), _t_bhsd(v)
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
     # fit to the lengths (largest aligned divisors <= requested blocks);
@@ -320,12 +330,12 @@ def flash_attention_pallas(q, k, v, causal: bool = False,
             num_scalar_prefetch=1,
             grid=(batch, heads, nq, nk),
             in_specs=[
-                _tile_spec(block_q, d, layout, "i"),
-                _tile_spec(block_k, d, layout, "j"),
-                _tile_spec(block_k, d, layout, "j"),
+                _tile_spec(block_q, d, "i"),
+                _tile_spec(block_k, d, "j"),
+                _tile_spec(block_k, d, "j"),
             ],
             out_specs=[
-                _tile_spec(block_q, d, layout, "i"),
+                _tile_spec(block_q, d, "i"),
                 pl.BlockSpec((1, 1, block_q, _STATS_LANES),
                              lambda b, h, i, j, *_: (b, h, i, 0)),
             ],
@@ -338,6 +348,8 @@ def flash_attention_pallas(q, k, v, causal: bool = False,
         interpret=interpret,
         **params,
     )(seed, q, k, v)
+    if layout == "bshd":
+        out = _t_bhsd(out)
     return (out, lse[..., 0]) if return_lse else out
 
 
@@ -347,7 +359,7 @@ def flash_attention_pallas(q, k, v, causal: bool = False,
 def _fa_bwd_dkdv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                         delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
                         causal, sm_scale, block_q, block_k, num_q_blocks,
-                        dropout_rate):
+                        num_k_blocks, dropout_rate):
     b = pl.program_id(0)
     h = pl.program_id(1)
     ki = pl.program_id(2)
@@ -386,7 +398,7 @@ def _fa_bwd_dkdv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             # same (qi, ki) seeding as the forward — identical mask.
             # dV sees the DROPPED probabilities; dS = P*(D.dp - delta)
             keep = _dropout_keep(seed_ref, b, h, qi, ki, dropout_rate,
-                                 block_q, block_k)
+                                 block_q, block_k, num_k_blocks)
             p_drop = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
             dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
         else:
@@ -444,7 +456,7 @@ def _fa_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             preferred_element_type=jnp.float32)
         if dropout_rate > 0.0:
             keep = _dropout_keep(seed_ref, b, h, qi, ki, dropout_rate,
-                                 block_q, block_k)
+                                 block_q, block_k, num_k_blocks)
             dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
         ds = p * (dp - delta) * sm_scale
         dq_scr[...] += jax.lax.dot_general(            # ds @ k -> [bq, d]
@@ -464,9 +476,14 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
                                dropout_rate: float = 0.0,
                                dropout_seed=None):
     """Block-wise dq, dk, dv — no [S, S] materialization in HBM.  Inputs
-    and grads follow `layout` (lse is always [B, H, S])."""
+    and grads follow `layout` (lse is always [B, H, S]); "bshd" converts
+    to the kernel's [B, H, S, D] at this boundary (see
+    flash_attention_pallas)."""
     batch, heads, q_len, d = _dims(q, layout)
     k_len = _dims(k, layout)[2]
+    if layout == "bshd":
+        q, k, v = _t_bhsd(q), _t_bhsd(k), _t_bhsd(v)
+        out, do = _t_bhsd(out), _t_bhsd(do)
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
     # fit to the lengths (largest aligned divisors <= requested blocks);
@@ -488,11 +505,9 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
     # delta_i = rowsum(dO_i * O_i)  (cheap elementwise; leave to XLA).
     # With dropout this stays correct: rowsum(dO*O) = sum_j A_ij dA_ij for
     # A = dropout(P), which is exactly the subtrahend in dS = P*(D.dp - δ).
-    # The stats ride [B, H, S, lanes] in both layouts (tiny tensors).
+    # The stats ride [B, H, S, lanes] (tiny tensors).
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)
-    if layout == "bshd":
-        delta = delta.transpose(0, 2, 1)               # [B,S,H] -> [B,H,S]
     stats_shape = (*delta.shape, _STATS_LANES)
     delta = jnp.broadcast_to(delta[..., None], stats_shape)
     lse = jnp.broadcast_to(lse[..., None], stats_shape)
@@ -508,7 +523,7 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
     # use "j" here
     dkdv_kernel = functools.partial(
         _fa_bwd_dkdv_kernel, causal=causal, sm_scale=float(sm_scale),
-        block_q=block_q, block_k=block_k, num_q_blocks=nq,
+        block_q=block_q, block_k=block_k, num_q_blocks=nq, num_k_blocks=nk,
         dropout_rate=float(dropout_rate))
     dk, dv = pl.pallas_call(
         dkdv_kernel,
@@ -516,18 +531,18 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
             num_scalar_prefetch=1,
             grid=(batch, heads, nk, nq),
             in_specs=[
-                _tile_spec(block_q, d, layout, "j"),
-                _tile_spec(block_k, d, layout, "i"),
-                _tile_spec(block_k, d, layout, "i"),
-                _tile_spec(block_q, d, layout, "j"),
+                _tile_spec(block_q, d, "j"),
+                _tile_spec(block_k, d, "i"),
+                _tile_spec(block_k, d, "i"),
+                _tile_spec(block_q, d, "j"),
                 pl.BlockSpec((1, 1, block_q, _STATS_LANES),
                              lambda b, h, j, i, *_: (b, h, i, 0)),
                 pl.BlockSpec((1, 1, block_q, _STATS_LANES),
                              lambda b, h, j, i, *_: (b, h, i, 0)),
             ],
             out_specs=[
-                _tile_spec(block_k, d, layout, "i"),
-                _tile_spec(block_k, d, layout, "i"),
+                _tile_spec(block_k, d, "i"),
+                _tile_spec(block_k, d, "i"),
             ],
             scratch_shapes=[
                 pltpu.VMEM((block_k, d), jnp.float32),
@@ -554,19 +569,21 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
             num_scalar_prefetch=1,
             grid=(batch, heads, nq, nk),
             in_specs=[
-                _tile_spec(block_q, d, layout, "i"),
-                _tile_spec(block_k, d, layout, "j"),
-                _tile_spec(block_k, d, layout, "j"),
-                _tile_spec(block_q, d, layout, "i"),
+                _tile_spec(block_q, d, "i"),
+                _tile_spec(block_k, d, "j"),
+                _tile_spec(block_k, d, "j"),
+                _tile_spec(block_q, d, "i"),
                 r_spec, r_spec,
             ],
-            out_specs=_tile_spec(block_q, d, layout, "i"),
+            out_specs=_tile_spec(block_q, d, "i"),
             scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)]),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=interpret,
         **params,
     )(seed, q, k, v, do, lse, delta)
 
+    if layout == "bshd":
+        dq, dk, dv = _t_bhsd(dq), _t_bhsd(dk), _t_bhsd(dv)
     return dq, dk, dv
 
 
@@ -712,17 +729,18 @@ def flash_attention_bsh(q, k, v, causal: bool = False,
                         block_k: int = DEFAULT_BLOCK_K,
                         impl: str = "auto", dropout_rate: float = 0.0,
                         dropout_seed=None):
-    """Fused attention over [B, S, heads, d] — the transpose-free path.
+    """Fused attention over [B, S, heads, d] activations.
 
     Callers holding [B, S, hidden] activations reshape (free) to
-    [B, S, heads, d] and never materialize the [B, H, S, D] layout: the
-    Pallas BlockSpecs index the head dim directly, which saves two full
-    HBM read+write passes per tensor per direction around the kernel
-    (the classic path's transposes are forced because a Pallas call
-    takes concrete layouts).  Semantics are identical to
-    flash_attention — including impl='pallas' strictness — with
-    bias/impl='xla'/unusable lengths falling back to the transposed XLA
-    reference.  dropout_rate/dropout_seed as in flash_attention."""
+    [B, S, heads, d]; the layout conversion to the kernel's [B, H, S, D]
+    happens at the Pallas boundary.  (Round-3 finding: a truly
+    transpose-free bshd BlockSpec is Mosaic-illegal — its per-head tile
+    puts (1, d) in the last-two-dims position — so this entry point is
+    API convenience, not an HBM-traffic optimization; measured, the
+    boundary transposes are <1% of step traffic.)  Semantics are
+    identical to flash_attention — including impl='pallas' strictness —
+    with bias/impl='xla'/unusable lengths falling back to the transposed
+    XLA reference.  dropout_rate/dropout_seed as in flash_attention."""
     if dropout_rate > 0.0 and dropout_seed is None:
         raise ValueError("dropout_rate > 0 requires dropout_seed")
     seed = _seed_arg(dropout_seed)

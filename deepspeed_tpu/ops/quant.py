@@ -63,9 +63,12 @@ def _dq_kernel(x_ref, qw_ref, s_ref, o_ref, acc, *, num_k_blocks):
         acc[...] = jnp.zeros_like(acc)
 
     x = x_ref[...]                                    # [bm, bk]
-    # dequant in VMEM: int8 -> compute dtype, per-row (K-dim) scale —
-    # HBM only ever saw the int8 bytes
-    qw = qw_ref[...].astype(x.dtype) * s_ref[...].astype(x.dtype)[:, None]
+    # dequant in VMEM: int8 -> fp32, per-row (K-dim) scale, then down to
+    # the compute dtype — HBM only ever saw the int8 bytes.  The scale
+    # multiply stays in fp32: s_ref is a [bk, 1] fp32 tile (a 1-D vector
+    # operand trips Mosaic's layout verifier when bk < K, and a bf16
+    # minor-dim insert is rejected outright).
+    qw = (qw_ref[...].astype(jnp.float32) * s_ref[...]).astype(x.dtype)
     acc[...] += jax.lax.dot_general(
         x, qw, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
@@ -101,7 +104,7 @@ def fused_dequant_matmul(x, w: QuantizedWeight, block_m: int = 256,
         raise ValueError(f"shapes ({m},{k},{n}) have no legal tiling — "
                          "use the XLA dequant path")
     bm, bn, bk = fit
-    scales = _row_scales(w, jnp.float32)              # [K]
+    scales = _row_scales(w, jnp.float32)[:, None]     # [K, 1]
     grid = (m // bm, n // bn, k // bk)
     params = {}
     if not interpret:
@@ -113,7 +116,7 @@ def fused_dequant_matmul(x, w: QuantizedWeight, block_m: int = 256,
         in_specs=[
             pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
             pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
-            pl.BlockSpec((bk,), lambda i, j, kk: (kk,)),
+            pl.BlockSpec((bk, 1), lambda i, j, kk: (kk, 0)),
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
         out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
@@ -142,19 +145,29 @@ def _dq_fit_or_none(m, k, n, block_m=256, block_n=512, block_k=512):
 @jax.custom_vjp
 def _fused_dq(x, qweight, scales):
     """Differentiable wrapper: forward = Pallas fused kernel; backward =
-    one XLA matmul against the (fusably) dequantized transpose.  int8
-    weights and scales are non-differentiable."""
+    one XLA matmul against the (fusably) dequantized transpose.  The int8
+    weight is non-differentiable; the scale cotangent IS computed (so the
+    fused path and the XLA fallback produce the same gradients — e.g. for
+    learned scales), but XLA dead-code-eliminates its extra matmul
+    whenever the caller doesn't use it."""
     return fused_dequant_matmul(x, QuantizedWeight(qweight, scales))
 
 
 def _fused_dq_fwd(x, qweight, scales):
-    return _fused_dq(x, qweight, scales), (qweight, scales)
+    return _fused_dq(x, qweight, scales), (x, qweight, scales)
 
 
 def _fused_dq_bwd(res, g):
-    qweight, scales = res
+    x, qweight, scales = res
     w = QuantizedWeight(qweight, scales)
-    return (g @ dequant(w, g.dtype).T, None, None)
+    # dL/dW = x^T g; dL/dscale_group = sum over the group's rows of
+    # (x^T g) * float(qweight), matching d/ds [x @ (s * qf)].
+    gw = jnp.einsum("mk,mn->kn", x.astype(jnp.float32),
+                    g.astype(jnp.float32))
+    per_row = jnp.sum(gw * qweight.astype(jnp.float32), axis=1)   # [K]
+    groups = scales.shape[0]
+    dscale = per_row.reshape(groups, -1).sum(axis=1).reshape(scales.shape)
+    return (g @ dequant(w, g.dtype).T, None, dscale.astype(scales.dtype))
 
 
 _fused_dq.defvjp(_fused_dq_fwd, _fused_dq_bwd)

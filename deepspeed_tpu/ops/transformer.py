@@ -231,10 +231,10 @@ class DeepSpeedTransformerLayer:
             ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h)
             ctx = dropout(ctx, cfg.attn_dropout_ratio, r_attn, deterministic)
         elif cfg.attn_layout == "bshd":
-            # transpose-free: reshape [B,S,H] -> [B,S,heads,d] is a view;
-            # the kernel's BlockSpecs index the head dim directly, saving
-            # two HBM passes per tensor per direction vs the [B,H,S,D]
-            # layout a Pallas call would otherwise force
+            # [B,S,H] -> [B,S,heads,d] is a free view; the layout
+            # conversion to the kernel's [B,H,S,D] happens at the Pallas
+            # boundary (a native bshd BlockSpec is Mosaic-illegal —
+            # measured round 3; see flash_attention.py::_tile_spec)
             def split_heads(t):
                 return t.reshape(b, s, heads, d)
 

@@ -254,7 +254,11 @@ def test_flash_inkernel_dropout_tpu():
 
     grads = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
     rng = np.random.RandomState(0)
-    eps = 1e-2
+    # eps must be large enough that the fp32 loss difference (magnitude
+    # ~1e4, so ~1e-1 evaluation noise after cancellation) doesn't dominate
+    # the quotient: at 1e-2 even an exact-gradient XLA reference fails its
+    # own finite-difference check here.
+    eps = 1e-1
     for i, (x, g) in enumerate(zip((q, k, v), grads)):
         u = jnp.asarray(rng.standard_normal(shape).astype(np.float32))
         args_p = [q, k, v]; args_m = [q, k, v]
