@@ -7,78 +7,69 @@
 # compile), then the re-measured flagship rows, then the never-measured
 # rows, with the wedge-prone offload rows last (device->host traffic
 # through the 0.02 GB/s tunnel is what wedged session 2).
+#
+# Re-runnable: finished stages leave markers under $OUT/done/ and are
+# skipped, so the supervisor can relaunch this script after a mid-session
+# tunnel death without repeating work.  A mid-script slot loss exits
+# non-zero immediately (the supervisor handles the retry) instead of
+# burning every remaining stage's timeout against a dead tunnel.
 set -u
 cd "$(dirname "$0")/.."
 OUT=benchmarks/session_r3
 mkdir -p "$OUT"
-stamp() { date -u +%FT%TZ; }
+. benchmarks/slot_lib.sh
 
-probe() { timeout -k 10 75 python -c "import jax; jax.devices()[0]" \
-          > /dev/null 2>&1; }
-
-waitslot() {  # $1 = max probes (45 s apart + probe time)
-  local max=${1:-40}
-  for i in $(seq 1 "$max"); do
-    if probe; then
-      echo "   slot ok after $i probe(s) [$(stamp)]" | tee -a "$OUT/session.log"
-      return 0
-    fi
-    sleep 45
-  done
-  echo "   slot NEVER freed after $max probes [$(stamp)]" \
-    | tee -a "$OUT/session.log"
-  return 1
-}
-
-row() {  # $1 = config, extra env via caller; appends to ladder_results.jsonl
+row() {  # $1 = row stage name, $2 = bench config; appends one JSON line
+  done_skip "row_$1" && return 0
   echo "== row $1 $(stamp)" | tee -a "$OUT/session.log"
   local out
   out=$(DS_BENCH_WATCHDOG="${WATCHDOG:-1200}" DS_BENCH_RUN_MARGIN=700 \
-    timeout -k 30 "${ROWTIMEOUT:-1300}" python bench.py --config "$1" \
+    timeout -k 30 "${ROWTIMEOUT:-1300}" python bench.py --config "$2" \
     2>> "$OUT/row_$1.stderr.log" | tail -1)
   # only a complete JSON line reaches the results log (a timeout-killed
   # bench can emit nothing or a truncated line)
   if echo "$out" | python -c \
       'import json,sys; json.loads(sys.stdin.read())' 2>/dev/null; then
     echo "$out" | tee -a benchmarks/ladder_results.jsonl
+    done_mark "row_$1"
   else
     echo "   row $1 produced no JSON (see row_$1.stderr.log) [$(stamp)]" \
       | tee -a "$OUT/session.log"
   fi
 }
 
+prof() {  # $1 = stage name, $2 = timeout, $3... = command
+  done_skip "$1" && return 0
+  local name=$1 t=$2; shift 2
+  echo "== $name $(stamp)" | tee -a "$OUT/session.log"
+  timeout -k 30 "$t" "$@" > "$OUT/$name.log" 2>&1 && done_mark "$name" \
+    || echo "   $name rc=$? (see $name.log)" | tee -a "$OUT/session.log"
+  waitslot 10 || exit 1
+}
+
 echo "== session-3 start $(stamp)" | tee -a "$OUT/session.log"
 waitslot 40 || exit 1
 
 if [ -z "${SKIP_PROFILES:-}" ]; then
-  echo "== profiles $(stamp)" | tee -a "$OUT/session.log"
-  timeout -k 30 900 python benchmarks/profile_layout.py \
-    > "$OUT/layout_ab.log" 2>&1
-  waitslot 10
-  timeout -k 30 900 python benchmarks/profile_ce_sweep.py \
-    > "$OUT/ce_sweep.log" 2>&1
-  waitslot 10
-  timeout -k 30 1200 python benchmarks/profile_ablations2.py \
-    > "$OUT/ablations2.log" 2>&1
-  waitslot 10
-  timeout -k 30 900 python benchmarks/profile_gpt2.py \
-    > "$OUT/profile_gpt2.log" 2>&1
-  waitslot 10
+  prof layout_ab     900 python benchmarks/profile_layout.py
+  prof ce_sweep      900 python benchmarks/profile_ce_sweep.py
+  prof ablations2   1200 python benchmarks/profile_ablations2.py
+  prof profile_gpt2  900 python benchmarks/profile_gpt2.py
 fi
 
 if [ -z "${SKIP_ROWS:-}" ]; then
   # flagship re-measures first (post in-kernel-dropout / LN-bwd / dequant)
-  row gpt2
-  waitslot 10
-  row decode
-  waitslot 10
-  row sparse_longseq
-  waitslot 10
-  row infinity
-  waitslot 10
+  row gpt2 gpt2
+  waitslot 10 || exit 1
+  row decode decode
+  waitslot 10 || exit 1
+  row sparse_longseq sparse_longseq
+  waitslot 10 || exit 1
+  row infinity infinity
+  waitslot 10 || exit 1
 fi
 
-if [ -z "${SKIP_CAP:-}" ]; then
+if [ -z "${SKIP_CAP:-}" ] && ! done_skip capability; then
   echo "== infinity capability $(stamp)" | tee -a "$OUT/session.log"
   timeout -k 60 5400 python benchmarks/infinity_capability.py \
     > "$OUT/infinity_capability.log" 2>&1
@@ -87,18 +78,19 @@ if [ -z "${SKIP_CAP:-}" ]; then
       'import json,sys; json.loads(sys.stdin.read())' 2>/dev/null; then
     echo "$last" >> benchmarks/ladder_results.jsonl
     echo "$last" | tee -a "$OUT/session.log"
+    done_mark capability
   else
     echo "infinity_capability produced no JSON (see log)" \
       | tee -a "$OUT/session.log"
   fi
-  waitslot 10
+  waitslot 10 || exit 1
 fi
 
 if [ -z "${SKIP_OFFLOAD:-}" ]; then
   # wedge-prone rows last, with a wider watchdog for the slow tunnel
-  WATCHDOG=1500 ROWTIMEOUT=1700 row offload
-  waitslot 20
-  DS_BENCH_GAS=8 WATCHDOG=1500 ROWTIMEOUT=1700 row offload
+  WATCHDOG=1500 ROWTIMEOUT=1700 row offload offload
+  waitslot 20 || exit 1
+  DS_BENCH_GAS=8 WATCHDOG=1500 ROWTIMEOUT=1700 row offload_gas8 offload
   waitslot 20
 fi
 

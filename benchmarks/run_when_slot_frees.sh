@@ -1,24 +1,21 @@
 #!/bin/bash
-# Watcher: wait out the stale TPU claim (bounded subprocess probes, up to
-# ~2h), then run the kernel-parity lane and the session-3 measurement
-# pass back-to-back while the slot is ours.
+# Watcher: wait out the stale TPU claim / relay outage (bounded
+# subprocess probes, up to ~2h per invocation — watch_supervisor.sh
+# relaunches on exhaustion), then run the kernel-parity lane and the
+# session-3 measurement pass back-to-back while the slot is ours.
 set -u
 cd "$(dirname "$0")/.."
 OUT=benchmarks/session_r3
 mkdir -p "$OUT"
-stamp() { date -u +%FT%TZ; }
-probe() { timeout -k 10 75 python -c "import jax; jax.devices()[0]" \
-          > /dev/null 2>&1; }
+. benchmarks/slot_lib.sh
 echo "== watcher start $(stamp)" | tee -a "$OUT/session.log"
-ok=0
-for i in $(seq 1 160); do
-  if probe; then ok=1; echo "   slot ok after $i probe(s) [$(stamp)]" \
-      | tee -a "$OUT/session.log"; break; fi
-  sleep 45
-done
-[ $ok = 1 ] || { echo "   slot never freed [$(stamp)]" \
-    | tee -a "$OUT/session.log"; exit 1; }
-echo "== tests/tpu lane $(stamp)" | tee -a "$OUT/session.log"
-timeout -k 30 2700 python -m pytest tests/tpu -q -rs > "$OUT/tpu_tests.log" 2>&1
-tail -3 "$OUT/tpu_tests.log" | tee -a "$OUT/session.log"
+waitslot 160 || exit 1
+if ! done_skip tpu_lane; then
+  echo "== tests/tpu lane $(stamp)" | tee -a "$OUT/session.log"
+  if timeout -k 30 2700 python -m pytest tests/tpu -q -rs \
+      > "$OUT/tpu_tests.log" 2>&1; then
+    done_mark tpu_lane
+  fi
+  tail -3 "$OUT/tpu_tests.log" | tee -a "$OUT/session.log"
+fi
 exec bash benchmarks/run_round3_session3.sh

@@ -1,0 +1,28 @@
+# Shared TPU-slot helpers, sourced by the session/watcher scripts (one
+# copy of the probe/backoff logic — it has already been tuned three
+# times this round).  Callers set OUT before sourcing.
+
+stamp() { date -u +%FT%TZ; }
+
+probe() { timeout -k 10 75 python -c "import jax; jax.devices()[0]" \
+          > /dev/null 2>&1; }
+
+waitslot() {  # $1 = max probes (45 s apart + probe time); rc 1 = never freed
+  local max=${1:-40}
+  for i in $(seq 1 "$max"); do
+    if probe; then
+      echo "   slot ok after $i probe(s) [$(stamp)]" | tee -a "$OUT/session.log"
+      return 0
+    fi
+    sleep 45
+  done
+  echo "   slot NEVER freed after $max probes [$(stamp)]" \
+    | tee -a "$OUT/session.log"
+  return 1
+}
+
+# Stage markers: a supervisor re-run after a mid-session tunnel death
+# must not repeat finished stages (duplicate ladder rows, wasted chip
+# time).  done_mark/done_skip key on a stage name under $OUT/done/.
+done_mark() { mkdir -p "$OUT/done" && touch "$OUT/done/$1"; }
+done_skip() { [ -e "$OUT/done/$1" ]; }

@@ -1,0 +1,11 @@
+#!/bin/bash
+# Outer supervisor: the relay can stay down for hours (the session-1
+# outage lasted 8h+).  Re-launch the slot watcher until one run gets the
+# slot and completes the measurement session.
+cd "$(dirname "$0")/.."
+while true; do
+  bash benchmarks/run_when_slot_frees.sh && break
+  echo "== watcher exhausted, relay still down; restarting $(date -u +%FT%TZ)" \
+    >> benchmarks/session_r3/session.log
+  sleep 120
+done

@@ -38,16 +38,33 @@ def _takes_no_value(flag):
     return False
 
 
-def _positional_paths(argv, cwd):
+# Flags KNOWN to take a value whose content is not a collection target —
+# their values are excluded from the veto scan below (e.g. `-k flash`
+# from inside tests/ must not resolve to tests/flash and veto the lane).
+_VALUE_FLAGS = {"-k", "-m", "-n", "-p", "-o", "-c", "-W", "--durations",
+                "--ignore", "--deselect", "--rootdir", "--confcutdir",
+                "--tb", "--maxfail", "--junitxml", "--color", "--capture",
+                "--basetemp", "--timeout", "--cov"}
+
+
+def _classified_paths(argv, cwd):
+    """Yield (path, is_positional) for each non-flag arg, resolved
+    against cwd (so `cd tests/tpu && pytest t.py`, `cd tests && pytest
+    tpu`, and repo-root invocations all classify by the directory the
+    arg actually points into).  An arg following an unknown flag is
+    treated as that flag's value: not positional, but still visible to
+    the veto scan (it might be a real collection target the parser
+    misjudged — e.g. `pytest tests/tpu --runxfail tests/unit/x.py`).
+    Values of KNOWN value-flags are dropped entirely."""
     prev = ""
     for a in argv:
-        if (not a.startswith("-")
-                and (not prev.startswith("-") or _takes_no_value(prev))):
-            # resolve against cwd so `cd tests/tpu && pytest t.py`,
-            # `cd tests && pytest tpu`, and repo-root invocations all
-            # classify by the directory the arg actually points into
-            yield os.path.normpath(
-                os.path.join(cwd, a.split("::", 1)[0]))
+        if not a.startswith("-"):
+            positional = (not prev.startswith("-")
+                          or _takes_no_value(prev))
+            known_value = prev in _VALUE_FLAGS
+            if not known_value:
+                yield (os.path.normpath(
+                    os.path.join(cwd, a.split("::", 1)[0])), positional)
         prev = a
 
 
@@ -56,9 +73,16 @@ def _under(path, root):
 
 
 _cwd = os.getcwd()
-_paths = list(_positional_paths(sys.argv[1:], _cwd))
+_classified = list(_classified_paths(sys.argv[1:], _cwd))
+_paths = [p for p, pos in _classified if pos]
 _tpu_refs = [p for p in _paths if _under(p, _TPU_DIR)]
-_other_tests_refs = [p for p in _paths
+# Asymmetric on purpose: affirming the tpu lane requires a strict
+# positional, vetoing it only requires any scanned arg (positional OR
+# unknown-flag value) to name a non-tpu tests path — unknown-flag
+# mistakes then always fall toward the CPU sim (where the tpu dir skips
+# itself visibly), never toward running the unit suite on a real
+# backend.
+_other_tests_refs = [p for p, _pos in _classified
                      if _under(p, _TESTS_DIR) and not _under(p, _TPU_DIR)]
 _tpu_lane_only = (
     bool(_tpu_refs) or (_under(_cwd, _TPU_DIR) and not _paths)

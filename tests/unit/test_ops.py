@@ -482,6 +482,32 @@ def test_fused_dequant_matmul_grad():
                                rtol=1e-3, atol=1e-2)
 
 
+def test_fused_dequant_matmul_scale_grad():
+    """The fused path's scale cotangent matches autodiff through the XLA
+    dequant path — learned scales get identical gradients on both
+    backends (round-3 review finding: it used to be silently zero)."""
+    from deepspeed_tpu.ops.quant import (QuantizedWeight, _fused_dq,
+                                         dequant)
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.standard_normal((8, 128)).astype(np.float32))
+    qw = jnp.asarray(rng.randint(-127, 128, (128, 256)).astype(np.int8))
+    scale = jnp.asarray(rng.uniform(0.05, 0.2, (4, 1)).astype(np.float32))
+
+    import deepspeed_tpu.ops.quant as qmod
+    import functools as ft
+    orig = qmod.fused_dequant_matmul
+    qmod.fused_dequant_matmul = ft.partial(orig, interpret=True)
+    try:
+        ds1 = jax.grad(lambda s: jnp.sum(
+            _fused_dq(x, qw, s) ** 2))(scale)
+    finally:
+        qmod.fused_dequant_matmul = orig
+    ds2 = jax.grad(lambda s: jnp.sum(
+        (x @ dequant(QuantizedWeight(qw, s), jnp.float32)) ** 2))(scale)
+    np.testing.assert_allclose(np.asarray(ds1), np.asarray(ds2),
+                               rtol=1e-3, atol=1e-2)
+
+
 def test_dequantize_weight_delegates():
     from deepspeed_tpu.runtime.weight_quantizer import (quantize_weight,
                                                         dequantize_weight)
