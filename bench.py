@@ -756,9 +756,32 @@ def main():
         print(f"[bench] slot ok after {slot_wait:.0f}s: {info}",
               file=sys.stderr, flush=True)
 
+    # A kernel that compiles in interpret mode can still fail Mosaic on
+    # whatever chip generation the driver runs (seen round 3: prng_seed
+    # arity, BlockSpec layout rules).  A degraded-but-real number beats a
+    # 0.0 diagnostic, so on a compile-shaped failure retry ONCE with all
+    # Pallas kernels routed to their XLA fallbacks, and say so in the
+    # payload.
+    degraded = None
     try:
         devs = _init_backend()
-        payload = BENCHES[args.config]()
+        try:
+            payload = BENCHES[args.config]()
+        except Exception as e:  # noqa: BLE001 — maybe kernel-compile
+            err = f"{type(e).__name__}: {e}"
+            compile_shaped = any(s in err for s in
+                                 ("Mosaic", "pallas", "Pallas",
+                                  "remote_compile"))
+            if not compile_shaped:
+                raise
+            from deepspeed_tpu.ops.dispatch import force_xla_kernels
+            force_xla_kernels(True)
+            degraded = f"pallas kernels disabled after: {err[:300]}"
+            print(f"[bench] degraded retry (XLA kernels): {err[:200]}",
+                  file=sys.stderr, flush=True)
+            payload = BENCHES[args.config]()
+        if degraded:
+            payload["degraded"] = degraded
         payload["platform"] = devs[0].platform
         payload["device_kind"] = devs[0].device_kind
         if slot_wait > 60:
