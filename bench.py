@@ -243,6 +243,48 @@ def bench_gpt2():
     }
 
 
+def bench_smoke():
+    """Tiny end-to-end smoke row (2-layer GPT-2-shape, seq 128): exercises
+    the full bench main path — backend init, engine build, compiled
+    train loop, JSON contract — in under a minute on any backend.  For
+    CI and verify drives; NOT a performance anchor (vs_baseline 0)."""
+    import jax
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT2Config, GPT2Model
+
+    batch, seq = 4, 128
+    cfg = GPT2Config(n_positions=seq, hidden_size=128, num_layers=2,
+                     num_heads=4, vocab_size=2048, bf16=True)
+    model = GPT2Model(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    config = {
+        "train_micro_batch_size_per_gpu": batch,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config,
+                                    model_parameters=params)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32)
+
+    def step():
+        loss = engine.forward(ids)
+        engine.backward(loss)
+        engine.step()
+        return loss
+
+    dt, final_loss, n = _time_steps(step, warmup=1, iters=5)
+    return {
+        "metric": "smoke_tiny_gpt2_train_tokens_per_sec",
+        "value": round(n * batch * seq / dt, 1),
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "final_loss": round(final_loss, 4),
+    }
+
+
 def bench_bert_z2():
     """BERT-large-class encoder, ZeRO-2, seq128 — BASELINE.md anchor row."""
     import jax
@@ -654,7 +696,8 @@ def bench_infinity():
     }
 
 
-BENCHES = {"gpt2": bench_gpt2, "bert_z2": bench_bert_z2,
+BENCHES = {"gpt2": bench_gpt2, "smoke": bench_smoke,
+           "bert_z2": bench_bert_z2,
            "decode": bench_decode, "moe": bench_moe,
            "gpt_moe": bench_gpt_moe,
            "longseq": bench_longseq, "sparse_longseq": bench_sparse_longseq,
@@ -662,6 +705,7 @@ BENCHES = {"gpt2": bench_gpt2, "bert_z2": bench_bert_z2,
            "infinity": bench_infinity}
 METRIC_NAMES = {  # error-path metric must match the success-path name
     "gpt2": ("gpt2_124m_train_tokens_per_sec_1chip", "tokens/s"),
+    "smoke": ("smoke_tiny_gpt2_train_tokens_per_sec", "tokens/s"),
     "bert_z2": ("bert_large_z2_samples_per_sec_1chip", "samples/s"),
     "decode": ("gpt2_124m_decode_tokens_per_sec_1chip", "tokens/s"),
     "moe": ("moe_top2_train_tokens_per_sec_1chip", "tokens/s"),
