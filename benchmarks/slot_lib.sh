@@ -10,6 +10,11 @@ probe() { timeout -k 10 75 python -c "import jax; jax.devices()[0]" \
 waitslot() {  # $1 = max probes (45 s apart + probe time); rc 1 = never freed
   local max=${1:-40}
   for i in $(seq 1 "$max"); do
+    if [ -e "$OUT/STOP" ]; then
+      echo "   STOP file present; ceding the slot [$(stamp)]" \
+        | tee -a "$OUT/session.log"
+      return 1
+    fi
     if probe; then
       echo "   slot ok after $i probe(s) [$(stamp)]" | tee -a "$OUT/session.log"
       return 0

@@ -2,10 +2,58 @@
 # Outer supervisor: the relay can stay down for hours (the session-1
 # outage lasted 8h+).  Re-launch the slot watcher until one run gets the
 # slot and completes the measurement session.
+#
+# DEADLINE: the driver's end-of-round bench needs the tunnel's single
+# slot.  Past the deadline (UTC HH:MM, default 14:05) stop claiming:
+# kill the in-flight session's whole process group, drop a STOP file
+# (which waitslot also honors), and exit — a partially measured ladder
+# beats starving the round-contract artifact.
 cd "$(dirname "$0")/.."
+OUT=benchmarks/session_r3
+mkdir -p "$OUT"
+DEADLINE="${DS_SESSION_DEADLINE:-14:05}"
+
+# a STOP from a previous day's deadline must not disable this run
+rm -f "$OUT/STOP"
+
+deadline_epoch=$(date -u -d "today $DEADLINE" +%s 2>/dev/null || echo 0)
+now=$(date -u +%s)
+if [ "$deadline_epoch" -le 0 ]; then
+  echo "== bad DS_SESSION_DEADLINE '$DEADLINE'; refusing to run unbounded" \
+    >> "$OUT/session.log"
+  exit 1
+fi
+if [ "$now" -ge "$deadline_epoch" ]; then
+  echo "== started past deadline $DEADLINE; not claiming the slot" \
+    >> "$OUT/session.log"
+  exit 0
+fi
+
+watcher_pgid=""
+(
+  sleep $((deadline_epoch - now))
+  touch "$OUT/STOP"
+  echo "== deadline $DEADLINE reached; releasing the slot for the driver" \
+    >> "$OUT/session.log"
+  # the watcher runs in its own process group (setsid below): killing
+  # the group covers every child — pytest, bench rows, profilers,
+  # infinity_capability — current and future
+  pgid=$(cat "$OUT/watcher.pgid" 2>/dev/null)
+  [ -n "$pgid" ] && kill -TERM -- "-$pgid" 2>/dev/null
+) &
+killer_pid=$!
+
 while true; do
-  bash benchmarks/run_when_slot_frees.sh && break
+  [ -e "$OUT/STOP" ] && break
+  setsid bash benchmarks/run_when_slot_frees.sh &
+  watcher_pid=$!
+  echo "$watcher_pid" > "$OUT/watcher.pgid"   # setsid: pid == pgid
+  if wait "$watcher_pid"; then break; fi
+  [ -e "$OUT/STOP" ] && break
   echo "== watcher exhausted, relay still down; restarting $(date -u +%FT%TZ)" \
-    >> benchmarks/session_r3/session.log
+    >> "$OUT/session.log"
   sleep 120
 done
+rm -f "$OUT/watcher.pgid"
+kill "$killer_pid" 2>/dev/null
+exit 0
