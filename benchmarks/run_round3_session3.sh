@@ -3,10 +3,11 @@
 # fixes to the session-2 kernels (in-kernel dropout seed arity, fused
 # dequant layout/dtype, bshd boundary conversion).
 #
-# Order: cheap profilers first (they also re-certify the fixed kernels
-# compile), then the re-measured flagship rows, then the never-measured
-# rows, with the wedge-prone offload rows last (device->host traffic
-# through the 0.02 GB/s tunnel is what wedged session 2).
+# Order: value-first for a possibly-short window — re-measured flagship
+# rows (gpt2/decode), the never-measured infinity row + beyond-HBM
+# capability demo, the real-hardware kernel lane, then the remaining
+# row, profilers, and the wedge-prone offload rows last (device->host
+# traffic through the 0.02 GB/s tunnel is what wedged session 2).
 #
 # Re-runnable: finished stages leave markers under $OUT/done/ and are
 # skipped, so the supervisor can relaunch this script after a mid-session
@@ -50,20 +51,13 @@ prof() {  # $1 = stage name, $2 = timeout, $3... = command
 echo "== session-3 start $(stamp)" | tee -a "$OUT/session.log"
 waitslot 40 || exit 1
 
-if [ -z "${SKIP_PROFILES:-}" ]; then
-  prof layout_ab     900 python benchmarks/profile_layout.py
-  prof ce_sweep      900 python benchmarks/profile_ce_sweep.py
-  prof ablations2   1200 python benchmarks/profile_ablations2.py
-  prof profile_gpt2  900 python benchmarks/profile_gpt2.py
-fi
-
+# Value order for a possibly-short window: flagship re-measures (the MFU
+# story), the never-measured infinity rows, THEN the kernel-parity lane,
+# remaining rows, profilers, and the wedge-prone offload rows last.
 if [ -z "${SKIP_ROWS:-}" ]; then
-  # flagship re-measures first (post in-kernel-dropout / LN-bwd / dequant)
   row gpt2 gpt2
   waitslot 10 || exit 1
   row decode decode
-  waitslot 10 || exit 1
-  row sparse_longseq sparse_longseq
   waitslot 10 || exit 1
   row infinity infinity
   waitslot 10 || exit 1
@@ -85,6 +79,29 @@ if [ -z "${SKIP_CAP:-}" ] && ! done_skip capability; then
   fi
   waitslot 10 || exit 1
 fi
+
+if ! done_skip tpu_lane; then
+  echo "== tests/tpu lane $(stamp)" | tee -a "$OUT/session.log"
+  if timeout -k 30 2700 python -m pytest tests/tpu -q -rs \
+      > "$OUT/tpu_tests.log" 2>&1; then
+    done_mark tpu_lane
+  fi
+  tail -3 "$OUT/tpu_tests.log" | tee -a "$OUT/session.log"
+  waitslot 10 || exit 1
+fi
+
+if [ -z "${SKIP_ROWS:-}" ]; then
+  row sparse_longseq sparse_longseq
+  waitslot 10 || exit 1
+fi
+
+if [ -z "${SKIP_PROFILES:-}" ]; then
+  prof layout_ab     900 python benchmarks/profile_layout.py
+  prof ce_sweep      900 python benchmarks/profile_ce_sweep.py
+  prof ablations2   1200 python benchmarks/profile_ablations2.py
+  prof profile_gpt2  900 python benchmarks/profile_gpt2.py
+fi
+
 
 if [ -z "${SKIP_OFFLOAD:-}" ]; then
   # wedge-prone rows last, with a wider watchdog for the slow tunnel

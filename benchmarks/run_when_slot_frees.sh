@@ -10,12 +10,7 @@ mkdir -p "$OUT"
 . benchmarks/slot_lib.sh
 echo "== watcher start $(stamp)" | tee -a "$OUT/session.log"
 waitslot 160 || exit 1
-if ! done_skip tpu_lane; then
-  echo "== tests/tpu lane $(stamp)" | tee -a "$OUT/session.log"
-  if timeout -k 30 2700 python -m pytest tests/tpu -q -rs \
-      > "$OUT/tpu_tests.log" 2>&1; then
-    done_mark tpu_lane
-  fi
-  tail -3 "$OUT/tpu_tests.log" | tee -a "$OUT/session.log"
-fi
+# the kernel-parity lane runs INSIDE session-3 (after the high-value
+# ladder rows) — when the relay returns late, the measured rows are
+# worth more than lane breadth
 exec bash benchmarks/run_round3_session3.sh
