@@ -1,100 +1,6 @@
 #!/bin/bash
-# Remaining round-3 measurement stages, run after the ladder's offload row
-# wedged the tunnel (stale claim poisoned infinity/gas8/tpu-tests).
-#
-# Discipline learned from that wedge:
-#   - wait for the relay to reap the stale claim BEFORE each stage
-#     (bounded subprocess probes — a hung jax.devices() cannot be
-#     interrupted in-process);
-#   - order stages so the wedge-prone offload rows (device<->host traffic
-#     through the 0.02 GB/s tunnel) run LAST;
-#   - every stage under `timeout` with TERM-first.
-set -u
-cd "$(dirname "$0")/.."
-OUT=benchmarks/session_r3
-mkdir -p "$OUT"
-stamp() { date -u +%FT%TZ; }
-
-probe() { timeout -k 10 75 python -c "import jax; jax.devices()[0]" \
-          > /dev/null 2>&1; }
-
-waitslot() {  # $1 = max probes (45 s apart + probe time)
-  local max=${1:-40}
-  for i in $(seq 1 "$max"); do
-    if probe; then
-      echo "   slot ok after $i probe(s) [$(stamp)]" | tee -a "$OUT/session.log"
-      return 0
-    fi
-    sleep 45
-  done
-  echo "   slot NEVER freed after $max probes [$(stamp)]" \
-    | tee -a "$OUT/session.log"
-  return 1
-}
-
-row() {  # $1 = config, extra env via caller; appends to ladder_results.jsonl
-  echo "== row $1 $(stamp)" | tee -a "$OUT/session.log"
-  DS_BENCH_WATCHDOG="${WATCHDOG:-1200}" DS_BENCH_RUN_MARGIN=700 \
-    timeout -k 30 "${ROWTIMEOUT:-1300}" python bench.py --config "$1" \
-    2>/dev/null | tail -1 | tee -a benchmarks/ladder_results.jsonl
-}
-
-echo "== remainder session start $(stamp)" | tee -a "$OUT/session.log"
-waitslot 40 || exit 1
-
-if [ -z "${SKIP_TPUTESTS:-}" ]; then
-  echo "== tests/tpu kernel-parity lane $(stamp)" | tee -a "$OUT/session.log"
-  timeout -k 30 2400 python -m pytest tests/tpu -q > "$OUT/tpu_tests.log" 2>&1
-  tail -2 "$OUT/tpu_tests.log" | tee -a "$OUT/session.log"
-  waitslot 10
-fi
-
-if [ -z "${SKIP_PROFILES:-}" ]; then
-  echo "== profiles $(stamp)" | tee -a "$OUT/session.log"
-  timeout -k 30 900 python benchmarks/profile_layout.py \
-    > "$OUT/layout_ab.log" 2>&1
-  waitslot 10
-  timeout -k 30 900 python benchmarks/profile_ce_sweep.py \
-    > "$OUT/ce_sweep.log" 2>&1
-  waitslot 10
-  timeout -k 30 1200 python benchmarks/profile_ablations2.py \
-    > "$OUT/ablations2.log" 2>&1
-  waitslot 10
-  timeout -k 30 900 python benchmarks/profile_gpt2.py \
-    > "$OUT/profile_gpt2.log" 2>&1
-  waitslot 10
-fi
-
-if [ -z "${SKIP_ROWS:-}" ]; then
-  row sparse_longseq
-  waitslot 10
-  row infinity
-  waitslot 10
-fi
-
-if [ -z "${SKIP_CAP:-}" ]; then
-  echo "== infinity capability $(stamp)" | tee -a "$OUT/session.log"
-  timeout -k 60 5400 python benchmarks/infinity_capability.py \
-    > "$OUT/infinity_capability.log" 2>&1
-  last=$(tail -1 "$OUT/infinity_capability.log")
-  if echo "$last" | python -c \
-      'import json,sys; json.loads(sys.stdin.read())' 2>/dev/null; then
-    echo "$last" >> benchmarks/ladder_results.jsonl
-    echo "$last" | tee -a "$OUT/session.log"
-  else
-    echo "infinity_capability produced no JSON (see log)" \
-      | tee -a "$OUT/session.log"
-  fi
-  waitslot 10
-fi
-
-if [ -z "${SKIP_OFFLOAD:-}" ]; then
-  # wedge-prone rows last, with a wider watchdog for the slow tunnel
-  WATCHDOG=1500 ROWTIMEOUT=1700 row offload
-  waitslot 20
-  DS_BENCH_GAS=8 WATCHDOG=1500 ROWTIMEOUT=1700 row offload
-  waitslot 20
-fi
-
-python benchmarks/render_results.py | tee -a "$OUT/session.log"
-echo "== remainder session done $(stamp)" | tee -a "$OUT/session.log"
+# SUPERSEDED (kept for the session-2 log trail): the live measurement
+# entry point is benchmarks/watch_supervisor.sh, which waits out tunnel
+# outages and runs benchmarks/run_round3_session3.sh (marker-resumable,
+# deadline-guarded).  This wrapper just delegates.
+exec bash "$(dirname "$0")/run_round3_session3.sh" "$@"

@@ -1,63 +1,6 @@
 #!/bin/bash
-# Round-3 TPU measurement session — run ONCE when the tunnel slot works.
-# Strictly serial (one claim at a time); every stage logs to
-# benchmarks/session_r3/ and is individually skippable via env.
-#
-#   SKIP_LADDER=1 SKIP_TPUTESTS=1 SKIP_CAP=1 SKIP_PROFILES=1
-#
-# Order: the LADDER first (the round-contract numbers — in case the
-# tunnel dies again), then kernel parity, then profiling for the MFU
-# push, then the long infinity capability run last (it monopolizes the
-# tunnel for ~20-40 min).
-set -u
-cd "$(dirname "$0")/.."
-OUT=benchmarks/session_r3
-mkdir -p "$OUT"
-
-stamp() { date -u +%FT%TZ; }
-
-if [ -z "${SKIP_LADDER:-}" ]; then
-  echo "== [$(stamp)] bench ladder" | tee -a "$OUT/session.log"
-  bash benchmarks/run_ladder.sh 2> "$OUT/ladder.stderr"
-  python benchmarks/render_results.py | tee -a "$OUT/session.log"
-fi
-
-if [ -z "${SKIP_TPUTESTS:-}" ]; then
-  echo "== [$(stamp)] tests/tpu kernel-parity lane" | tee -a "$OUT/session.log"
-  timeout -k 30 1800 python -m pytest tests/tpu -q \
-    > "$OUT/tpu_tests.log" 2>&1
-  tail -2 "$OUT/tpu_tests.log" | tee -a "$OUT/session.log"
-fi
-
-if [ -z "${SKIP_PROFILES:-}" ]; then
-  echo "== [$(stamp)] profiles (MFU push)" | tee -a "$OUT/session.log"
-  timeout -k 30 900 python benchmarks/profile_layout.py \
-    > "$OUT/layout_ab.log" 2>&1
-  timeout -k 30 900 python benchmarks/profile_ce_sweep.py \
-    > "$OUT/ce_sweep.log" 2>&1
-  timeout -k 30 1200 python benchmarks/profile_ablations2.py \
-    > "$OUT/ablations2.log" 2>&1
-  timeout -k 30 900 python benchmarks/profile_gpt2.py \
-    > "$OUT/profile_gpt2.log" 2>&1
-fi
-
-if [ -z "${SKIP_CAP:-}" ]; then
-  echo "== [$(stamp)] infinity capability (beyond-HBM)" \
-    | tee -a "$OUT/session.log"
-  timeout -k 60 5400 python benchmarks/infinity_capability.py \
-    > "$OUT/infinity_capability.log" 2>&1
-  last=$(tail -1 "$OUT/infinity_capability.log")
-  echo "$last" | tee -a "$OUT/session.log"
-  # append to the source of truth ONLY if the line is real JSON (a
-  # timeout/traceback tail must not pollute ladder_results.jsonl)
-  if echo "$last" | python -c 'import json,sys; json.loads(sys.stdin.read())' \
-      2>/dev/null; then
-    echo "$last" >> benchmarks/ladder_results.jsonl
-  else
-    echo "infinity_capability produced no JSON (see log)" \
-      | tee -a "$OUT/session.log"
-  fi
-  python benchmarks/render_results.py >> "$OUT/session.log" 2>&1
-fi
-
-echo "== [$(stamp)] session done" | tee -a "$OUT/session.log"
+# SUPERSEDED (kept because docs/ROUND3_NOTES.md references it): the live
+# measurement entry point is benchmarks/watch_supervisor.sh ->
+# run_round3_session3.sh (marker-resumable, deadline-guarded, shared
+# slot_lib.sh probe logic).  This wrapper just delegates.
+exec bash "$(dirname "$0")/run_round3_session3.sh" "$@"
