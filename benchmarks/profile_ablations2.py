@@ -71,6 +71,11 @@ def main():
     orig_attn = tr_mod.flash_attention
 
     # ---- 2x2 on unrolled + no dropout --------------------------------- #
+    # "pallasLN" must pin the Pallas LN impl explicitly: the dispatch
+    # default is now XLA (the winner of this very 2x2), so without
+    # set_ln_impl both LN cells would silently measure the same path.
+    from deepspeed_tpu.ops import dispatch as _dispatch
+    _prev_ln_impl = _dispatch._ln_impl
     for ln_name, ln_fn in (("pallasLN", pallas_ln),
                            ("xlaLN", nm_mod.layer_norm_reference)):
         for at_name, at_fn in (("pallasATTN", pallas_attn),
@@ -78,11 +83,14 @@ def main():
             tr_mod.fused_layer_norm = ln_fn
             gpt_mod.fused_layer_norm = ln_fn
             tr_mod.flash_attention = at_fn
+            if ln_name == "pallasLN":
+                _dispatch.set_ln_impl("pallas")
             try:
                 time_step(f"unrolled nodrop {ln_name} + {at_name}",
                           make(model, ids, deterministic=True),
                           params0, flops, iters=ITERS)
             finally:
+                _dispatch.set_ln_impl(_prev_ln_impl)
                 tr_mod.fused_layer_norm = orig_ln_tr
                 gpt_mod.fused_layer_norm = orig_ln_gpt
                 tr_mod.flash_attention = orig_attn

@@ -20,6 +20,15 @@ except ImportError:  # pragma: no cover
 
 _force_xla = bool(int(os.environ.get("DS_FORCE_XLA_OPS", "0")))
 
+# Per-op implementation preferences, where measurement picked a default
+# that differs from "pallas wherever possible".  LayerNorm: measured on
+# v5e (benchmarks/session_r3/ablations2.log, 2026-07-31) the XLA LN
+# beats the Pallas LN kernels by ~2 ms on the flagship step — XLA fuses
+# LN into neighboring elementwise work, which a pallas_call is opaque
+# to.  DS_LN_IMPL=pallas (or set_ln_impl) re-enables the kernels for
+# re-measurement on new hardware/toolchains.
+_ln_impl = os.environ.get("DS_LN_IMPL", "xla")
+
 
 def force_xla_kernels(on: bool = True) -> None:
     """Route all op dispatchers to their XLA reference paths (no Pallas)."""
@@ -31,3 +40,17 @@ def pallas_available() -> bool:
     """True when Pallas TPU kernels may be used in this process."""
     return (not _force_xla and pltpu is not None
             and jax.default_backend() == "tpu")
+
+
+def set_ln_impl(impl: str) -> None:
+    """Select the LayerNorm implementation: "xla" (measured default) or
+    "pallas" (the Pallas kernels, for re-measurement)."""
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"ln impl must be 'xla' or 'pallas', got {impl!r}")
+    global _ln_impl
+    _ln_impl = impl
+
+
+def ln_impl() -> str:
+    """Active LayerNorm implementation ("xla" wins under force_xla)."""
+    return "xla" if _force_xla else _ln_impl

@@ -171,7 +171,12 @@ def _fused_ln(x, gamma, beta, eps):
 
 
 def _fused_ln_usable(x) -> bool:
-    from .dispatch import pallas_available
+    # The default LN impl is XLA, by measurement — see dispatch.ln_impl
+    # (v5e: XLA LN beats the Pallas kernels by ~2 ms/step because a
+    # pallas_call is opaque to XLA's elementwise fusion).
+    from .dispatch import ln_impl, pallas_available
+    if ln_impl() != "pallas":
+        return False
     if not pallas_available():
         return False
     rows = int(np.prod(x.shape[:-1])) if x.ndim > 1 else 1
@@ -203,5 +208,8 @@ _fused_ln.defvjp(_fused_ln_fwd, _fused_ln_bwd)
 
 
 def fused_layer_norm(x, gamma, beta, eps: float = 1e-5):
-    """Differentiable fused LayerNorm (Pallas on TPU, XLA elsewhere)."""
+    """Differentiable fused LayerNorm.  Default implementation is the
+    XLA reference (the measured winner on v5e — see dispatch.ln_impl);
+    DS_LN_IMPL=pallas / dispatch.set_ln_impl("pallas") selects the
+    Pallas kernels."""
     return _fused_ln(x, gamma, beta, eps)

@@ -72,7 +72,11 @@ def test_flash_dispatcher_unaligned_length_falls_back():
         rtol=BF16_RTOL, atol=BF16_ATOL)
 
 
-def test_fused_layer_norm_fwd_bwd_parity_bf16():
+def test_fused_layer_norm_fwd_bwd_parity_bf16(monkeypatch):
+    """Compiled-Mosaic parity of the PALLAS LN kernels (they are no
+    longer the dispatch default — XLA LN measured faster — so this test
+    must select them explicitly or it compares XLA against XLA)."""
+    monkeypatch.setattr("deepspeed_tpu.ops.dispatch._ln_impl", "pallas")
     x = jax.random.normal(jax.random.PRNGKey(3), (8, 1024, 768),
                           jnp.bfloat16)
     w = jnp.ones((768,), jnp.float32) * 1.1

@@ -288,6 +288,7 @@ def test_fused_ln_bwd_dispatch_via_pallas(monkeypatch):
 
     from deepspeed_tpu.ops import normalize as nm
 
+    monkeypatch.setattr("deepspeed_tpu.ops.dispatch._ln_impl", "pallas")
     monkeypatch.setattr(
         "deepspeed_tpu.ops.dispatch.pallas_available", lambda: True)
     monkeypatch.setattr(
