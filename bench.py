@@ -156,6 +156,53 @@ def _emit(payload):
     sys.stdout.flush()
 
 
+def _last_measured(metric):
+    """Most recent real-chip row for `metric` from the canonical ladder.
+
+    A tunnel outage at driver-bench time must degrade to "stale but real
+    data", not an information-free 0.0 (the round-2/3 failure mode): the
+    failure JSON carries the last on-chip measurement, clearly labeled.
+    """
+    best = None
+    path = os.environ.get("DS_BENCH_LADDER") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "benchmarks", "ladder_results.jsonl")
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                value = row.get("value", 0)
+                # skip rows that are themselves stale fallbacks or
+                # diagnostics: a stale line appended to the ladder (e.g.
+                # by run_ladder.sh during an outage) must never be
+                # re-laundered as "the last on-chip measurement"
+                if (row.get("metric") == metric
+                        and isinstance(value, (int, float)) and value > 0
+                        and row.get("platform") == "tpu"
+                        and not row.get("stale")
+                        and not row.get("error")):
+                    best = row  # later lines win: the file is append-only
+    except OSError:
+        return None
+    if best is not None:
+        best["_source"] = path  # actual file read — honest provenance
+    return best
+
+
+def _git_head():
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10)
+        return out.stdout.strip() or None
+    except Exception:  # noqa: BLE001 — provenance is best-effort
+        return None
+
+
 def _peak_tflops():
     import jax
 
@@ -750,8 +797,24 @@ def main():
                 return
             finished.set()
             metric, unit = METRIC_NAMES[args.config]
-            _emit({"metric": metric, "value": 0.0, "unit": unit,
-                   "vs_baseline": 0.0, "error": reason})
+            _emit(_failure_payload(metric, unit, reason))
+
+    def _failure_payload(metric, unit, reason):
+        # Degrade to the last on-chip measurement (labeled stale), never
+        # to an information-free 0.0.
+        stale = _last_measured(metric)
+        if stale is None:
+            return {"metric": metric, "value": 0.0, "unit": unit,
+                    "vs_baseline": 0.0, "error": reason}
+        payload = dict(stale)
+        payload["stale"] = True
+        payload["stale_source"] = payload.pop("_source")
+        # provenance comes from the ROW; a row without a commit stamp
+        # stays unknown — stamping the current HEAD would claim this
+        # commit achieves a number measured under an older one
+        payload["stale_commit"] = payload.pop("commit", None)
+        payload["error"] = reason
+        return payload
 
     def _kill_probe():
         proc = _active_probe
@@ -813,9 +876,15 @@ def main():
             payload = BENCHES[args.config]()
         except Exception as e:  # noqa: BLE001 — maybe kernel-compile
             err = f"{type(e).__name__}: {e}"
+            # Compiler-origin markers only: a non-compile error that
+            # merely mentions "pallas" (the dispatcher's impl='pallas'
+            # ValueError, the "pallas TPU support unavailable"
+            # RuntimeError) must surface as the real configuration
+            # error, not trigger the degraded-XLA retry.
             compile_shaped = any(s in err for s in
-                                 ("Mosaic", "pallas", "Pallas",
-                                  "remote_compile"))
+                                 ("Mosaic", "mosaic", "remote_compile",
+                                  "pallas_call",
+                                  "Pallas TPU lowering"))
             if not compile_shaped:
                 raise
             from deepspeed_tpu.ops.dispatch import force_xla_kernels
@@ -828,6 +897,11 @@ def main():
             payload["degraded"] = degraded
         payload["platform"] = devs[0].platform
         payload["device_kind"] = devs[0].device_kind
+        # Provenance for the stale-fallback path: a future outage emits
+        # this row labeled with where/when it was actually measured.
+        payload["commit"] = _git_head()
+        payload["measured_at"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         if slot_wait > 60:
             payload["slot_wait_s"] = round(slot_wait, 1)
         with emit_lock:
@@ -842,14 +916,25 @@ def main():
                 return
             finished.set()
             metric, unit = METRIC_NAMES[args.config]
-            _emit({
+            # A raised exception is code-shaped, not outage-shaped: keep
+            # value 0.0 (a stale number here could mask a regression) but
+            # attach the last measurement so the record is never empty.
+            payload = {
                 "metric": metric,
                 "value": 0.0,
                 "unit": unit,
                 "vs_baseline": 0.0,
                 "error": f"{type(e).__name__}: {e}",
                 "traceback_tail": traceback.format_exc()[-2000:],
-            })
+            }
+            stale = _last_measured(metric)
+            if stale is not None:
+                payload["last_measured"] = {
+                    k: stale[k] for k in
+                    ("value", "unit", "vs_baseline", "commit",
+                     "measured_at") if k in stale}
+                payload["last_measured"]["stale"] = True
+            _emit(payload)
         sys.exit(0)  # diagnostic JSON emitted; don't mask it with rc!=0
 
 

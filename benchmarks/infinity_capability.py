@@ -156,8 +156,11 @@ def main():
     stream_bytes = 2 * param_bytes_bf16  # fwd + bwd re-stream (H2D)
     grad_bytes = param_bytes_bf16        # grads D2H
     tpuvm_step = (stream_bytes + grad_bytes) / 16e9
+    dev = jax.devices()[0]
     out = {
         "metric": "gpt_8b_infinity_capability_1chip",
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
         "value": round(tokens_per_sec, 3),
         "unit": "tokens/s",
         "vs_baseline": 0.0,

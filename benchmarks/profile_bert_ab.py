@@ -1,0 +1,90 @@
+"""bert_z2 step-level 2x2: {pallas,xla} LN x attention at seq 128.
+
+Round-3 left bert_z2 self-contradictory (263.5 samples/s in the canonical
+ladder vs a claimed in-round 319.1 at commit 3b87500) and below the 272
+samples/s baseline.  The suspect is kernel dispatch at the row's unusual
+shape — BERT-large at S=128 is LN-heavy relative to its matmuls and the
+flash kernel's 128-row tiles exactly span the whole sequence, so the
+winners measured on GPT-2 at S=1024 need not transfer.  This pins each
+cell explicitly, full train steps with state feedback, dropout ON (the
+bench row trains with dropout).
+"""
+
+import importlib
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from _harness import pallas_attn, time_step, xla_attn
+
+from deepspeed_tpu.models import BertConfig, BertModel
+
+nm_mod = importlib.import_module("deepspeed_tpu.ops.normalize")
+tr_mod = importlib.import_module("deepspeed_tpu.ops.transformer")
+
+BATCH = 32
+SEQ = 128
+ITERS = int(os.environ.get("DS_PROFILE_ITERS", 20))
+
+
+def main():
+    cfg = BertConfig(max_position_embeddings=SEQ, hidden_size=1024,
+                     num_layers=24, num_heads=16, bf16=True)
+    model = BertModel(cfg)
+    params0 = jax.tree.map(jnp.asarray,
+                           model.init_params(jax.random.PRNGKey(0)))
+    ids = jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, size=(BATCH, SEQ)), jnp.int32)
+    flops = BATCH * SEQ * cfg.flops_per_token(SEQ)
+    print(f"bert-large B={BATCH} S={SEQ} step model-FLOPs: "
+          f"{flops / 1e12:.2f} T  iters={ITERS}")
+
+    tx = optax.lamb(1e-3)  # the bench row optimizes with LAMB
+
+    def make(deterministic):
+        def factory(p):
+            state = (p, tx.init(p), jax.random.key(1, impl="rbg"))
+
+            @jax.jit
+            def step(state):
+                p, o, r = state
+                r, sub = jax.random.split(r)
+                loss, grads = jax.value_and_grad(lambda pp: model.mlm_loss(
+                    pp, None if deterministic else sub, ids, ids))(p)
+                updates, o = tx.update(grads, o, p)
+                return (optax.apply_updates(p, updates), o, r)
+
+            return step, state
+        return factory
+
+    orig_ln = tr_mod.fused_layer_norm
+    orig_attn = tr_mod.flash_attention
+    from deepspeed_tpu.ops import dispatch as _dispatch
+    _prev_ln_impl = _dispatch._ln_impl
+
+    for drop_name, det in (("drop", False), ("nodrop", True)):
+        for ln_name, ln_fn in (("xlaLN", nm_mod.layer_norm_reference),
+                               ("pallasLN", nm_mod.fused_layer_norm)):
+            for at_name, at_fn in (("pallasATTN", pallas_attn),
+                                   ("xlaATTN", xla_attn)):
+                tr_mod.fused_layer_norm = ln_fn
+                tr_mod.flash_attention = at_fn
+                _dispatch.set_ln_impl(
+                    "pallas" if ln_name == "pallasLN" else "xla")
+                try:
+                    time_step(f"bert {drop_name} {ln_name} + {at_name}",
+                              make(det), params0, flops, iters=ITERS)
+                finally:
+                    _dispatch.set_ln_impl(_prev_ln_impl)
+                    tr_mod.fused_layer_norm = orig_ln
+                    tr_mod.flash_attention = orig_attn
+
+
+if __name__ == "__main__":
+    main()

@@ -45,6 +45,11 @@ def load_rows():
             d = json.loads(line)
         except json.JSONDecodeError:
             continue
+        if d.get("stale"):
+            # a stale-fallback line (bench.py outage path) re-serves an
+            # OLD measurement — rendering it would overwrite the real
+            # row's entry with no visible difference
+            continue
         if "metric" in d:
             rows[d["metric"]] = d  # last wins
     return list(rows.values())

@@ -8,14 +8,28 @@
 # 30 s later as a last resort.
 cd "$(dirname "$0")/.."
 out=benchmarks/ladder_results.jsonl
+OUT=benchmarks  # for slot_lib's done-markers (unused here) and logs
+. benchmarks/slot_lib.sh
+
+append_row() {  # stale-fallback/diagnostic lines stay OUT of the ladder
+  local line
+  line=$(cat)
+  echo "$line"
+  if fresh_json "$line"; then
+    echo "$line" >> "$out"
+  else
+    echo "   (not a fresh chip measurement; not appended)" >&2
+  fi
+}
+
 for c in gpt2 bert_z2 moe gpt_moe decode longseq offload infinity; do
   echo "== $c $(date -u +%FT%TZ) ==" >&2
   DS_BENCH_WATCHDOG=1200 DS_BENCH_RUN_MARGIN=700 \
     timeout -k 30 1300 python bench.py --config "$c" \
-    2>/dev/null | tail -1 | tee -a "$out"
+    2>/dev/null | tail -1 | append_row
 done
 # offload amortization row: grads cross d2h only at the gas boundary
 echo "== offload gas=8 $(date -u +%FT%TZ) ==" >&2
 DS_BENCH_GAS=8 DS_BENCH_WATCHDOG=1200 DS_BENCH_RUN_MARGIN=700 \
   timeout -k 30 1300 python bench.py --config offload \
-  2>/dev/null | tail -1 | tee -a "$out"
+  2>/dev/null | tail -1 | append_row

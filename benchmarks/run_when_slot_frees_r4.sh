@@ -1,0 +1,11 @@
+#!/bin/bash
+# Round-4 slot watcher: wait out the stale claim / relay outage, then run
+# the measurement session while the slot is ours.
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/session_r4
+mkdir -p "$OUT"
+. benchmarks/slot_lib.sh
+echo "== watcher start $(stamp)" | tee -a "$OUT/session.log"
+waitslot 160 || exit 1
+exec bash benchmarks/run_round4_session.sh

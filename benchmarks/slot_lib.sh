@@ -31,3 +31,23 @@ waitslot() {  # $1 = max probes (45 s apart + probe time); rc 1 = never freed
 # time).  done_mark/done_skip key on a stage name under $OUT/done/.
 done_mark() { mkdir -p "$OUT/done" && touch "$OUT/done/$1"; }
 done_skip() { [ -e "$OUT/done/$1" ]; }
+
+# Freshness gate for the canonical ladder: only a valid, NON-STALE,
+# positive-value, real-chip JSON line may be appended.  bench.py's
+# outage path now re-emits old rows labeled stale:true — appending one
+# would launder old data as a new measurement (and a CPU-fallback run
+# slipping past the slot probe must not register as a chip number).
+fresh_json() {  # $1 = candidate line; rc 0 iff appendable
+  echo "$1" | python -c '
+import json, sys
+try:
+    row = json.loads(sys.stdin.read())
+except ValueError:
+    sys.exit(1)
+v = row.get("value", 0)
+ok = (not row.get("stale")
+      and isinstance(v, (int, float)) and v > 0
+      and row.get("platform") == "tpu")
+sys.exit(0 if ok else 1)
+' 2>/dev/null
+}

@@ -1,0 +1,52 @@
+#!/bin/bash
+# Round-4 outer supervisor: relaunch the slot watcher until a run
+# completes, releasing the slot at the deadline so the driver's
+# end-of-round bench can claim it.  Deadline is an absolute epoch
+# (DS_SESSION_DEADLINE_EPOCH) — the round can cross a UTC midnight, so
+# round 3's "today HH:MM" form is not enough.
+cd "$(dirname "$0")/.."
+OUT=benchmarks/session_r4
+mkdir -p "$OUT"
+
+rm -f "$OUT/STOP"
+
+deadline_epoch="${DS_SESSION_DEADLINE_EPOCH:-0}"
+now=$(date -u +%s)
+if [ "$deadline_epoch" -le "$now" ]; then
+  echo "== DS_SESSION_DEADLINE_EPOCH missing or in the past; refusing to" \
+       "run unbounded" >> "$OUT/session.log"
+  exit 1
+fi
+
+(
+  sleep $((deadline_epoch - now))
+  touch "$OUT/STOP"
+  echo "== deadline reached; releasing the slot for the driver $(date -u +%FT%TZ)" \
+    >> "$OUT/session.log"
+  pgid=$(cat "$OUT/watcher.pgid" 2>/dev/null)
+  [ -n "$pgid" ] && kill -TERM -- "-$pgid" 2>/dev/null
+) &
+killer_pid=$!
+
+while true; do
+  [ -e "$OUT/STOP" ] && break
+  setsid bash benchmarks/run_when_slot_frees_r4.sh &
+  watcher_pid=$!
+  echo "$watcher_pid" > "$OUT/watcher.pgid"   # setsid: pid == pgid
+  # the deadline killer may have fired in the spawn->pgid-write gap and
+  # TERMed a stale (or empty) pgid; re-check so a watcher started at the
+  # deadline edge cannot hold the slot past it
+  if [ -e "$OUT/STOP" ]; then
+    kill -TERM -- "-$watcher_pid" 2>/dev/null
+    wait "$watcher_pid" 2>/dev/null
+    break
+  fi
+  if wait "$watcher_pid"; then break; fi
+  [ -e "$OUT/STOP" ] && break
+  echo "== watcher exhausted, relay still down; restarting $(date -u +%FT%TZ)" \
+    >> "$OUT/session.log"
+  sleep 120
+done
+rm -f "$OUT/watcher.pgid"
+kill "$killer_pid" 2>/dev/null
+exit 0

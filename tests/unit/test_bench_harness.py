@@ -78,6 +78,17 @@ def test_sigterm_emits_one_diagnostic_json_line():
     env["JAX_PLATFORMS"] = "cpu"
     env["DS_BENCH_PROBE_PLATFORM"] = "no_such_platform"
     env["DS_BENCH_ITERS"] = "1"
+    # hermetic ladder: the stale-fallback assertion must not depend on
+    # the repo's live (mutable, rotatable) results log
+    import tempfile
+    ladder = tempfile.NamedTemporaryFile(
+        "w", suffix=".jsonl", delete=False)
+    ladder.write(json.dumps(
+        {"metric": "gpt2_124m_train_tokens_per_sec_1chip",
+         "value": 99999.0, "unit": "tokens/s", "vs_baseline": 1.3,
+         "platform": "tpu", "commit": "abc1234"}) + "\n")
+    ladder.close()
+    env["DS_BENCH_LADDER"] = ladder.name
     proc = subprocess.Popen(
         [sys.executable, str(REPO / "bench.py"), "--config", "gpt2"],
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
@@ -88,9 +99,45 @@ def test_sigterm_emits_one_diagnostic_json_line():
     lines = [l for l in out.strip().splitlines() if l.strip()]
     assert len(lines) == 1, out
     payload = json.loads(lines[0])
+    os.unlink(ladder.name)
     assert payload["metric"] == "gpt2_124m_train_tokens_per_sec_1chip"
-    assert payload["value"] == 0.0
+    # outage-shaped failures degrade to the last on-chip measurement,
+    # clearly labeled stale — not to an information-free 0.0
+    assert payload["stale"] is True
+    assert payload["value"] == 99999.0
+    assert payload["stale_commit"] == "abc1234"
+    assert payload["stale_source"] == ladder.name  # the file actually read
     assert "signal" in payload["error"]
+
+
+def test_last_measured_picks_latest_tpu_row(tmp_path, monkeypatch):
+    """_last_measured returns the LAST real-chip row for the metric,
+    skipping cpu rows, zero-value rows, and junk lines."""
+    ladder = tmp_path / "benchmarks" / "ladder_results.jsonl"
+    ladder.parent.mkdir()
+    rows = [
+        {"metric": "m", "value": 1.0, "platform": "tpu"},
+        "not json at all",
+        {"metric": "m", "value": 0.0, "platform": "tpu"},   # failed run
+        {"metric": "m", "value": 7.0, "platform": "cpu"},   # not the chip
+        {"metric": "other", "value": 9.0, "platform": "tpu"},
+        {"metric": "m", "value": None, "platform": "tpu"},  # junk value
+        {"metric": "m", "value": "x", "platform": "tpu"},   # junk value
+        {"metric": "m", "value": 2.5, "platform": "tpu"},   # the winner
+        # stale fallbacks / diagnostics must never be re-laundered
+        {"metric": "m", "value": 9.9, "platform": "tpu", "stale": True},
+        {"metric": "m", "value": 8.8, "platform": "tpu",
+         "error": "watchdog"},
+    ]
+    ladder.write_text("\n".join(
+        r if isinstance(r, str) else json.dumps(r) for r in rows) + "\n")
+    monkeypatch.setenv("DS_BENCH_LADDER", str(ladder))
+    row = bench._last_measured("m")
+    assert row["value"] == 2.5
+    assert bench._last_measured("absent") is None
+    # no ladder file at all -> None (callers fall back to 0.0)
+    monkeypatch.setenv("DS_BENCH_LADDER", str(tmp_path / "missing.jsonl"))
+    assert bench._last_measured("m") is None
 
 
 def test_degraded_retry_on_mosaic_failure(monkeypatch, capsys):
@@ -157,6 +204,29 @@ def test_degraded_retry_on_mosaic_failure(monkeypatch, capsys):
     assert payload["value"] == 0.0
     assert "unrelated" in payload["error"]
     assert len(calls) == 1
+
+    # a message that merely MENTIONS pallas (dispatcher config errors)
+    # is not compile-shaped: no degraded retry, the real error surfaces
+    calls.clear()
+
+    def config_error_bench():
+        calls.append(1)
+        raise RuntimeError(
+            "impl='pallas' requested but pallas TPU support unavailable")
+
+    monkeypatch.setitem(bench.BENCHES, "gpt2", config_error_bench)
+    try:
+        with pytest.raises(SystemExit):
+            bench.main()
+    finally:
+        dispatch.force_xla_kernels(prev_force)
+        signal.signal(signal.SIGTERM, prev_term)
+        signal.signal(signal.SIGINT, prev_int)
+    out = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    payload = json.loads(out[-1])
+    assert payload["value"] == 0.0
+    assert "unavailable" in payload["error"]
+    assert len(calls) == 1  # no retry
 
 
 def test_time_steps_gas_alignment(monkeypatch):

@@ -86,3 +86,75 @@ def test_gpt2_engine_converges_bf16_with_dropout():
         engine.step()
         last = float(loss)
     assert last < 0.6, f"bf16+dropout config failed to learn: end {last:.3f}"
+
+
+# --------------------------------------------------------------------- #
+# Chip-scale tier (reference: tests/model/run_func_test.py:606 — real
+# runs diffed against stored baselines).  benchmarks/convergence_run.py
+# trains the flagship GPT-2 124M on the chip and stores its curve under
+# tests/baselines/; these tests gate regressions against that artifact.
+# --------------------------------------------------------------------- #
+import json
+import os
+import sys
+
+_BASELINE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "baselines",
+    "convergence_gpt2_124m.json")
+
+
+def _conv_mod():
+    bench_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "benchmarks")
+    sys.path.insert(0, bench_dir)
+    try:
+        import convergence_run
+    finally:
+        sys.path.remove(bench_dir)
+    return convergence_run
+
+
+def test_markov_floor_matches_brute_force():
+    """The analytic floor (mean true -log p(next|prev)) must equal a
+    brute-force per-transition lookup — the threshold the chip run is
+    judged against has to be trustworthy."""
+    cr = _conv_mod()
+    lang = cr.MarkovLanguage(vocab=64, n_succ=8, seed=7)
+    ids = lang.sample(4, 32, np.random.RandomState(3))
+    expect = []
+    for b in range(ids.shape[0]):
+        for t in range(1, ids.shape[1]):
+            prev, nxt = int(ids[b, t - 1]), int(ids[b, t])
+            p = sum(w for s, w in zip(lang.succ[prev], lang.row_probs)
+                    if s == nxt)
+            expect.append(-np.log(max(p, 1e-12)))
+    assert abs(lang.floor_nats(ids) - float(np.mean(expect))) < 1e-9
+    # and sampling really follows the table: every transition possible
+    assert np.isfinite(lang.floor_nats(ids))
+    assert lang.floor_nats(ids) < np.log(64)  # structured, not uniform
+
+
+def test_chip_convergence_baseline():
+    """Gate on the stored chip run: it must exist (after the first
+    measured round), be from real hardware, and show convergence to the
+    analytic-floor threshold."""
+    if not os.path.exists(_BASELINE):
+        import pytest as _pytest
+        _pytest.skip("no stored chip convergence baseline yet "
+                     "(benchmarks/convergence_run.py writes it)")
+    with open(_BASELINE) as f:
+        base = json.load(f)
+    assert base["platform"] == "tpu", "baseline must come from the chip"
+    assert base["converged"] is True
+    assert base["final_val_loss"] <= base["threshold_nats"]
+    # the curve must actually descend (no flat/NaN runs sneaking in)
+    first_val = base["val_curve"][0][1]
+    last_val = base["val_curve"][-1][1]
+    assert last_val < first_val - 1.0, (first_val, last_val)
+    # floor math is reproducible from the seed: re-derive and compare
+    cr = _conv_mod()
+    lang = cr.MarkovLanguage()
+    val_rng = np.random.RandomState(9999)
+    floors = [lang.floor_nats(lang.sample(cr.BATCH, cr.SEQ, val_rng))
+              for _ in range(cr.VAL_BATCHES)]
+    assert abs(float(np.mean(floors)) - base["analytic_floor_nats"]) < 2e-3
