@@ -39,8 +39,9 @@ class BertConfig:
     hidden_act: str = "gelu"         # HF BERT default: exact erf gelu
     initializer_range: float = 0.02
     bf16: bool = True
-    # attention kernel layout: "bhsd" (classic) or "bshd"
-    # (transpose-free; opt-in until Mosaic-measured)
+    # attention kernel layout: "bhsd" (classic) or "bshd" (API
+    # convenience; converts at the kernel boundary — a native bshd
+    # BlockSpec is Mosaic-illegal, measured round 3)
     attn_layout: str = "bhsd"
     pre_layer_norm: bool = True      # reference supports both (preln/postln)
     activation_checkpointing: bool = False

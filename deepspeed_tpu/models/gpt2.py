@@ -43,8 +43,9 @@ class GPT2Config:
     layer_norm_eps: float = 1e-5
     initializer_range: float = 0.02
     bf16: bool = True
-    # attention kernel layout: "bhsd" (classic) or "bshd"
-    # (transpose-free; opt-in until Mosaic-measured)
+    # attention kernel layout: "bhsd" (classic) or "bshd" (API
+    # convenience; converts at the kernel boundary — a native bshd
+    # BlockSpec is Mosaic-illegal, measured round 3)
     attn_layout: str = "bhsd"
     activation_checkpointing: bool = False
     sparse_attention: Optional[object] = None  # a SparsityConfig

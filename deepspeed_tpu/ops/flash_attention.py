@@ -115,12 +115,17 @@ def _dropout_keep(seed_ref, b, h, qi, ki, rate, block_q, block_k,
     k-block count.  The seed rides in BOTH values: with value 1 alone,
     sequential per-step seeds (the natural dropout_seed=step usage) would
     alias step s+1/head h with step s/head h+1 and recycle whole mask
-    patterns.  Mixing seed*40503 into value 2 breaks the alias: a
-    collision now needs seed' - seed == bh - bh' AND tile' - tile ==
-    (seed - seed')*40503, impossible while the per-head tile count stays
-    below 40503 (S < ~146k at the default 512x1024 blocks)."""
+    patterns.  Value 2 mixes the seed via a Knuth multiplicative hash in
+    uint32 — wraparound-defined, and an odd multiplier is a mod-2^32
+    bijection of the seed, so the anti-aliasing argument survives
+    arbitrary step counts (a plain seed*constant in int32 overflowed past
+    seed ~53k and silently voided it): a collision now needs
+    seed' - seed == bh - bh' AND tile' - tile == (seed - seed')*H mod
+    2^32, vanishingly unlikely while tile counts stay tiny vs 2^32."""
+    mix = (qi * num_k_blocks + ki).astype(jnp.uint32) + \
+        seed_ref[0].astype(jnp.uint32) * jnp.uint32(2654435761)
     pltpu.prng_seed(seed_ref[0] + b * pl.num_programs(1) + h,
-                    qi * num_k_blocks + ki + seed_ref[0] * 40503)
+                    jax.lax.bitcast_convert_type(mix, jnp.int32))
     bits = pltpu.prng_random_bits((block_q, block_k))
     threshold = np.uint32(min(int((1.0 - rate) * 2 ** 32), 2 ** 32 - 1))
     return bits.astype(jnp.uint32) < threshold

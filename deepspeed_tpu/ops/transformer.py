@@ -52,11 +52,11 @@ class DeepSpeedTransformerConfig:
     block_k: int = 1024
     # "auto" = Pallas flash when usable, XLA reference otherwise
     attn_impl: str = "auto"
-    # "bhsd" (default): classic [B,H,S,D] kernel layout with explicit
-    # head transposes.  "bshd": transpose-free — the kernel BlockSpecs
-    # index the head dim directly, saving two HBM passes per tensor per
-    # direction.  Opt-in until measured on real Mosaic (the (1,rows,1,d)
-    # tiling is interpret-verified but its compiled layout cost is not).
+    # "bhsd" (default): classic [B,H,S,D] kernel layout.  "bshd": API
+    # convenience for [B,S,H,D] callers — NOT transpose-free: a native
+    # bshd BlockSpec is Mosaic-illegal (measured round 3, v5e), so the
+    # layout converts at the Pallas boundary; the transposes are <1% of
+    # step traffic.
     attn_layout: str = "bhsd"
     # "gelu_new"/"gelu_pytorch_tanh" = tanh approx (the reference kernel's
     # flavor, gelu_kernels.cu:10); "gelu" = exact erf (HF BERT default)

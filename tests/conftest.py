@@ -45,6 +45,12 @@ _VALUE_FLAGS = {"-k", "-m", "-n", "-p", "-o", "-c", "-W", "--durations",
                 "--ignore", "--deselect", "--rootdir", "--confcutdir",
                 "--tb", "--maxfail", "--junitxml", "--color", "--capture",
                 "--basetemp", "--timeout", "--cov"}
+# --cov stays a value flag even though pytest-cov declares it nargs='?':
+# argparse still CONSUMES a following non-dash arg as the coverage
+# source, so in `pytest --cov tests/tpu` the path is never a collection
+# target (pytest collects the default paths) and dropping it matches
+# pytest's real parse.  Removing it would instead let the cov source in
+# `pytest tests/tpu --cov tests` veto the explicitly requested lane.
 
 
 def _classified_paths(argv, cwd):

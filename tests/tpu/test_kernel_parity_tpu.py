@@ -61,6 +61,44 @@ def test_flash_backward_parity_bf16():
             rtol=5e-2, atol=5e-2)
 
 
+def test_flash_pallas_non_lane_multiple_lengths():
+    """Lengths the block-fit logic ACCEPTS onto the Pallas path without
+    being 128-multiples (the advisor-r3 gap): a q length of 328 tiles as
+    one 41-sublane block (8-aligned, not lane-aligned) against k=1024,
+    and S=1152 self-attention tiles as 384x384 (non-power-of-2 blocks).
+    Interpret mode cannot validate these tilings under Mosaic."""
+    q, _, _ = _qkv(1, 2, 328, 64, jnp.bfloat16, seed=8)
+    _, k, v = _qkv(1, 2, 1024, 64, jnp.bfloat16, seed=9)
+    out = flash_attention(q, k, v, causal=False, impl="pallas")
+    ref = mha_reference(q, k, v, causal=False)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=BF16_RTOL, atol=BF16_ATOL)
+
+    q, k, v = _qkv(1, 2, 1152, 64, jnp.bfloat16, seed=10)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       impl="pallas").astype(jnp.float32)
+                       ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v,
+                                     causal=True).astype(jnp.float32) ** 2)
+
+    out = flash_attention(q, k, v, causal=True, impl="pallas")
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=BF16_RTOL, atol=BF16_ATOL)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=5e-2, atol=5e-2)
+
+
 def test_flash_dispatcher_unaligned_length_falls_back():
     """Non-lane-aligned lengths must take the XLA path (the advisor-r2
     alignment gate) and still be numerically right on TPU."""
