@@ -1,0 +1,211 @@
+"""1-bit Adam comm/compute cost, measured (VERDICT r3 #10).
+
+The reference claims ~5x end-to-end communication reduction for 1-bit
+Adam (docs/_posts/2020-09-09-onebit-adam-blog-post.md:85; the compressed
+stage itself moves 1 bit/element + per-chunk scales over NCCL).  This
+benchmark quantifies what OUR recast actually moves and costs:
+
+1. WIRE VOLUME (virtual 8-device CPU mesh, subprocess): compile the
+   dense-psum, full-width compressed, and int8-wire compressed allreduce
+   programs and sum the collective operand bytes straight from the
+   compiled HLO.  The honest headline: wire="full" moves full-width
+   sign*scale tensors (no win — psum cannot weight per-worker operands
+   post-cast); wire="int8" moves sign tensors in int8 lanes, a real 4x
+   vs fp32 (true 1-bit packing would need a bit-packed allgather whose
+   volume scales with world size — not a psum).
+2. DISPATCH COST (real chip): the compression arithmetic added to a
+   post-freeze optimizer step vs plain AdamW on a GPT-2-124M-sized
+   pytree — the single-chip overhead a user pays for enabling it.
+
+Emits ONE JSON line (last stdout line) with platform/device_kind from
+the real chip so the session runner's freshness gate accepts it.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WIRE_SUBPROC = r"""
+import json, re, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+sys.path.insert(0, "@REPO@")
+from deepspeed_tpu.parallel import initialize_mesh, reset_mesh_context
+from deepspeed_tpu.runtime.comm.compressed import compressed_allreduce_inner
+
+N = 1 << 22  # 4M fp32 elements per worker
+reset_mesh_context()
+ctx = initialize_mesh(data=-1)
+mesh = ctx.mesh
+W = ctx.data_parallel_world_size
+
+_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s32": 4,
+                "u32": 4, "s8": 1, "u8": 1, "pred": 1, "s16": 2, "u16": 2}
+_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+                "collective-permute", "all-to-all")
+
+
+def wire_bytes(fn, *args):
+    txt = jax.jit(fn).lower(*args).compile().as_text()
+    total = 0
+    for line in txt.splitlines():
+        s = line.strip()
+        # "%name = f32[4194304]{0} all-reduce(...)" (fusion bodies too)
+        m = re.match(r"^[%\w.-]+ = \(?([a-z]+\d*)\[([\d,]*)\]", s)
+        if not m:
+            continue
+        if not any(c + "(" in s for c in _COLLECTIVES):
+            continue
+        dt, dims = m.groups()
+        n = 1
+        for d in filter(None, dims.split(",")):
+            n *= int(d)
+        total += n * _DTYPE_BYTES.get(dt, 4)
+    return total
+
+
+x = jnp.zeros((W, N), jnp.float32)
+e = jnp.zeros_like(x)
+spec = P("data")
+
+
+def shmap(inner):
+    return jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec),
+                         out_specs=(spec, spec), check_vma=False)
+
+
+def dense(a, b):
+    return jax.lax.psum(a, "data")[None][0], b
+
+
+def full(a, b):
+    r, e2 = compressed_allreduce_inner(a[0], b[0], "data", wire="full")
+    return r[None], e2[None]
+
+
+def int8(a, b):
+    r, e2 = compressed_allreduce_inner(a[0], b[0], "data", wire="int8")
+    return r[None], e2[None]
+
+
+out = {
+    "dense_fp32_bytes": wire_bytes(shmap(dense), x, e),
+    "compressed_full_bytes": wire_bytes(shmap(full), x, e),
+    "compressed_int8_bytes": wire_bytes(shmap(int8), x, e),
+    "elements": N,
+    "world": W,
+}
+print(json.dumps(out))
+"""
+
+
+def measure_wire_volume():
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=8").strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c", _WIRE_SUBPROC.replace("@REPO@", _REPO)],
+        capture_output=True, text=True, timeout=600, env=env, cwd=_REPO)
+    if proc.returncode != 0:
+        raise RuntimeError(f"wire-volume subprocess failed:\n"
+                           f"{proc.stderr[-2000:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def measure_dispatch_cost():
+    """Post-freeze onebit_adam update vs plain AdamW on a 124M-ish tree,
+    timed on whatever backend this process sees (the chip, under the
+    session runner)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from deepspeed_tpu.runtime.comm.onebit import onebit_adam, OnebitState
+
+    rng = jax.random.PRNGKey(0)
+    # GPT-2-124M-shaped leaves: a dozen big matrices
+    shapes = [(50257, 768), (1024, 768)] + [(768, 3072), (3072, 768),
+                                            (768, 2304), (768, 768)] * 3
+    keys = jax.random.split(rng, len(shapes))
+    params = [jax.random.normal(k, s, jnp.float32) * 0.02
+              for k, s in zip(keys, shapes)]
+    grads = [jax.random.normal(k, s, jnp.float32) * 1e-3
+             for k, s in zip(keys, shapes)]
+    n_elems = sum(int(np.prod(s)) for s in shapes)
+
+    def timed(opt, state):
+        @jax.jit
+        def step(g, s, p):
+            u, s2 = opt.update(g, s, p)
+            return optax.apply_updates(p, u), s2
+
+        p2, s2 = step(grads, state, params)  # compile
+        jax.block_until_ready(p2)
+        iters = 20
+        t0 = time.perf_counter()
+        p2, s2 = params, state
+        for _ in range(iters):
+            p2, s2 = step(grads, s2, p2)
+        jax.block_until_ready(p2)
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    dense_opt = optax.adamw(1e-4)
+    onebit_opt = onebit_adam(1e-4, freeze_step=10)
+    ob_state = onebit_opt.init(params)
+    ob_state = OnebitState(jnp.asarray(100, jnp.int32), ob_state.m,
+                           ob_state.v, ob_state.error)  # post-freeze branch
+    dense_ms = timed(dense_opt, dense_opt.init(params))
+    onebit_ms = timed(onebit_opt, ob_state)
+    return dense_ms, onebit_ms, n_elems
+
+
+def main():
+    wire = measure_wire_volume()
+    dense_b = wire["dense_fp32_bytes"]
+    int8_b = wire["compressed_int8_bytes"]
+    full_b = wire["compressed_full_bytes"]
+
+    import jax
+    devs = jax.devices()
+    dense_ms, onebit_ms, n_elems = measure_dispatch_cost()
+
+    ratio = round(dense_b / int8_b, 3) if int8_b else 0.0
+    payload = {
+        "metric": "onebit_adam_int8_wire_compression_vs_fp32",
+        "value": ratio,
+        "unit": "x",
+        # reference's end-to-end comm-reduction claim for 1-bit Adam: 5x
+        "vs_baseline": round(ratio / 5.0, 3),
+        "wire_dense_fp32_bytes": dense_b,
+        "wire_compressed_full_bytes": full_b,
+        "wire_compressed_int8_bytes": int8_b,
+        "wire_full_ratio": round(dense_b / full_b, 3) if full_b else 0.0,
+        "optimizer_step_dense_ms": round(dense_ms, 3),
+        "optimizer_step_onebit_ms": round(onebit_ms, 3),
+        "dispatch_overhead_pct": round((onebit_ms - dense_ms)
+                                       / dense_ms * 100, 1),
+        "elements_timed": n_elems,
+        "platform": devs[0].platform,
+        "device_kind": devs[0].device_kind,
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    try:
+        payload["commit"] = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, cwd=_REPO).stdout.strip() or None
+    except Exception:  # noqa: BLE001
+        payload["commit"] = None
+    print(json.dumps(payload))
+
+
+if __name__ == "__main__":
+    main()

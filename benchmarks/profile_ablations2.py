@@ -103,6 +103,17 @@ def main():
                                   rng0=jax.random.key(1, impl="rbg")),
               params0, flops, iters=ITERS)
 
+    # ---- attention-dropout placement (round-4): in-kernel probability
+    # dropout (reference semantics, O(S^2) PRNG bits x3 kernels) vs ctx
+    # output dropout (O(S*d)).  Explains the r4 flagship regression
+    # hypothesis: 84.7 dropout-on vs 94.3 nodrop TFLOPS.
+    for dimpl in ("kernel", "ctx"):
+        cfg_d, model_d, params_d, ids_d, flops_d = build(
+            8, attn_dropout_impl=dimpl)
+        time_step(f"attn-dropout {dimpl}",
+                  make(model_d, ids_d, rng0=jax.random.key(3, impl="rbg")),
+                  params_d, flops_d, iters=ITERS)
+
     # ---- batch scaling -------------------------------------------------- #
     for batch in (16, 32):
         c2, m2, p2, ids2, fl2 = build(batch)

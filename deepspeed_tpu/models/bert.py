@@ -43,6 +43,7 @@ class BertConfig:
     # convenience; converts at the kernel boundary — a native bshd
     # BlockSpec is Mosaic-illegal, measured round 3)
     attn_layout: str = "bhsd"
+    attn_dropout_impl: str = "kernel"  # "kernel" (reference semantics) | "ctx" (cheaper)
     pre_layer_norm: bool = True      # reference supports both (preln/postln)
     activation_checkpointing: bool = False
     sparse_attention: Optional[object] = None  # a SparsityConfig
@@ -83,6 +84,7 @@ class BertConfig:
             activation=self.hidden_act,
             sparsity_config=self.sparse_attention,
             attn_layout=self.attn_layout,
+            attn_dropout_impl=self.attn_dropout_impl,
         )
 
     def num_params(self, include_embeddings: bool = True) -> int:

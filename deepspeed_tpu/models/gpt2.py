@@ -47,6 +47,7 @@ class GPT2Config:
     # convenience; converts at the kernel boundary — a native bshd
     # BlockSpec is Mosaic-illegal, measured round 3)
     attn_layout: str = "bhsd"
+    attn_dropout_impl: str = "kernel"  # "kernel" (reference semantics) | "ctx" (cheaper)
     activation_checkpointing: bool = False
     sparse_attention: Optional[object] = None  # a SparsityConfig
     tie_word_embeddings: bool = True
@@ -88,6 +89,7 @@ class GPT2Config:
             causal=True,
             sparsity_config=self.sparse_attention,
             attn_layout=self.attn_layout,
+            attn_dropout_impl=self.attn_dropout_impl,
         )
 
     def num_params(self, include_embeddings: bool = True) -> int:

@@ -115,17 +115,21 @@ def _dropout_keep(seed_ref, b, h, qi, ki, rate, block_q, block_k,
     k-block count.  The seed rides in BOTH values: with value 1 alone,
     sequential per-step seeds (the natural dropout_seed=step usage) would
     alias step s+1/head h with step s/head h+1 and recycle whole mask
-    patterns.  Value 2 mixes the seed via a Knuth multiplicative hash in
-    uint32 — wraparound-defined, and an odd multiplier is a mod-2^32
-    bijection of the seed, so the anti-aliasing argument survives
-    arbitrary step counts (a plain seed*constant in int32 overflowed past
-    seed ~53k and silently voided it): a collision now needs
-    seed' - seed == bh - bh' AND tile' - tile == (seed - seed')*H mod
-    2^32, vanishingly unlikely while tile counts stay tiny vs 2^32."""
-    mix = (qi * num_k_blocks + ki).astype(jnp.uint32) + \
-        seed_ref[0].astype(jnp.uint32) * jnp.uint32(2654435761)
+    patterns.  Value 2 mixes the seed with the Knuth multiplicative hash
+    (2654435761 == -1640531527 as an int32 bit pattern): int32 multiply
+    wraps mod 2^32 (MLIR arith has two's-complement semantics, no UB),
+    and under that wrap an odd multiplier is a bijection of the seed, so
+    the anti-aliasing argument holds for arbitrary step counts — unlike
+    the old seed*40503, whose argument silently broke once the product
+    first wrapped (seed ~53k).  A collision now needs seed'-seed ==
+    bh-bh' AND tile-tile' == (seed'-seed)*2654435761 mod 2^32 —
+    vanishingly unlikely while tile counts stay tiny vs 2^32.  All
+    arithmetic stays in plain int32: scalar casts/bitcasts are
+    Mosaic-illegal ('tpu.bitcast' needs vector operands — measured on
+    v5e, round 4)."""
     pltpu.prng_seed(seed_ref[0] + b * pl.num_programs(1) + h,
-                    jax.lax.bitcast_convert_type(mix, jnp.int32))
+                    qi * num_k_blocks + ki
+                    + seed_ref[0] * np.int32(-1640531527))
     bits = pltpu.prng_random_bits((block_q, block_k))
     threshold = np.uint32(min(int((1.0 - rate) * 2 ** 32), 2 ** 32 - 1))
     return bits.astype(jnp.uint32) < threshold

@@ -9,3 +9,5 @@ from .block_sparse_flash import (block_sparse_flash_attention,
 from .sparse_attention_utils import (extend_position_embedding,
                                      pad_to_block_size,
                                      unpad_sequence_output)
+from .matmul import MatMul, Softmax, block_coords
+from .bert_sparse_self_attention import BertSparseSelfAttention

@@ -1,0 +1,89 @@
+"""BERT-style sparse self-attention module.
+
+Reference: deepspeed/ops/sparse_attention/bert_sparse_self_attention.py:78
+— q/k/v Linear projections + SparseSelfAttention with the BERT attention
+mask as key_padding_mask, returning the merged [B, S, hidden] context.
+
+Functional-JAX form (init_params/apply) matching the repo's model
+convention; the q/k/v projections are plain matmuls so XLA fuses them
+with neighbors, and the attention itself dispatches through
+SparseSelfAttention (Pallas streaming kernel when the mask-free fast
+path applies, gather-einsum otherwise).
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .sparse_self_attention import SparseSelfAttention
+from .sparsity_config import FixedSparsityConfig, SparsityConfig
+
+
+class BertSparseSelfAttention:
+    """`BertSparseSelfAttention(config, sparsity_config)` — config needs
+    `hidden_size` and `num_attention_heads` (or `num_heads`), like the
+    reference's BERT config contract."""
+
+    def __init__(self, config, sparsity_config: Optional[SparsityConfig]
+                 = None, key_padding_mask_mode: str = "add"):
+        hidden = getattr(config, "hidden_size")
+        heads = getattr(config, "num_attention_heads",
+                        getattr(config, "num_heads", None))
+        if heads is None:
+            raise ValueError("config needs num_attention_heads/num_heads")
+        if hidden % heads:
+            raise ValueError(
+                f"The hidden size ({hidden}) is not a multiple of the "
+                f"number of attention heads ({heads})")
+        self.num_attention_heads = heads
+        self.attention_head_size = hidden // heads
+        self.all_head_size = hidden
+        if sparsity_config is None:
+            sparsity_config = FixedSparsityConfig(num_heads=heads)
+        if sparsity_config.num_heads != heads:
+            raise ValueError(
+                f"sparsity_config built for {sparsity_config.num_heads} "
+                f"heads, model has {heads}")
+        self.sparse_self_attention = SparseSelfAttention(
+            sparsity_config, key_padding_mask_mode=key_padding_mask_mode)
+
+    def init_params(self, rng):
+        h = self.all_head_size
+        ks = jax.random.split(rng, 3)
+        init = lambda k: (jax.random.normal(k, (h, h), jnp.float32)  # noqa: E731
+                          * 0.02)
+        return {
+            "query": {"kernel": init(ks[0]), "bias": jnp.zeros((h,))},
+            "key": {"kernel": init(ks[1]), "bias": jnp.zeros((h,))},
+            "value": {"kernel": init(ks[2]), "bias": jnp.zeros((h,))},
+        }
+
+    def _transpose_for_scores(self, x):
+        b, s, _ = x.shape
+        return x.reshape(b, s, self.num_attention_heads,
+                         self.attention_head_size).transpose(0, 2, 1, 3)
+
+    def apply(self, params, hidden_states, attention_mask=None):
+        """hidden_states [B, S, hidden]; attention_mask [B, S] routed as
+        the key-padding mask exactly like the reference forward
+        (bert_sparse_self_attention.py:78).  Its VALUES follow this
+        module's key_padding_mask_mode (reference softmax.py semantics):
+        the default 'add' expects an ADDITIVE mask (0 = keep, a large
+        negative like -10000 = pad — the HF/BERT extended-mask
+        convention); 'mul' expects 1 = keep / 0 = pad.  Returns the
+        dense [B, S, hidden] context."""
+        q = hidden_states @ params["query"]["kernel"] + \
+            params["query"]["bias"]
+        k = hidden_states @ params["key"]["kernel"] + params["key"]["bias"]
+        v = hidden_states @ params["value"]["kernel"] + \
+            params["value"]["bias"]
+        qh = self._transpose_for_scores(q)
+        kh = self._transpose_for_scores(k)
+        vh = self._transpose_for_scores(v)
+        ctx = self.sparse_self_attention(
+            qh, kh, vh, key_padding_mask=attention_mask)
+        b, _, s, _ = ctx.shape
+        return ctx.transpose(0, 2, 1, 3).reshape(b, s, self.all_head_size)
+
+    __call__ = apply

@@ -1,0 +1,261 @@
+"""Standalone block-sparse matmul (SDD / DSD / DDS) over a layout.
+
+Reference: deepspeed/ops/sparse_attention/matmul.py:749 `MatMul` — the
+Triton block-sparse GEMMs behind SparseSelfAttention (sdd: dense×dense →
+sparse scores; dsd: sparse×dense → dense context) plus the dds mode their
+backward uses.  The reference hand-writes forward + two backward kernels
+per mode and a LUT builder with segmenting/locks for the scatter.
+
+TPU recasting: the layout is static at trace time, so every mode compiles
+to gather → batched einsum (→ scatter for sdd): static shapes, MXU-sized
+[block × block] tiles, and XLA autodiff differentiates straight through —
+the reference's hand-written backward kernels and locking LUTs have no
+analog here because gather/einsum transpose mechanically.
+
+Sparse operand format (mirrors the reference's torch-blocksparse layout):
+``[B, nnz, block, block]`` where ``nnz = layout.sum()`` and row ``n``
+holds the block at the n-th nonzero of ``layout [H, nb, nb]`` in
+row-major (h, i, j) order — `block_coords` returns those coordinates.
+"""
+
+import functools
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def block_coords(layout: np.ndarray) -> Tuple[np.ndarray, ...]:
+    """(h, i, j) int32 coordinate arrays of layout's nonzeros, row-major —
+    the order of the sparse format's nnz dimension."""
+    layout = np.asarray(layout, bool)
+    hs, is_, js = np.nonzero(layout)
+    return hs.astype(np.int32), is_.astype(np.int32), js.astype(np.int32)
+
+
+def _group_index(layout: np.ndarray, transpose: bool
+                 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Per-(head, row-block) gather tables into the nnz dimension.
+
+    Returns (n_idx [H, nb, max_deg], other [H, nb, max_deg], valid): for
+    q-block i of head h, n_idx lists the positions in the nnz list of its
+    allowed blocks and `other` the k-block ids (transpose=False); with
+    transpose=True the grouping is by k-block j and `other` lists i."""
+    layout = np.asarray(layout, bool)
+    h, nb, _ = layout.shape
+    nnz_of = -np.ones_like(layout, np.int32)
+    nnz_of[np.nonzero(layout)] = np.arange(int(layout.sum()), dtype=np.int32)
+    lay = layout.transpose(0, 2, 1) if transpose else layout
+    deg = lay.sum(-1)
+    max_deg = max(int(deg.max()), 1)
+    n_idx = np.zeros((h, nb, max_deg), np.int32)
+    other = np.zeros((h, nb, max_deg), np.int32)
+    valid = np.zeros((h, nb, max_deg), bool)
+    for hh in range(h):
+        for i in range(nb):
+            cols = np.nonzero(lay[hh, i])[0]
+            other[hh, i, :len(cols)] = cols
+            n_idx[hh, i, :len(cols)] = (nnz_of[hh, cols, i] if transpose
+                                        else nnz_of[hh, i, cols])
+            valid[hh, i, :len(cols)] = True
+    return n_idx, other, valid
+
+
+class MatMul:
+    """`MatMul(layout, block, mode, trans_a, trans_b)` — API parity with
+    the reference's triton ops (matmul.py:749).
+
+    mode='sdd': c_sparse = a_dense @ b_dense at the layout's blocks
+                (a, b: [B, H, S, D]-style; trans flags transpose the last
+                two dims first, so the attention call sdd(q, k,
+                trans_b=True) computes q @ k^T).
+    mode='dsd': c_dense = a_sparse @ b_dense (trans_a transposes each
+                stored block AND the layout).
+    mode='dds': c_dense = a_dense @ b_sparse.
+    """
+
+    def __init__(self, layout, block: int, mode: str,
+                 trans_a: bool = False, trans_b: bool = False):
+        if mode not in ("sdd", "dsd", "dds"):
+            raise ValueError(f"mode={mode!r} not in sdd|dsd|dds")
+        self.layout = np.asarray(layout, bool)
+        if self.layout.ndim != 3:
+            raise ValueError("layout must be [H, nb, nb]")
+        self.block = int(block)
+        self.mode = mode
+        self.trans_a = trans_a
+        self.trans_b = trans_b
+        self.nnz = int(self.layout.sum())
+        hs, is_, js = block_coords(self.layout)
+        self._hs, self._is, self._js = (jnp.asarray(hs), jnp.asarray(is_),
+                                        jnp.asarray(js))
+        # row-grouped (and col-grouped) views for the dense-output modes
+        self._by_row = tuple(map(jnp.asarray,
+                                 _group_index(self.layout, False)))
+        self._by_col = tuple(map(jnp.asarray,
+                                 _group_index(self.layout, True)))
+
+    # ------------------------------------------------------------------ #
+    def _blocked(self, x, trans):
+        """[B, H, S, D] (optionally pre-transposing the trailing dims) ->
+        [B, H, nb, block, D]."""
+        if trans:
+            x = jnp.swapaxes(x, -1, -2)
+        b, h, s, d = x.shape
+        if s % self.block:
+            raise ValueError(f"S={s} not a multiple of block={self.block}")
+        return x.reshape(b, h, s // self.block, self.block, d)
+
+    def _sdd(self, a, b):
+        ab = self._blocked(a, self.trans_a)
+        bb = self._blocked(b, not self.trans_b)  # contract over D
+        if ab.shape[1] == 1:  # head-broadcast operands (reference allows)
+            ab = jnp.broadcast_to(ab, (ab.shape[0], self.layout.shape[0])
+                                  + ab.shape[2:])
+        if bb.shape[1] == 1:
+            bb = jnp.broadcast_to(bb, (bb.shape[0], self.layout.shape[0])
+                                  + bb.shape[2:])
+        a_g = ab[:, self._hs, self._is]          # [B, nnz, block, D]
+        b_g = bb[:, self._hs, self._js]          # [B, nnz, block, D]
+        return jnp.einsum("bnqd,bnkd->bnqk", a_g, b_g,
+                          preferred_element_type=jnp.float32
+                          ).astype(a.dtype)
+
+    def _dsd(self, a_sparse, b):
+        n_idx, other, valid = self._by_row if not self.trans_a \
+            else self._by_col
+        w = a_sparse
+        if self.trans_a:
+            w = jnp.swapaxes(w, -1, -2)
+        bb = self._blocked(b, self.trans_b)
+        h, nb, max_deg = n_idx.shape
+        w_g = w[:, n_idx]                  # [B, H, nb, deg, block, block]
+        w_g = jnp.where(valid[None, :, :, :, None, None], w_g, 0)
+        b_g = bb[:, jnp.arange(h)[:, None, None], other]
+        out = jnp.einsum("bhijqk,bhijkd->bhiqd", w_g, b_g,
+                         preferred_element_type=jnp.float32)
+        bsz, _, _, _, _, d = b_g.shape
+        return out.reshape(bsz, h, nb * self.block, d).astype(b.dtype)
+
+    def _dds(self, a, b_sparse):
+        # c[.., m, j·block+k] = sum_i a[.., m, i·block+q] · w[n(h,i,j),q,k]
+        n_idx, other, valid = self._by_col if not self.trans_b \
+            else self._by_row
+        w = b_sparse
+        if self.trans_b:
+            w = jnp.swapaxes(w, -1, -2)
+        a2 = a if not self.trans_a else jnp.swapaxes(a, -1, -2)
+        bsz, h, m, s = a2.shape
+        a_blk = a2.reshape(bsz, h, m, s // self.block, self.block)
+        a_g = a_blk[:, jnp.arange(h)[:, None, None], :, other]
+        # a_g: [H, nb_j, deg, B, m, block_q] (numpy-style advanced-index
+        # reordering); move batch back
+        a_g = jnp.moveaxis(a_g, 3, 0)      # [B, H, nb_j, deg, m, block_q]
+        w_g = w[:, n_idx]                  # [B, H, nb_j, deg, blk_q, blk_k]
+        w_g = jnp.where(valid[None, :, :, :, None, None], w_g, 0)
+        out = jnp.einsum("bhjimq,bhjiqk->bhjmk", a_g, w_g,
+                         preferred_element_type=jnp.float32)
+        nb = n_idx.shape[1]
+        out = jnp.moveaxis(out, 2, 3).reshape(bsz, h, m, nb * self.block)
+        return out.astype(a.dtype)
+
+    def __call__(self, a, b):
+        if self.mode == "sdd":
+            return self._sdd(a, b)
+        if self.mode == "dsd":
+            return self._dsd(a, b)
+        return self._dds(a, b)
+
+
+class Softmax:
+    """Block-sparse softmax with scale / rpe / key-padding / attention
+    masks — API parity with reference softmax.py:315 (same application
+    order as trsrc/softmax_fwd.tr: x·scale + rpe + kp_mask + attn_mask,
+    then a rowwise softmax over the row's allowed blocks).
+
+    x: the sparse format [B, nnz, block, block].
+    rpe: [S, S], [H, S, S] or [B, H, S, S] fp tensor, gathered at the
+         layout blocks and ADDED (reference loads it per (head, row,
+         col)).
+    key_padding_mask: [B, S] over keys; mode 'add' adds the values, mode
+         'mul' turns zero entries into -inf (softmax_fwd.tr:102).
+    attn_mask: [S, S]; same two modes.
+    Fully-masked rows produce 0 rather than the reference's NaN.
+    """
+
+    def __init__(self, layout, block: int):
+        self.layout = np.asarray(layout, bool)
+        self.block = int(block)
+        self.nnz = int(self.layout.sum())
+        self._by_row = tuple(map(jnp.asarray,
+                                 _group_index(self.layout, False)))
+
+    @functools.partial(jax.jit, static_argnames=("self", "kp_mode",
+                                                 "attn_mode", "have"))
+    def _impl(self, x, scale, rpe, kp, attn, kp_mode, attn_mode, have):
+        n_idx, other, valid = self._by_row
+        h, nb, max_deg = n_idx.shape
+        blk = self.block
+        bsz = x.shape[0]
+        w = x[:, n_idx].astype(jnp.float32)  # [B, H, nb, deg, bq, bk]
+        w = w * scale
+        heads = jnp.arange(h)[:, None, None]
+        if "rpe" in have:
+            r = rpe.astype(jnp.float32)
+            if r.ndim == 2:
+                r = r[None, None]
+            elif r.ndim == 3:
+                r = r[None]
+            rb = r.reshape(r.shape[0], r.shape[1], nb, blk, nb, blk)
+            rb = jnp.moveaxis(rb, 4, 3)  # [b?, h?, nb_i, nb_j, bq, bk]
+            rb = jnp.broadcast_to(rb, (rb.shape[0], h, nb, nb, blk, blk))
+            r_g = rb[:, heads, jnp.arange(nb)[None, :, None], other]
+            w = w + r_g                      # [B?, H, nb, deg, bq, bk]
+        if "kp" in have:
+            kpf = kp.astype(jnp.float32)
+            if kp_mode == "mul":
+                kpf = jnp.where(kpf == 0, -jnp.inf, 0.0)
+            kpb = kpf.reshape(bsz, nb, blk)
+            kp_g = kpb[:, other]             # [B, H, nb, deg, bk]
+            w = w + kp_g[:, :, :, :, None, :]
+        if "attn" in have:
+            am = attn.astype(jnp.float32)
+            if attn_mode == "mul":
+                am = jnp.where(am == 0, -jnp.inf, 0.0)
+            ab = am.reshape(nb, blk, nb, blk)
+            ab = jnp.moveaxis(ab, 2, 1)      # [nb_i, nb_j, bq, bk]
+            a_g = ab[jnp.arange(nb)[None, :, None], other]
+            w = w + a_g[None]
+        neg = jnp.float32(-1e30)
+        w = jnp.where(valid[None, :, :, :, None, None], w, neg)
+        w = jnp.maximum(w, neg)  # -inf + -inf stays finite for the max
+        flat = jnp.moveaxis(w, -2, -3)       # [B, H, nb, bq, deg, bk]
+        flat = flat.reshape(bsz, h, nb, blk, max_deg * blk)
+        m = jnp.max(flat, -1, keepdims=True)
+        p = jnp.exp(flat - m)
+        p = p * (flat > neg / 2)             # drop masked lanes exactly
+        denom = jnp.maximum(p.sum(-1, keepdims=True), 1e-30)
+        p = (p / denom).reshape(bsz, h, nb, blk, max_deg, blk)
+        p = jnp.moveaxis(p, -2, -3)          # [B, H, nb, deg, bq, bk]
+        # scatter back to the sparse format; padding entries route to a
+        # dummy slot so they cannot clobber real blocks
+        slot = jnp.where(valid, n_idx, self.nnz)
+        out = jnp.zeros((bsz, self.nnz + 1, blk, blk), x.dtype)
+        out = out.at[:, slot].set(p.astype(x.dtype))
+        return out[:, :self.nnz]
+
+    def __call__(self, x, scale=1.0, rpe=None, key_padding_mask=None,
+                 attn_mask=None, key_padding_mask_mode="add",
+                 attn_mask_mode="add"):
+        have = tuple(name for name, v in
+                     (("rpe", rpe), ("kp", key_padding_mask),
+                      ("attn", attn_mask)) if v is not None)
+        zero = jnp.zeros((), jnp.float32)
+        return self._impl(x, jnp.float32(scale),
+                          rpe if rpe is not None else zero,
+                          key_padding_mask if key_padding_mask is not None
+                          else zero,
+                          attn_mask if attn_mask is not None else zero,
+                          key_padding_mask_mode, attn_mask_mode, have)

@@ -60,9 +60,14 @@ def layout_to_gather_indices(layout: np.ndarray
     return _gather_core(layout, pad_last_valid=False, allow_empty_rows=False)
 
 
-@functools.partial(jax.jit, static_argnames=("block", "causal", "sm_scale"))
+@functools.partial(jax.jit, static_argnames=("block", "causal", "sm_scale",
+                                             "kp_mode", "attn_mode",
+                                             "have"))
 def _sparse_attention_impl(q, k, v, idx, valid, block: int,
-                           causal: bool, sm_scale: Optional[float]):
+                           causal: bool, sm_scale: Optional[float],
+                           rpe=None, key_padding_mask=None, attn_mask=None,
+                           kp_mode: str = "add", attn_mode: str = "add",
+                           have: tuple = ()):
     b, h, s, d = q.shape
     nb = s // block
     max_deg = idx.shape[-1]
@@ -79,6 +84,40 @@ def _sparse_attention_impl(q, k, v, idx, valid, block: int,
                         kg.astype(jnp.float32),
                         preferred_element_type=jnp.float32) * scale
 
+    # reference mask-application order (trsrc/softmax_fwd.tr): x·scale
+    # + rpe + key_padding_mask + attn_mask, then the masked softmax
+    if "rpe" in have:
+        r = rpe.astype(jnp.float32)
+        if r.ndim == 2:
+            r = r[None, None]
+        elif r.ndim == 3:
+            r = r[None]
+        rb = r.reshape(r.shape[0], r.shape[1], nb, block, nb, block)
+        rb = jnp.moveaxis(rb, 4, 3)          # [b?, h?, nb_i, nb_j, bq, bk]
+        rb = jnp.broadcast_to(rb,
+                              (rb.shape[0], h, nb, nb, block, block))
+        r_g = rb[:, heads, jnp.arange(nb)[None, :, None], idx]
+        scores = scores + jnp.moveaxis(r_g, -2, -3)  # -> [.., bq, deg, bk]
+    if "kp" in have:
+        kpf = key_padding_mask.astype(jnp.float32)
+        if kp_mode == "mul":
+            kpf = jnp.where(kpf == 0, DEFAULT_MASK_VALUE, 0.0)
+        kp_g = kpf.reshape(b, nb, block)[:, idx]     # [B, H, nb, deg, bk]
+        scores = scores + kp_g[:, :, :, None, :, :]  # broadcast over bq
+    if "attn" in have:
+        am = attn_mask.astype(jnp.float32)
+        if attn_mode == "mul":
+            am = jnp.where(am == 0, DEFAULT_MASK_VALUE, 0.0)
+        ab = am.reshape(nb, block, nb, block)
+        ab = jnp.moveaxis(ab, 2, 1)          # [nb_i, nb_j, bq, bk]
+        a_g = ab[jnp.arange(nb)[None, :, None], idx]  # [H, nb, deg, bq, bk]
+        scores = scores + jnp.moveaxis(a_g, -2, -3)[None]
+    if have:
+        # two stacked mul-mode masks would overflow fp32 to -inf and the
+        # exp below would then produce NaN on fully-masked rows; clamping
+        # keeps the max finite (same guard as matmul.Softmax)
+        scores = jnp.maximum(scores, DEFAULT_MASK_VALUE)
+
     mask = valid[:, :, None, :, None]         # [H, nb, 1, max_deg, 1]
     if causal:
         q_pos = (jnp.arange(nb)[:, None] * block +
@@ -94,7 +133,10 @@ def _sparse_attention_impl(q, k, v, idx, valid, block: int,
     flat = scores.reshape(b, h, nb, block, max_deg * block)
     m = jnp.max(flat, axis=-1, keepdims=True)
     p = jnp.exp(flat - m)
-    p = p * mask.reshape(1, h, nb, block, max_deg * block)
+    # exclude layout padding AND mul-mode-masked lanes (their scores sit
+    # at ~DEFAULT_MASK_VALUE); a fully-masked row then outputs 0 instead
+    # of the reference kernel's NaN
+    p = p * (flat > DEFAULT_MASK_VALUE / 2)
     l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
     p = (p / l).reshape(b, h, nb, block, max_deg, block)
 
@@ -116,10 +158,15 @@ class SparseSelfAttention:
     """
 
     def __init__(self, sparsity_config: SparsityConfig,
+                 key_padding_mask_mode: str = "add",
                  attn_mask_mode: str = "add", impl: str = "auto"):
         if impl not in ("auto", "pallas", "gather"):
             raise ValueError(f"impl={impl!r} not in auto|pallas|gather")
+        for mode in (key_padding_mask_mode, attn_mask_mode):
+            if mode not in ("add", "mul"):
+                raise ValueError(f"mask mode {mode!r} not in add|mul")
         self.sparsity_config = sparsity_config
+        self.key_padding_mask_mode = key_padding_mask_mode
         self.attn_mask_mode = attn_mask_mode
         self.impl = impl
         self._cache = {}
@@ -155,8 +202,18 @@ class SparseSelfAttention:
         return ok
 
     def __call__(self, q, k, v, causal: bool = False,
-                 sm_scale: Optional[float] = None):
-        """q, k, v: [B, H, S, D] -> [B, H, S, D]."""
+                 sm_scale: Optional[float] = None, rpe=None,
+                 key_padding_mask=None, attn_mask=None):
+        """q, k, v: [B, H, S, D] -> [B, H, S, D].
+
+        rpe / key_padding_mask / attn_mask follow the reference forward
+        (sparse_self_attention.py:105): rpe is [S, S] / [H, S, S] /
+        [B, H, S, S] added to the scores; key_padding_mask is [B, S]
+        over keys; attn_mask is [S, S]; each mask honors this module's
+        add/mul mode (softmax.py semantics).  Masked calls run on the
+        gather path — the Pallas streaming kernel covers the plain
+        layout+causal cases (impl='pallas' raises rather than silently
+        degrading)."""
         s = q.shape[2]
         block = self.sparsity_config.block
         _, idx, valid, flash_idx = self.layout_for(s)
@@ -164,6 +221,20 @@ class SparseSelfAttention:
             raise ValueError(
                 f"q has {q.shape[1]} heads, layout built for "
                 f"{self.sparsity_config.num_heads}")
+        have = tuple(name for name, t in
+                     (("rpe", rpe), ("kp", key_padding_mask),
+                      ("attn", attn_mask)) if t is not None)
+        if have:
+            if self.impl == "pallas":
+                raise ValueError(
+                    "impl='pallas': rpe/key_padding_mask/attn_mask run on "
+                    "the gather path — use impl='auto' or 'gather'")
+            return _sparse_attention_impl(
+                q, k, v, idx, valid, block, causal, sm_scale,
+                rpe=rpe, key_padding_mask=key_padding_mask,
+                attn_mask=attn_mask,
+                kp_mode=self.key_padding_mask_mode,
+                attn_mode=self.attn_mask_mode, have=have)
         if self._use_pallas():
             from .block_sparse_flash import block_sparse_flash_attention
             fidx, fvalid, tidx, tvalid = flash_idx

@@ -58,6 +58,10 @@ class DeepSpeedTransformerConfig:
     # layout converts at the Pallas boundary; the transposes are <1% of
     # step traffic.
     attn_layout: str = "bhsd"
+    # "kernel" = in-kernel attention-probability dropout (reference
+    # semantics, ~10% step cost at S=1024); "ctx" = cheap dropout on the
+    # attention output (different regularizer) — see __call__
+    attn_dropout_impl: str = "kernel"
     # "gelu_new"/"gelu_pytorch_tanh" = tanh approx (the reference kernel's
     # flavor, gelu_kernels.cu:10); "gelu" = exact erf (HF BERT default)
     activation: str = "gelu_new"
@@ -203,14 +207,20 @@ class DeepSpeedTransformerLayer:
             params["attn_qkvb"].astype(attn_in.dtype)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
-        # attention-probability dropout runs INSIDE the flash kernel
-        # (reference semantics: dropout_kernels.cu attn-dropout on the
-        # softmax output; saves the extra [B,S,H] mask pass the old
-        # ctx-level dropout cost).  Sparse attention keeps ctx-level
-        # dropout (its kernel has no PRNG path yet), so the seed draw
-        # lives in the dense branches only — r_attn is consumed exactly
-        # once on every path.
-        attn_rate = 0.0 if deterministic else cfg.attn_dropout_ratio
+        # attention dropout placement (attn_dropout_impl):
+        #   "kernel" (default) — probability dropout INSIDE the flash
+        #     kernel, the reference's semantics (dropout_kernels.cu
+        #     attn-dropout on the softmax output).  Costs O(S^2) PRNG
+        #     bits regenerated in all three kernels: measured ~10% of
+        #     the flagship step on v5e (94.3 nodrop vs 84.7 TFLOPS).
+        #   "ctx" — cheap dropout on the attention OUTPUT (O(S*d) bits,
+        #     one pass).  Different regularizer than the reference's;
+        #     choose it when dropout semantics need not match.
+        # Sparse attention always uses ctx dropout (its kernel has no
+        # PRNG path yet); r_attn is consumed exactly once on every path.
+        kernel_drop = cfg.attn_dropout_impl == "kernel"
+        attn_rate = (0.0 if deterministic or not kernel_drop
+                     else cfg.attn_dropout_ratio)
 
         def attn_seed():
             if attn_rate == 0.0:
@@ -218,16 +228,34 @@ class DeepSpeedTransformerLayer:
             return jax.random.randint(r_attn, (), 0, 2 ** 31 - 1, jnp.int32)
 
         if self._sparse_attn is not None:
+            # route the layer's additive mask into SparseSelfAttention's
+            # mask features (added round 4): [B,1,1,S] (key padding) ->
+            # key_padding_mask 'add'; [1,1,S,S] / [S,S] -> attn_mask
+            # 'add'.  A per-batch full [B,1,S,S] mask has no sparse
+            # analog (the reference softmax supports 2D attn masks only).
+            sparse_kp = sparse_am = None
             if attn_mask is not None:
-                raise NotImplementedError(
-                    "sparse attention with an additive attn_mask is not "
-                    "supported — fold padding into the layout instead")
+                if attn_mask.ndim == 4 and attn_mask.shape[1:3] == (1, 1):
+                    sparse_kp = attn_mask.reshape(attn_mask.shape[0], s)
+                elif (attn_mask.ndim == 4 and attn_mask.shape[0] == 1
+                      and attn_mask.shape[1] == 1):
+                    sparse_am = attn_mask.reshape(s, s)
+                elif attn_mask.ndim == 2:
+                    sparse_am = attn_mask
+                else:
+                    raise NotImplementedError(
+                        "sparse attention supports [B,1,1,S] key-padding "
+                        "or 2D [S,S] additive masks (reference "
+                        "softmax.py:attn_mask is 2D-only); got shape "
+                        f"{attn_mask.shape}")
 
             def to_heads(t):
                 return t.reshape(b, s, heads, d).transpose(0, 2, 1, 3)
 
             ctx = self._sparse_attn(to_heads(q), to_heads(k), to_heads(v),
-                                    causal=cfg.causal)
+                                    causal=cfg.causal,
+                                    key_padding_mask=sparse_kp,
+                                    attn_mask=sparse_am)
             ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h)
             ctx = dropout(ctx, cfg.attn_dropout_ratio, r_attn, deterministic)
         elif cfg.attn_layout == "bshd":
@@ -245,6 +273,9 @@ class DeepSpeedTransformerLayer:
                 impl=cfg.attn_impl, dropout_rate=attn_rate,
                 dropout_seed=attn_seed())
             ctx = ctx.reshape(b, s, h)
+            if not kernel_drop:
+                ctx = dropout(ctx, cfg.attn_dropout_ratio, r_attn,
+                              deterministic)
         else:
             def to_heads(t):
                 return t.reshape(b, s, heads, d).transpose(0, 2, 1, 3)
@@ -255,6 +286,9 @@ class DeepSpeedTransformerLayer:
                 impl=cfg.attn_impl, dropout_rate=attn_rate,
                 dropout_seed=attn_seed())
             ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h)
+            if not kernel_drop:
+                ctx = dropout(ctx, cfg.attn_dropout_ratio, r_attn,
+                              deterministic)
 
         attn_out = matmul_maybe_int8(ctx, params["attn_ow"])
         attn_out = bias_dropout_residual(

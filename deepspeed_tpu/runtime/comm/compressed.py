@@ -27,37 +27,70 @@ from ...parallel.mesh import DATA_AXIS
 
 
 def compressed_allreduce_inner(x: jnp.ndarray, error: jnp.ndarray,
-                               axis_name: str = DATA_AXIS
+                               axis_name: str = DATA_AXIS,
+                               wire: str = "full"
                                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """One error-compensated 1-bit allreduce step; call inside shard_map.
 
     x: this worker's tensor (e.g. local momentum update);
     error: carried compensation state (same shape).
     Returns (averaged_decompressed, new_error).
+
+    wire="full": per-worker scale, the psum moves a full-dtype sign*scale
+    tensor — same numerics as the reference's per-chunk scaling but NO
+    wire-width win (measured in benchmarks/onebit_cost.py; the XLA psum
+    cannot weight per-worker operands after an int8 cast).
+    wire="int8": the scale is first psum-averaged to a SHARED scalar, the
+    sign tensor then rides the wire as int8 (4x narrower than fp32; the
+    narrowest dtype XLA collectives move — true 1-bit packing would need
+    a bit-packed allgather whose volume scales with world size).  The
+    worker's error feedback absorbs the shared-scale approximation the
+    same way the reference's server-side error absorbs its second-stage
+    compression (runtime/comm/nccl.py:47).
     """
+    if wire not in ("full", "int8"):
+        raise ValueError(f"wire={wire!r} not in full|int8")
     world = lax.psum(1, axis_name)
     compensated = x + error
     # per-worker scale: mean magnitude preserves E[|x|] under sign compression
     # (reference uses norm/sqrt(numel) — same estimator family)
     scale = jnp.mean(jnp.abs(compensated))
-    compressed = scale * jnp.sign(compensated)
+    sign = jnp.sign(compensated)
+    if wire == "int8":
+        shared_scale = lax.psum(scale, axis_name) / world
+        summed = lax.psum(sign.astype(jnp.int8), axis_name)
+        reduced = shared_scale * summed.astype(x.dtype) / world
+        # what THIS worker contributed post-decompression
+        applied = shared_scale * sign
+        return reduced, compensated - applied
+    compressed = scale * sign
     new_error = compensated - compressed
     reduced = lax.psum(compressed, axis_name) / world
     return reduced, new_error
 
 
 def compressed_allreduce(x_stacked, error_stacked, mesh_ctx=None,
-                         axis_name: str = DATA_AXIS):
+                         axis_name: str = DATA_AXIS, wire: str = "full"):
     """Worker-stacked wrapper: x_stacked [W, ...] holds worker i's tensor in
     row i (sharded over the data axis).  Returns (reduced [W, ...] — every
-    row identical — and the new per-worker error stack)."""
+    row identical — and the new per-worker error stack).
+
+    wire="int8" needs world size <= 127 (the summed sign tensor rides in
+    int8 lanes)."""
     from ...parallel.mesh import get_mesh_context
     from jax.sharding import PartitionSpec as P
     ctx = mesh_ctx or get_mesh_context()
+    if wire == "int8":
+        world = ctx.mesh.shape.get(axis_name, 1)
+        if world > 127:
+            raise ValueError(
+                f"wire='int8' supports at most 127 workers on the "
+                f"{axis_name!r} axis (summed signs ride int8 lanes); "
+                f"mesh has {world} — use wire='full'")
     spec = P(axis_name)
 
     def inner(a, b):
-        r, e = compressed_allreduce_inner(a[0], b[0], axis_name)
+        r, e = compressed_allreduce_inner(a[0], b[0], axis_name, wire=wire)
         return r[None], e[None]
 
     fn = jax.shard_map(inner, mesh=ctx.mesh, in_specs=(spec, spec),

@@ -114,14 +114,50 @@ class PipelineEngine(DeepSpeedEngine):
 
         # schedule selection: kwarg > config "pipeline" block > 1F1B default
         # (the reference always trains with TrainSchedule — pipe/engine.py:287)
+        raw = getattr(cfg, "_param_dict", {}) or {}
         if schedule is None:
-            raw = getattr(cfg, "_param_dict", {}) or {}
             schedule = (raw.get("pipeline") or {}).get("schedule", "1f1b")
         if schedule not in ("1f1b", "gpipe"):
             raise ValueError(
                 f"pipeline schedule must be '1f1b' or 'gpipe', got "
                 f"{schedule!r}")
         self.schedule_kind = schedule
+        # gated (default): per-device lax.cond executor — executed ≈
+        # useful FLOPs, matching the reference's only-scheduled-work
+        # property (pipe/engine.py:1209).  "gated": false falls back to
+        # the branch-free masked-lane executor (~1.5x FLOPs at M >> S,
+        # schedule_efficiency) — one program for every device, no
+        # divergent control flow.
+        #
+        # TP limitation (measured on the 8-device mesh, round 4): with a
+        # model axis > 1, GSPMD places whole-mesh collectives INSIDE the
+        # divergent cond branches (the TP reductions of the stage body),
+        # and devices in different pipe rows then wait on different
+        # collectives — a rendezvous deadlock (4+4 split on collective
+        # permutes).  Until the stage body's collectives can be hoisted
+        # out of the gates, pipe×model meshes run the masked executor.
+        gated_cfg = (raw.get("pipeline") or {}).get("gated")
+        # any non-pipe axis whose collectives can appear in the stage
+        # body (TP reductions, sequence-parallel ppermutes) hits the
+        # same mechanism; data/expert grad reductions happen OUTSIDE the
+        # gates (out_specs / end-of-scan psums) and are safe — measured
+        # green at pipe×data on the 8-device mesh.
+        inbody_axes = (ctx.model_parallel_world_size > 1 or
+                       ctx.seq_parallel_world_size > 1)
+        if gated_cfg and inbody_axes:
+            raise ValueError(
+                "pipeline.gated=true cannot compose with model/seq "
+                "axes > 1: GSPMD places the stage body's collectives "
+                "(TP reductions, ring-attention permutes) inside the "
+                "divergent per-stage branches, which deadlocks — drop "
+                "the explicit gated flag to use the masked executor on "
+                "this mesh")
+        self.schedule_gated = (bool(gated_cfg)
+                               if gated_cfg is not None else not inbody_axes)
+        if inbody_axes and gated_cfg is None:
+            log_dist(
+                "PipelineEngine: masked 1F1B executor (gated executor "
+                "does not compose with model/seq axes yet)", ranks=[0])
         if schedule == "1f1b":
             # hand-scheduled fwd/bwd interleave: the base engine compiles
             # this program directly instead of value_and_grad
@@ -182,7 +218,7 @@ class PipelineEngine(DeepSpeedEngine):
     def _make_1f1b_program(self, ctx):
         """Build the 1F1B interleaved fwd/bwd program (one_f_one_b.py) —
         the compiled execution of schedule.py's TrainSchedule."""
-        from .one_f_one_b import make_1f1b_grad_fn
+        from .one_f_one_b import make_1f1b_grad_fn, make_gated_1f1b_grad_fn
 
         module = self.pipeline_module
         S = self.num_stages
@@ -223,10 +259,15 @@ class PipelineEngine(DeepSpeedEngine):
                 rng=jax.random.fold_in(rng_post, mb))
             return loss_fn(o, y_mb)
 
-        grad_fn = make_1f1b_grad_fn(
-            module=module, constrain=constrain, stage_apply=stage_apply,
-            pre_apply=pre_apply, post_loss=post_loss, micro_batches=M,
-            num_stages=S)
+        if self.schedule_gated:
+            grad_fn = make_gated_1f1b_grad_fn(
+                mesh=mesh, stage_apply=stage_apply, pre_apply=pre_apply,
+                post_loss=post_loss, micro_batches=M, num_stages=S)
+        else:
+            grad_fn = make_1f1b_grad_fn(
+                module=module, constrain=constrain, stage_apply=stage_apply,
+                pre_apply=pre_apply, post_loss=post_loss, micro_batches=M,
+                num_stages=S)
 
         def program(params, loss_scale, rng, x, y):
             xm = x.reshape((M, -1) + x.shape[1:])
