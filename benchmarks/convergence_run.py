@@ -105,7 +105,13 @@ def main():
     print(f"[conv] analytic val floor: {floor:.4f} nats "
           f"(target <= {floor + THRESH_MARGIN:.4f})", flush=True)
 
-    cfg = GPT2Config(n_positions=SEQ, bf16=True)  # GPT-2 124M
+    # DS_CONV_DROPOUT=0 disables dropout — the A/B probe for the r4
+    # unigram-plateau investigation (a broken in-kernel attention-dropout
+    # mask would cripple the training signal through attention while
+    # leaving deterministic eval untouched)
+    drop = float(os.environ.get("DS_CONV_DROPOUT", 0.1))
+    cfg = GPT2Config(n_positions=SEQ, bf16=True, embd_dropout=drop,
+                     attn_dropout=drop, hidden_dropout=drop)  # GPT-2 124M
     model = GPT2Model(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
     engine, _, _, _ = ds.initialize(

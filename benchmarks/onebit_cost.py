@@ -30,6 +30,7 @@ import time
 import numpy as np
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)  # run as `python benchmarks/onebit_cost.py`
 
 _WIRE_SUBPROC = r"""
 import json, re, sys

@@ -18,6 +18,7 @@ cases.
 
 import functools
 import math
+import os
 from typing import Optional
 
 import jax
@@ -606,12 +607,29 @@ def _flash(q, k, v, seed, causal, sm_scale, block_q, block_k,
                       layout, dropout_rate)[0]
 
 
+# Auto-dispatch crossover (v5e, 2026-07-31, benchmarks/session_r4/
+# bert_ab.log): at S=128 the XLA attention beats the Pallas flash kernel
+# by ~25% on the full BERT-large step (90.3 vs 115.5 ms dropout-on) —
+# short sequences leave the streaming kernel overhead-bound while XLA
+# fuses the whole [S, S] attention in registers/VMEM.  At S=1024 the
+# Pallas kernel wins (round-3 2x2).  Sequences shorter than this take
+# the XLA path under impl="auto"; impl="pallas" still forces the kernel.
+AUTO_MIN_SEQ = 512
+
+
 def _use_pallas(q_len, k_len, d, block_q, block_k):
     from .dispatch import pallas_available
     if not pallas_available():
         return False
     usable, _, _ = _resolve_blocks(q_len, k_len, block_q, block_k)
     return usable
+
+
+def _auto_prefers_xla(k_len):
+    """impl='auto' short-sequence crossover (measured; see AUTO_MIN_SEQ).
+    DS_FLASH_MIN_SEQ is read per call, not at import, so harnesses can
+    re-tune the crossover after the module is loaded."""
+    return k_len < int(os.environ.get("DS_FLASH_MIN_SEQ", AUTO_MIN_SEQ))
 
 
 def _t_bhsd(t):
@@ -672,12 +690,13 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 # state-feedback + fetch-sync measurement): large blocks dominate —
 # 128x128 is grid-overhead-bound (S=4096 fwd+bwd: 28.1 ms at 128x128 vs
 # 6.7 ms at 1024x1024; S=1024: 10.0 -> 4.3 ms).  With these blocks the
-# Pallas kernel also beats the batched-XLA attention at BOTH measured
-# lengths (S=1024: 4.3 vs 6.3 ms; S=4096: 6.7 vs 23.9 ms), so "auto"
-# simply means pallas-when-usable — an earlier short-seq XLA dispatch
-# here was an artifact of the old 128x128 default.  512x1024 (not
-# 1024x1024, statistically tied) keeps the bwd kernel's [bq, bk] fp32
-# score/ds tiles at 2 MB each for VMEM headroom at D>64.
+# Pallas kernel beats the batched-XLA attention at the kernel level for
+# S >= 1024 (S=1024: 4.3 vs 6.3 ms; S=4096: 6.7 vs 23.9 ms) — but at
+# SHORT lengths the FULL-STEP measurement goes the other way (round-4
+# bert_ab 2x2: S=128 XLA attention wins by ~25%), hence the
+# AUTO_MIN_SEQ crossover above.  512x1024 (not 1024x1024, statistically
+# tied) keeps the bwd kernel's [bq, bk] fp32 score/ds tiles at 2 MB
+# each for VMEM headroom at D>64.
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 1024
 
@@ -724,7 +743,7 @@ def flash_attention(q, k, v, causal: bool = False,
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
                              bias=bias, dropout_rate=dropout_rate,
                              dropout_seed=seed[0])
-    if impl == "xla":
+    if impl == "xla" or _auto_prefers_xla(k.shape[2]):
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
                              dropout_rate=dropout_rate,
                              dropout_seed=seed[0])
@@ -764,7 +783,8 @@ def flash_attention_bsh(q, k, v, causal: bool = False,
                 f"impl='pallas': no aligned tiling for seq lengths "
                 f"({q.shape[1]},{k.shape[1]}) or Pallas unavailable on this "
                 "backend — use impl='auto' for the XLA fallback")
-    if bias is not None or impl == "xla":
+    if (bias is not None or impl == "xla"
+            or (impl == "auto" and _auto_prefers_xla(k.shape[1]))):
         return _t_bhsd(mha_reference(_t_bhsd(q), _t_bhsd(k), _t_bhsd(v),
                                      causal=causal, sm_scale=sm_scale,
                                      bias=bias, dropout_rate=dropout_rate,

@@ -98,6 +98,15 @@ class MatMul:
                                  _group_index(self.layout, True)))
 
     # ------------------------------------------------------------------ #
+    def _check_heads(self, x):
+        """Out-of-range head gathers would CLAMP (JAX semantics), reading
+        the wrong head's data silently — guard like SparseSelfAttention."""
+        h = self.layout.shape[0]
+        if x.shape[1] not in (1, h):
+            raise ValueError(
+                f"operand has {x.shape[1]} heads, layout built for {h} "
+                "(1 broadcasts)")
+
     def _blocked(self, x, trans):
         """[B, H, S, D] (optionally pre-transposing the trailing dims) ->
         [B, H, nb, block, D]."""
@@ -109,6 +118,8 @@ class MatMul:
         return x.reshape(b, h, s // self.block, self.block, d)
 
     def _sdd(self, a, b):
+        self._check_heads(a)
+        self._check_heads(b)
         ab = self._blocked(a, self.trans_a)
         bb = self._blocked(b, not self.trans_b)  # contract over D
         if ab.shape[1] == 1:  # head-broadcast operands (reference allows)
@@ -124,6 +135,7 @@ class MatMul:
                           ).astype(a.dtype)
 
     def _dsd(self, a_sparse, b):
+        self._check_heads(b)
         n_idx, other, valid = self._by_row if not self.trans_a \
             else self._by_col
         w = a_sparse
@@ -140,6 +152,7 @@ class MatMul:
         return out.reshape(bsz, h, nb * self.block, d).astype(b.dtype)
 
     def _dds(self, a, b_sparse):
+        self._check_heads(a)
         # c[.., m, j·block+k] = sum_i a[.., m, i·block+q] · w[n(h,i,j),q,k]
         n_idx, other, valid = self._by_col if not self.trans_b \
             else self._by_row
@@ -195,39 +208,19 @@ class Softmax:
     @functools.partial(jax.jit, static_argnames=("self", "kp_mode",
                                                  "attn_mode", "have"))
     def _impl(self, x, scale, rpe, kp, attn, kp_mode, attn_mode, have):
+        from .sparse_self_attention import gathered_mask_terms
+
         n_idx, other, valid = self._by_row
         h, nb, max_deg = n_idx.shape
         blk = self.block
         bsz = x.shape[0]
         w = x[:, n_idx].astype(jnp.float32)  # [B, H, nb, deg, bq, bk]
         w = w * scale
-        heads = jnp.arange(h)[:, None, None]
-        if "rpe" in have:
-            r = rpe.astype(jnp.float32)
-            if r.ndim == 2:
-                r = r[None, None]
-            elif r.ndim == 3:
-                r = r[None]
-            rb = r.reshape(r.shape[0], r.shape[1], nb, blk, nb, blk)
-            rb = jnp.moveaxis(rb, 4, 3)  # [b?, h?, nb_i, nb_j, bq, bk]
-            rb = jnp.broadcast_to(rb, (rb.shape[0], h, nb, nb, blk, blk))
-            r_g = rb[:, heads, jnp.arange(nb)[None, :, None], other]
-            w = w + r_g                      # [B?, H, nb, deg, bq, bk]
-        if "kp" in have:
-            kpf = kp.astype(jnp.float32)
-            if kp_mode == "mul":
-                kpf = jnp.where(kpf == 0, -jnp.inf, 0.0)
-            kpb = kpf.reshape(bsz, nb, blk)
-            kp_g = kpb[:, other]             # [B, H, nb, deg, bk]
-            w = w + kp_g[:, :, :, :, None, :]
-        if "attn" in have:
-            am = attn.astype(jnp.float32)
-            if attn_mode == "mul":
-                am = jnp.where(am == 0, -jnp.inf, 0.0)
-            ab = am.reshape(nb, blk, nb, blk)
-            ab = jnp.moveaxis(ab, 2, 1)      # [nb_i, nb_j, bq, bk]
-            a_g = ab[jnp.arange(nb)[None, :, None], other]
-            w = w + a_g[None]
+        # one shared gather for rpe/kp/attn so this op and the fused
+        # attention impl cannot drift (sparse_self_attention.py)
+        for term in gathered_mask_terms(other, nb, blk, have, rpe, kp,
+                                        attn, kp_mode, attn_mode, bsz):
+            w = w + term
         neg = jnp.float32(-1e30)
         w = jnp.where(valid[None, :, :, :, None, None], w, neg)
         w = jnp.maximum(w, neg)  # -inf + -inf stays finite for the max

@@ -60,6 +60,46 @@ def layout_to_gather_indices(layout: np.ndarray
     return _gather_core(layout, pad_last_valid=False, allow_empty_rows=False)
 
 
+def gathered_mask_terms(kcols, nb, block, have, rpe, key_padding_mask,
+                        attn_mask, kp_mode, attn_mode, batch):
+    """Block-gathered additive mask terms, shared by the fused attention
+    impl below and the standalone Softmax op (matmul.py) so the two
+    paths cannot drift.  kcols [H, nb, deg] holds each row-block's
+    allowed k-block ids; every returned term broadcasts against the
+    [B, H, nb, deg, bq, bk] score layout (callers in [.., bq, deg, bk]
+    moveaxis(-2, -3) each term).  Semantics mirror trsrc/softmax_fwd.tr:
+    rpe added; mul-mode masks convert zero entries to DEFAULT_MASK_VALUE,
+    add-mode values pass through."""
+    h = kcols.shape[0]
+    heads = jnp.arange(h)[:, None, None]
+    rows = jnp.arange(nb)[None, :, None]
+    terms = []
+    if "rpe" in have:
+        r = rpe.astype(jnp.float32)
+        if r.ndim == 2:
+            r = r[None, None]
+        elif r.ndim == 3:
+            r = r[None]
+        rb = r.reshape(r.shape[0], r.shape[1], nb, block, nb, block)
+        rb = jnp.moveaxis(rb, 4, 3)          # [b?, h?, nb_i, nb_j, bq, bk]
+        rb = jnp.broadcast_to(rb, (rb.shape[0], h, nb, nb, block, block))
+        terms.append(rb[:, heads, rows, kcols])  # [B?, H, nb, deg, bq, bk]
+    if "kp" in have:
+        kpf = key_padding_mask.astype(jnp.float32)
+        if kp_mode == "mul":
+            kpf = jnp.where(kpf == 0, DEFAULT_MASK_VALUE, 0.0)
+        kp_g = kpf.reshape(batch, nb, block)[:, kcols]  # [B, H, nb, deg, bk]
+        terms.append(kp_g[:, :, :, :, None, :])
+    if "attn" in have:
+        am = attn_mask.astype(jnp.float32)
+        if attn_mode == "mul":
+            am = jnp.where(am == 0, DEFAULT_MASK_VALUE, 0.0)
+        ab = am.reshape(nb, block, nb, block)
+        ab = jnp.moveaxis(ab, 2, 1)          # [nb_i, nb_j, bq, bk]
+        terms.append(ab[rows, kcols][None])  # [1, H, nb, deg, bq, bk]
+    return terms
+
+
 @functools.partial(jax.jit, static_argnames=("block", "causal", "sm_scale",
                                              "kp_mode", "attn_mode",
                                              "have"))
@@ -86,32 +126,10 @@ def _sparse_attention_impl(q, k, v, idx, valid, block: int,
 
     # reference mask-application order (trsrc/softmax_fwd.tr): x·scale
     # + rpe + key_padding_mask + attn_mask, then the masked softmax
-    if "rpe" in have:
-        r = rpe.astype(jnp.float32)
-        if r.ndim == 2:
-            r = r[None, None]
-        elif r.ndim == 3:
-            r = r[None]
-        rb = r.reshape(r.shape[0], r.shape[1], nb, block, nb, block)
-        rb = jnp.moveaxis(rb, 4, 3)          # [b?, h?, nb_i, nb_j, bq, bk]
-        rb = jnp.broadcast_to(rb,
-                              (rb.shape[0], h, nb, nb, block, block))
-        r_g = rb[:, heads, jnp.arange(nb)[None, :, None], idx]
-        scores = scores + jnp.moveaxis(r_g, -2, -3)  # -> [.., bq, deg, bk]
-    if "kp" in have:
-        kpf = key_padding_mask.astype(jnp.float32)
-        if kp_mode == "mul":
-            kpf = jnp.where(kpf == 0, DEFAULT_MASK_VALUE, 0.0)
-        kp_g = kpf.reshape(b, nb, block)[:, idx]     # [B, H, nb, deg, bk]
-        scores = scores + kp_g[:, :, :, None, :, :]  # broadcast over bq
-    if "attn" in have:
-        am = attn_mask.astype(jnp.float32)
-        if attn_mode == "mul":
-            am = jnp.where(am == 0, DEFAULT_MASK_VALUE, 0.0)
-        ab = am.reshape(nb, block, nb, block)
-        ab = jnp.moveaxis(ab, 2, 1)          # [nb_i, nb_j, bq, bk]
-        a_g = ab[jnp.arange(nb)[None, :, None], idx]  # [H, nb, deg, bq, bk]
-        scores = scores + jnp.moveaxis(a_g, -2, -3)[None]
+    for term in gathered_mask_terms(idx, nb, block, have, rpe,
+                                    key_padding_mask, attn_mask,
+                                    kp_mode, attn_mode, b):
+        scores = scores + jnp.moveaxis(term, -2, -3)  # -> [.., bq, deg, bk]
     if have:
         # two stacked mul-mode masks would overflow fp32 to -inf and the
         # exp below would then produce NaN on fully-masked rows; clamping

@@ -93,6 +93,11 @@ class DeepSpeedTransformerConfig:
             raise ValueError(
                 f"ffn={self.ffn!r}: must be 'dense' or 'none' "
                 "(init/forward/specs all key on it)")
+        if self.attn_dropout_impl not in ("kernel", "ctx"):
+            raise ValueError(
+                f"attn_dropout_impl={self.attn_dropout_impl!r}: must be "
+                "'kernel' (in-kernel probability dropout, reference "
+                "semantics) or 'ctx' (output dropout)")
 
     @property
     def dtype(self):

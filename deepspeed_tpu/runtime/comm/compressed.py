@@ -50,6 +50,15 @@ def compressed_allreduce_inner(x: jnp.ndarray, error: jnp.ndarray,
     """
     if wire not in ("full", "int8"):
         raise ValueError(f"wire={wire!r} not in full|int8")
+    if wire == "int8":
+        # the axis size is static inside shard_map — guard here too, not
+        # just in the wrapper (shard_map loops call inner directly)
+        world_static = lax.axis_size(axis_name)
+        if world_static > 127:
+            raise ValueError(
+                f"wire='int8' supports at most 127 workers on "
+                f"{axis_name!r} (summed signs ride int8 lanes); axis has "
+                f"{world_static} — use wire='full'")
     world = lax.psum(1, axis_name)
     compensated = x + error
     # per-worker scale: mean magnitude preserves E[|x|] under sign compression

@@ -242,7 +242,15 @@ def test_block_sparse_flash_parity_bf16_tpu(causal):
         return jnp.sum(o.astype(jnp.float32) ** 2), o
 
     def loss_ref(q, k, v):
-        o = mha_reference(q, k, v, causal=causal, bias=bias)
+        # fp32 reference: at degenerate causal rows (q-position 0 of a
+        # block attending one key) the true dq is EXACTLY 0 via
+        # dp - delta cancellation; a bf16 reference on the MXU leaves
+        # ~0.1-magnitude cancellation noise there while the kernel's
+        # in-kernel fp32 math gives the exact 0 (measured round 4 —
+        # 39/524288 "mismatches" were the reference's noise, not kernel
+        # error; CPU interpret hid it by emulating bf16 in fp32)
+        o = mha_reference(q.astype(jnp.float32), k.astype(jnp.float32),
+                          v.astype(jnp.float32), causal=causal, bias=bias)
         return jnp.sum(o.astype(jnp.float32) ** 2), o
 
     (_, out), gs = jax.jit(jax.value_and_grad(
