@@ -19,8 +19,9 @@ backend init is retried with backoff; ANY failure still emits a single
 diagnostic JSON line instead of a bare traceback.
 
 Ladder: `python bench.py --config
-{gpt2|bert_z2|decode|moe|gpt_moe|longseq|offload|infinity}` selects other
-BASELINE.md anchor points; default is the flagship gpt2.
+{gpt2|bert_z2|bert_s512|decode|moe|gpt_moe|longseq|sparse_longseq|offload|
+infinity}` selects other BASELINE.md anchor points; default is the
+flagship gpt2.
 DS_BENCH_ITERS overrides the timing iteration count (CI smoke).
 """
 
@@ -332,13 +333,15 @@ def bench_smoke():
     }
 
 
-def bench_bert_z2():
-    """BERT-large-class encoder, ZeRO-2, seq128 — BASELINE.md anchor row."""
+def bench_bert_z2(batch=32, seq=128, baseline=272.0,
+                  metric="bert_large_z2_samples_per_sec_1chip"):
+    """BERT-large-class encoder, ZeRO-2 — BASELINE.md anchor rows.
+
+    seq=128 vs the reference's 272 samples/s and seq=512 vs its 52
+    samples/s (docs/_tutorials/bert-pretraining.md:387, 1x V100)."""
     import jax
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import BertConfig, BertModel
-
-    batch, seq = 32, 128
     cfg = BertConfig(max_position_embeddings=seq, hidden_size=1024,
                      num_layers=24, num_heads=16, bf16=True)
     model = BertModel(cfg)
@@ -367,10 +370,11 @@ def bench_bert_z2():
     samples_per_sec = n * batch / dt
     tflops = n * batch * seq * cfg.flops_per_token(seq) / dt / 1e12
     return {
-        "metric": "bert_large_z2_samples_per_sec_1chip",
+        "metric": metric,
         "value": round(samples_per_sec, 1),
         "unit": "samples/s",
-        "vs_baseline": round(samples_per_sec / 272.0, 3),  # ref: 272 s/s V100
+        "vs_baseline": round(samples_per_sec / baseline, 3),
+        "batch": batch, "seq_len": seq,
         "tflops_per_chip": round(tflops, 2),
         "mfu": round(tflops / _peak_tflops(), 4),
         "final_loss": round(final_loss, 4),
@@ -743,8 +747,14 @@ def bench_infinity():
     }
 
 
+def bench_bert_s512():
+    """BERT-large ZeRO-2 at seq 512 — BASELINE.md row 2 (52 samples/s)."""
+    return bench_bert_z2(batch=16, seq=512, baseline=52.0,
+                         metric="bert_large_z2_s512_samples_per_sec_1chip")
+
+
 BENCHES = {"gpt2": bench_gpt2, "smoke": bench_smoke,
-           "bert_z2": bench_bert_z2,
+           "bert_z2": bench_bert_z2, "bert_s512": bench_bert_s512,
            "decode": bench_decode, "moe": bench_moe,
            "gpt_moe": bench_gpt_moe,
            "longseq": bench_longseq, "sparse_longseq": bench_sparse_longseq,
@@ -754,6 +764,7 @@ METRIC_NAMES = {  # error-path metric must match the success-path name
     "gpt2": ("gpt2_124m_train_tokens_per_sec_1chip", "tokens/s"),
     "smoke": ("smoke_tiny_gpt2_train_tokens_per_sec", "tokens/s"),
     "bert_z2": ("bert_large_z2_samples_per_sec_1chip", "samples/s"),
+    "bert_s512": ("bert_large_z2_s512_samples_per_sec_1chip", "samples/s"),
     "decode": ("gpt2_124m_decode_tokens_per_sec_1chip", "tokens/s"),
     "moe": ("moe_top2_train_tokens_per_sec_1chip", "tokens/s"),
     "gpt_moe": ("gpt_moe_8e_top2_train_tokens_per_sec_1chip",

@@ -110,7 +110,11 @@ def main():
     # mask would cripple the training signal through attention while
     # leaving deterministic eval untouched)
     drop = float(os.environ.get("DS_CONV_DROPOUT", 0.1))
-    cfg = GPT2Config(n_positions=SEQ, bf16=True, embd_dropout=drop,
+    # DS_CONV_BF16=0 runs the stack fp32 — with DS_FORCE_XLA_OPS this
+    # forms the 2x2 that splits "Pallas kernel at flagship shapes" from
+    # "bf16 training dynamics" (round-4 plateau triage)
+    bf16 = bool(int(os.environ.get("DS_CONV_BF16", "1")))
+    cfg = GPT2Config(n_positions=SEQ, bf16=bf16, embd_dropout=drop,
                      attn_dropout=drop, hidden_dropout=drop)  # GPT-2 124M
     model = GPT2Model(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
@@ -123,7 +127,7 @@ def main():
             "scheduler": {"type": "WarmupLR",
                           "params": {"warmup_num_steps": 100,
                                      "warmup_max_lr": 6e-4}},
-            "bf16": {"enabled": True},
+            "bf16": {"enabled": bf16},
             "zero_optimization": {"stage": 2},
             "steps_per_print": 10 ** 9,
         })
@@ -159,7 +163,10 @@ def main():
     result = {
         "task": ("order1-markov-zipf64 (seed 1234), support 4096 of the "
                  "model's 50304-token vocab"),
-        "model": "gpt2-124m bf16 zero2 adamw",
+        "model": (f"gpt2-124m {'bf16' if bf16 else 'fp32'} zero2 adamw"
+                  + (" xla-ops" if os.environ.get("DS_FORCE_XLA_OPS") == "1"
+                     else "")),
+        "dropout": drop,
         "batch": BATCH, "seq": SEQ,
         "analytic_floor_nats": round(floor, 4),
         "threshold_nats": round(floor + THRESH_MARGIN, 4),
@@ -177,10 +184,28 @@ def main():
     # baseline: test_chip_convergence_baseline hard-asserts platform
     # and convergence, so a CPU-fallback or unconverged run landing at
     # OUT_PATH would turn the unit suite red until hand-deleted.
+    # Triage-probe configs (fp32 / forced-XLA ops / dropout-off / short
+    # runs) must not become the gating baseline: they answer "where is
+    # the bug", not "does the production engine learn".  Production =
+    # zero triage env overrides.  Non-production artifacts get a
+    # config-keyed suffix so the 2x2 probes don't clobber each other.
+    # Effective-value comparison (not env truthiness): exporting a knob
+    # AT its production value must not quarantine a baseline-eligible run.
+    overrides = []
+    if drop != 0.1:
+        overrides.append(f"drop{drop:g}")
+    if not bf16:
+        overrides.append("fp32")
+    if STEPS != 1500:
+        overrides.append(f"steps{STEPS}")
+    if os.environ.get("DS_FORCE_XLA_OPS") == "1":
+        overrides.append("xlaops")
     out_path = OUT_PATH
-    if dev.platform != "tpu" or not result["converged"]:
-        out_path = OUT_PATH + ".quarantine"
-        print(f"[conv] NOT a converged chip run -> {out_path}", flush=True)
+    if dev.platform != "tpu" or not result["converged"] or overrides:
+        tag = "-".join(overrides)
+        out_path = OUT_PATH + (f".{tag}" if tag else "") + ".quarantine"
+        print(f"[conv] NOT a converged production chip run -> {out_path}",
+              flush=True)
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
     with open(out_path, "w") as f:
         json.dump(result, f, indent=1)

@@ -1,0 +1,70 @@
+#!/bin/bash
+# Round-4 post session: chip stages queued AFTER run_round4_followup.sh.
+# Value order:
+#   1. grad_diag — one-step Pallas-vs-XLA loss + per-leaf grad cosines at
+#      flagship shapes: names the op (and direction) behind the
+#      convergence plateau, or exonerates the kernels in ~5 min
+#   2. conv probe, XLA ops (dropout off) — does the plateau survive with
+#      zero Pallas in the graph?
+#   3. conv probe, fp32 (dropout off) — does it survive at fp32?
+#      (2x2 with the already-measured bf16+pallas plateau)
+#   4. bert_s512 row (BASELINE.md row 2: 52 samples/s on V100)
+#   5. onebit_cost (VERDICT r3 #10)
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/session_r4c
+mkdir -p "$OUT"
+. benchmarks/slot_lib.sh
+
+# wait for the follow-up session to finish (cap 5h — its own stages are
+# individually timeout-bounded)
+for i in $(seq 1 600); do
+  pgrep -f run_round4_followup.sh > /dev/null 2>&1 || break
+  sleep 30
+done
+
+stage() {  # stage <name> <timeout> <cmd...>: log; mark ONLY on rc=0 so a
+  done_skip "$1" && return 0   # resume retries timed-out/failed stages
+  local name=$1 t=$2; shift 2
+  echo "== $name $(stamp)" | tee -a "$OUT/session.log"
+  if timeout -k 60 "$t" "$@" > "$OUT/$name.log" 2>&1; then
+    done_mark "$name"
+  else
+    echo "   $name rc=$? (left unmarked for resume)" \
+      | tee -a "$OUT/session.log"
+  fi
+  tail -4 "$OUT/$name.log" | tee -a "$OUT/session.log"
+}
+
+echo "== round-4 post start $(stamp)" | tee -a "$OUT/session.log"
+waitslot 40 || exit 1
+
+stage grad_diag 2400 python benchmarks/grad_diag.py
+waitslot 10 || exit 1
+stage conv_probe_xla 1500 env DS_FORCE_XLA_OPS=1 DS_CONV_DROPOUT=0 \
+  DS_CONV_STEPS=500 python benchmarks/convergence_run.py
+waitslot 10 || exit 1
+stage conv_probe_fp32 1500 env DS_CONV_BF16=0 DS_CONV_DROPOUT=0 \
+  DS_CONV_STEPS=500 python benchmarks/convergence_run.py
+waitslot 10 || exit 1
+
+row bert_s512 bert_s512
+waitslot 10 || exit 1
+
+if ! done_skip onebit; then
+  echo "== onebit_cost $(stamp)" | tee -a "$OUT/session.log"
+  timeout -k 60 1800 python benchmarks/onebit_cost.py \
+    > "$OUT/onebit_cost.log" 2>&1
+  last=$(grep -v '^\[' "$OUT/onebit_cost.log" | tail -1)
+  echo "   onebit raw: $last" >> "$OUT/session.log"
+  if fresh_json "$last"; then
+    echo "$last" >> benchmarks/ladder_results.jsonl
+    echo "$last" | tee -a "$OUT/session.log"
+    done_mark onebit
+  else
+    echo "   onebit produced no fresh JSON" | tee -a "$OUT/session.log"
+  fi
+fi
+
+python benchmarks/render_results.py | tee -a "$OUT/session.log"
+echo "== round-4 post done $(stamp)" | tee -a "$OUT/session.log"

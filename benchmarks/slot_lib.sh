@@ -51,3 +51,23 @@ ok = (not row.get("stale")
 sys.exit(0 if ok else 1)
 ' 2>/dev/null
 }
+
+# Shared ladder-row stage (one copy; session scripts source this).
+# $1 = stage name, $2 = bench.py --config name.  Tunables: WATCHDOG
+# (bench-internal watchdog s), ROWTIMEOUT (outer kill s).  Appends to the
+# canonical ladder only through fresh_json's gate; marks done on success.
+row() {
+  done_skip "row_$1" && return 0
+  echo "== row $1 $(stamp)" | tee -a "$OUT/session.log"
+  local out
+  out=$(DS_BENCH_WATCHDOG="${WATCHDOG:-1200}" DS_BENCH_RUN_MARGIN=700 \
+    timeout -k 30 "${ROWTIMEOUT:-1300}" python bench.py --config "$2" \
+    2>> "$OUT/row_$1.stderr.log" | tail -1)
+  echo "   row $1 raw: $out" >> "$OUT/session.log"
+  if fresh_json "$out"; then
+    echo "$out" | tee -a benchmarks/ladder_results.jsonl
+    done_mark "row_$1"
+  else
+    echo "   row $1 produced no fresh JSON" | tee -a "$OUT/session.log"
+  fi
+}
