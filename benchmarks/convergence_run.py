@@ -94,6 +94,12 @@ def main():
     import _harness  # noqa: F401  — SIGTERM-clean exit + compile cache
     import jax
 
+    # sitecustomize pre-imports jax, so JAX_PLATFORMS alone is ignored —
+    # apply it via config.update (CPU triage legs must not claim the TPU)
+    _plat = os.environ.get("JAX_PLATFORMS")
+    if _plat:
+        jax.config.update("jax_platforms", _plat)
+
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import GPT2Config, GPT2Model
 
@@ -114,8 +120,19 @@ def main():
     # forms the 2x2 that splits "Pallas kernel at flagship shapes" from
     # "bf16 training dynamics" (round-4 plateau triage)
     bf16 = bool(int(os.environ.get("DS_CONV_BF16", "1")))
+    # mirror ops/dispatch.py's parse exactly: any truthy int forces XLA,
+    # and the quarantine/label logic must agree with what dispatch DOES
+    forced_xla = bool(int(os.environ.get("DS_FORCE_XLA_OPS", "0")))
+    # DS_CONV_HIDDEN/DS_CONV_NLAYERS shrink the model (heads scale with
+    # width): the SAME shrunk config is CPU-feasible, so chip-vs-CPU at
+    # identical config isolates chip-specific failures from 124M-scale
+    # dynamics.  Any shrink quarantines the artifact (below).
+    hidden = int(os.environ.get("DS_CONV_HIDDEN", 768))
+    n_layers = int(os.environ.get("DS_CONV_NLAYERS", 12))
     cfg = GPT2Config(n_positions=SEQ, bf16=bf16, embd_dropout=drop,
-                     attn_dropout=drop, hidden_dropout=drop)  # GPT-2 124M
+                     attn_dropout=drop, hidden_dropout=drop,
+                     hidden_size=hidden, num_layers=n_layers,
+                     num_heads=max(hidden // 64, 1))  # default: GPT-2 124M
     model = GPT2Model(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
     engine, _, _, _ = ds.initialize(
@@ -163,9 +180,10 @@ def main():
     result = {
         "task": ("order1-markov-zipf64 (seed 1234), support 4096 of the "
                  "model's 50304-token vocab"),
-        "model": (f"gpt2-124m {'bf16' if bf16 else 'fp32'} zero2 adamw"
-                  + (" xla-ops" if os.environ.get("DS_FORCE_XLA_OPS") == "1"
-                     else "")),
+        "model": ((f"gpt2-124m" if (hidden, n_layers) == (768, 12)
+                   else f"gpt2-h{hidden}l{n_layers}")
+                  + f" {'bf16' if bf16 else 'fp32'} zero2 adamw"
+                  + (" xla-ops" if forced_xla else "")),
         "dropout": drop,
         "batch": BATCH, "seq": SEQ,
         "analytic_floor_nats": round(floor, 4),
@@ -198,10 +216,16 @@ def main():
         overrides.append("fp32")
     if STEPS != 1500:
         overrides.append(f"steps{STEPS}")
-    if os.environ.get("DS_FORCE_XLA_OPS") == "1":
+    if forced_xla:
         overrides.append("xlaops")
+    if hidden != 768 or n_layers != 12:
+        overrides.append(f"h{hidden}l{n_layers}")
     out_path = OUT_PATH
     if dev.platform != "tpu" or not result["converged"] or overrides:
+        # platform is part of the key: the chip and CPU legs of the
+        # same-config A/B must not clobber each other's artifact
+        if dev.platform != "tpu":
+            overrides.insert(0, dev.platform)
         tag = "-".join(overrides)
         out_path = OUT_PATH + (f".{tag}" if tag else "") + ".quarantine"
         print(f"[conv] NOT a converged production chip run -> {out_path}",

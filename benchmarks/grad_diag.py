@@ -114,6 +114,12 @@ def main():
             b = np.load(os.path.join(
                 db, name.replace("/", "__") + ".npy")).astype(np.float32)
             na, nb = np.linalg.norm(a), np.linalg.norm(b)
+            # manifest norm = in-child fp32 norm; catches npy round-trip
+            # corruption (the fp16 underflow class of bug) loudly
+            if not np.isclose(na, meta["norm"], rtol=1e-3, atol=1e-6):
+                raise RuntimeError(
+                    f"npy round-trip norm mismatch for {name}: "
+                    f"{na} vs manifest {meta['norm']}")
             cos = float((a * b).sum() / max(na * nb, 1e-30))
             ratio = float(na / max(nb, 1e-30))
             rows.append((name, cos, ratio, float(na), float(nb)))

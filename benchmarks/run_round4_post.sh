@@ -47,24 +47,21 @@ waitslot 10 || exit 1
 stage conv_probe_fp32 1500 env DS_CONV_BF16=0 DS_CONV_DROPOUT=0 \
   DS_CONV_STEPS=500 python benchmarks/convergence_run.py
 waitslot 10 || exit 1
+# small-model pair: identical config runs on CPU (launched separately) —
+# chip-vs-CPU at h256l4 splits chip-specific breakage from 124M-scale
+# dynamics; the xla leg removes Pallas from the chip graph too
+stage conv_small 900 env DS_CONV_HIDDEN=256 DS_CONV_NLAYERS=4 \
+  DS_CONV_DROPOUT=0 DS_CONV_STEPS=500 python benchmarks/convergence_run.py
+waitslot 10 || exit 1
+stage conv_small_xla 900 env DS_CONV_HIDDEN=256 DS_CONV_NLAYERS=4 \
+  DS_CONV_DROPOUT=0 DS_CONV_STEPS=500 DS_FORCE_XLA_OPS=1 \
+  python benchmarks/convergence_run.py
+waitslot 10 || exit 1
 
 row bert_s512 bert_s512
 waitslot 10 || exit 1
 
-if ! done_skip onebit; then
-  echo "== onebit_cost $(stamp)" | tee -a "$OUT/session.log"
-  timeout -k 60 1800 python benchmarks/onebit_cost.py \
-    > "$OUT/onebit_cost.log" 2>&1
-  last=$(grep -v '^\[' "$OUT/onebit_cost.log" | tail -1)
-  echo "   onebit raw: $last" >> "$OUT/session.log"
-  if fresh_json "$last"; then
-    echo "$last" >> benchmarks/ladder_results.jsonl
-    echo "$last" | tee -a "$OUT/session.log"
-    done_mark onebit
-  else
-    echo "   onebit produced no fresh JSON" | tee -a "$OUT/session.log"
-  fi
-fi
+json_stage onebit 1800 python benchmarks/onebit_cost.py
 
 python benchmarks/render_results.py | tee -a "$OUT/session.log"
 echo "== round-4 post done $(stamp)" | tee -a "$OUT/session.log"

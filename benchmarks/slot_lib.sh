@@ -71,3 +71,24 @@ row() {
     echo "   row $1 produced no fresh JSON" | tee -a "$OUT/session.log"
   fi
 }
+
+# Shared JSON-emitting stage: run a command whose LAST stdout line is a
+# bench JSON payload; gate through fresh_json before appending to the
+# canonical ladder.  $1 = stage name, $2 = timeout s, rest = command.
+json_stage() {
+  done_skip "$1" && return 0
+  local name=$1 t=$2; shift 2
+  echo "== $name $(stamp)" | tee -a "$OUT/session.log"
+  timeout -k 60 "$t" "$@" > "$OUT/$name.log" 2>&1
+  local last
+  last=$(grep -v '^\[' "$OUT/$name.log" | tail -1)
+  echo "   $name raw: $last" >> "$OUT/session.log"
+  if fresh_json "$last"; then
+    echo "$last" >> benchmarks/ladder_results.jsonl
+    echo "$last" | tee -a "$OUT/session.log"
+    done_mark "$name"
+  else
+    echo "   $name produced no fresh JSON (see $name.log)" \
+      | tee -a "$OUT/session.log"
+  fi
+}
