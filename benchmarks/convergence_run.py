@@ -129,10 +129,16 @@ def main():
     # dynamics.  Any shrink quarantines the artifact (below).
     hidden = int(os.environ.get("DS_CONV_HIDDEN", 768))
     n_layers = int(os.environ.get("DS_CONV_NLAYERS", 12))
+    # DS_CONV_FUSED=0 swaps the chunked linear+CE custom-VJP for the
+    # naive logits+softmax path — the one hot-path op DS_FORCE_XLA_OPS
+    # does NOT toggle (it is plain XLA either way, but with a
+    # hand-written VJP worth isolating)
+    fused = bool(int(os.environ.get("DS_CONV_FUSED", "1")))
     cfg = GPT2Config(n_positions=SEQ, bf16=bf16, embd_dropout=drop,
                      attn_dropout=drop, hidden_dropout=drop,
                      hidden_size=hidden, num_layers=n_layers,
-                     num_heads=max(hidden // 64, 1))  # default: GPT-2 124M
+                     num_heads=max(hidden // 64, 1),
+                     fused_loss=fused)  # default: GPT-2 124M
     model = GPT2Model(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
     engine, _, _, _ = ds.initialize(
@@ -220,6 +226,8 @@ def main():
         overrides.append("xlaops")
     if hidden != 768 or n_layers != 12:
         overrides.append(f"h{hidden}l{n_layers}")
+    if not fused:
+        overrides.append("nofusedce")
     out_path = OUT_PATH
     if dev.platform != "tpu" or not result["converged"] or overrides:
         # platform is part of the key: the chip and CPU legs of the

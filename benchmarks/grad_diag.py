@@ -102,27 +102,27 @@ def group_of(name: str) -> str:
     return "other"
 
 
-def main():
-    with tempfile.TemporaryDirectory() as da, \
-            tempfile.TemporaryDirectory() as db:
-        pallas = run_child(False, da)
-        xla = run_child(True, db)
-        rows = []
-        for name, meta in pallas["leaves"].items():
-            a = np.load(os.path.join(
-                da, name.replace("/", "__") + ".npy")).astype(np.float32)
-            b = np.load(os.path.join(
-                db, name.replace("/", "__") + ".npy")).astype(np.float32)
-            na, nb = np.linalg.norm(a), np.linalg.norm(b)
-            # manifest norm = in-child fp32 norm; catches npy round-trip
-            # corruption (the fp16 underflow class of bug) loudly
-            if not np.isclose(na, meta["norm"], rtol=1e-3, atol=1e-6):
-                raise RuntimeError(
-                    f"npy round-trip norm mismatch for {name}: "
-                    f"{na} vs manifest {meta['norm']}")
-            cos = float((a * b).sum() / max(na * nb, 1e-30))
-            ratio = float(na / max(nb, 1e-30))
-            rows.append((name, cos, ratio, float(na), float(nb)))
+def compare_dirs(da, db, label_a="pallas", label_b="xla"):
+    with open(os.path.join(da, "manifest.json")) as f:
+        ma = json.load(f)
+    with open(os.path.join(db, "manifest.json")) as f:
+        mb = json.load(f)
+    rows = []
+    for name, meta in ma["leaves"].items():
+        a = np.load(os.path.join(
+            da, name.replace("/", "__") + ".npy")).astype(np.float32)
+        b = np.load(os.path.join(
+            db, name.replace("/", "__") + ".npy")).astype(np.float32)
+        na, nb = np.linalg.norm(a), np.linalg.norm(b)
+        # manifest norm = in-child fp32 norm; catches npy round-trip
+        # corruption (the fp16 underflow class of bug) loudly
+        if not np.isclose(na, meta["norm"], rtol=1e-3, atol=1e-6):
+            raise RuntimeError(
+                f"npy round-trip norm mismatch for {name}: "
+                f"{na} vs manifest {meta['norm']}")
+        cos = float((a * b).sum() / max(na * nb, 1e-30))
+        ratio = float(na / max(nb, 1e-30))
+        rows.append((name, cos, ratio, float(na), float(nb)))
     groups = {}
     for name, cos, ratio, na, nb in rows:
         groups.setdefault(group_of(name), []).append((cos, ratio))
@@ -131,18 +131,58 @@ def main():
                for g, v in groups.items()}
     worst = min(rows, key=lambda r: r[1])
     print(json.dumps({
-        "metric": "grad_diag_pallas_vs_xla_worst_leaf_cosine",
+        "metric": f"grad_diag_{label_a}_vs_{label_b}_worst_leaf_cosine",
         "value": round(worst[1], 4),
         "unit": "cosine",
         "worst_leaf": worst[0],
-        "worst_leaf_norms_pallas_xla": [round(worst[3], 6),
-                                        round(worst[4], 6)],
-        "loss_pallas": round(pallas["loss"], 6),
-        "loss_xla": round(xla["loss"], 6),
-        "loss_delta": round(abs(pallas["loss"] - xla["loss"]), 6),
+        f"worst_leaf_norms_{label_a}_{label_b}": [round(worst[3], 6),
+                                                  round(worst[4], 6)],
+        f"loss_{label_a}": round(ma["loss"], 6),
+        f"loss_{label_b}": round(mb["loss"], 6),
+        "loss_delta": round(abs(ma["loss"] - mb["loss"]), 6),
         "groups": summary,
-        "platform": pallas["platform"],
+        "platforms": [ma["platform"], mb["platform"]],
     }), flush=True)
+
+
+def main(argv=None):
+    """Default: run both children in temp dirs and compare.
+
+    --keep DIR   persist child outputs to DIR/pallas and DIR/xla (so a
+                 later cross-PLATFORM compare can reuse them — the
+                 params and batch are seed-deterministic and threefry is
+                 platform-independent, so a CPU child and a chip child
+                 see identical inputs)
+    --compare A B [--labels la lb]   skip running; compare two saved
+                 child dirs (e.g. chip pallas vs CPU xla)
+    """
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--keep", default=None)
+    ap.add_argument("--compare", nargs=2, default=None)
+    ap.add_argument("--labels", nargs=2, default=None)
+    args = ap.parse_args(argv)
+
+    if args.compare:
+        la, lb = args.labels or ("a", "b")
+        compare_dirs(args.compare[0], args.compare[1], la, lb)
+        return
+
+    if args.keep:
+        da = os.path.join(args.keep, "pallas")
+        db = os.path.join(args.keep, "xla")
+        os.makedirs(da, exist_ok=True)
+        os.makedirs(db, exist_ok=True)
+        run_child(False, da)
+        run_child(True, db)
+        compare_dirs(da, db)
+        return
+
+    with tempfile.TemporaryDirectory() as da, \
+            tempfile.TemporaryDirectory() as db:
+        run_child(False, da)
+        run_child(True, db)
+        compare_dirs(da, db)
 
 
 if __name__ == "__main__":

@@ -39,8 +39,18 @@ stage() {  # stage <name> <timeout> <cmd...>: log; mark ONLY on rc=0 so a
 echo "== round-4 post start $(stamp)" | tee -a "$OUT/session.log"
 waitslot 40 || exit 1
 
-stage grad_diag 2400 python benchmarks/grad_diag.py
+stage grad_diag 2400 python benchmarks/grad_diag.py --keep /tmp/ds_diag_tpu
 waitslot 10 || exit 1
+# cross-PLATFORM leg: chip-pallas vs the separately-launched CPU child —
+# catches platform-level (non-Pallas) miscompiles the same-platform A/B
+# is blind to.  Pure host work; skipped gracefully if the CPU leg isn't
+# done yet (re-runs on resume since it stays unmarked).
+if [ -e /tmp/ds_diag_cpu/xla/manifest.json ] \
+    && [ -e /tmp/ds_diag_tpu/pallas/manifest.json ]; then
+  stage grad_diag_xplat 600 python benchmarks/grad_diag.py \
+    --compare /tmp/ds_diag_tpu/pallas /tmp/ds_diag_cpu/xla \
+    --labels tpu_pallas cpu_xla
+fi
 stage conv_probe_xla 1500 env DS_FORCE_XLA_OPS=1 DS_CONV_DROPOUT=0 \
   DS_CONV_STEPS=500 python benchmarks/convergence_run.py
 waitslot 10 || exit 1
