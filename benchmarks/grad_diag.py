@@ -40,6 +40,13 @@ import jax, jax.numpy as jnp
 _plat = os.environ.get("JAX_PLATFORMS")
 if _plat:
     jax.config.update("jax_platforms", _plat)
+try:  # persistent compile cache: child retries must not recompile 124M
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("DS_BENCH_COMPILE_CACHE",
+                                     "/tmp/ds_jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+except Exception:
+    pass
 
 sys.path.insert(0, "@REPO@")
 sys.path.insert(0, "@REPO@/benchmarks")
@@ -80,9 +87,12 @@ def run_child(force_xla: bool, out_dir: str):
     env["DS_FORCE_XLA_OPS"] = "1" if force_xla else "0"
     code = _CHILD.replace("@REPO@", _REPO)
     # 900 s/child keeps 2 children + the ~1 GB npy comparison inside the
-    # post-session script's 2400 s stage budget (chip children run ~3 min)
+    # post-session script's 2400 s stage budget (chip children run ~3
+    # min); the CPU leg overrides — 124M fwd+bwd on a contended host
+    # CPU can exceed 900 s in compile alone
+    child_t = int(os.environ.get("DS_DIAG_CHILD_TIMEOUT", "900"))
     proc = subprocess.run([sys.executable, "-c", code, out_dir],
-                          capture_output=True, text=True, timeout=900,
+                          capture_output=True, text=True, timeout=child_t,
                           env=env, cwd=_REPO)
     if proc.returncode != 0:
         raise RuntimeError(f"diag child (force_xla={force_xla}) failed:\n"
