@@ -134,6 +134,13 @@ def main():
     # does NOT toggle (it is plain XLA either way, but with a
     # hand-written VJP worth isolating)
     fused = bool(int(os.environ.get("DS_CONV_FUSED", "1")))
+    # Optimization knobs for the unigram-shelf probes: at 8192
+    # tokens/step the default 6e-4 is far above standard LR scaling for
+    # 124M (nanoGPT uses 6e-4 at ~500k tokens/step); DS_CONV_LR and
+    # DS_CONV_CLIP let the chip probe the shelf-vs-hyperparameter
+    # hypothesis without code edits.
+    lr = float(os.environ.get("DS_CONV_LR", 6e-4))
+    clip = float(os.environ.get("DS_CONV_CLIP", 0.0))
     cfg = GPT2Config(n_positions=SEQ, bf16=bf16, embd_dropout=drop,
                      attn_dropout=drop, hidden_dropout=drop,
                      hidden_size=hidden, num_layers=n_layers,
@@ -146,10 +153,11 @@ def main():
         config={
             "train_micro_batch_size_per_gpu": BATCH,
             "optimizer": {"type": "AdamW",
-                          "params": {"lr": 6e-4, "weight_decay": 0.1}},
+                          "params": {"lr": lr, "weight_decay": 0.1}},
             "scheduler": {"type": "WarmupLR",
                           "params": {"warmup_num_steps": 100,
-                                     "warmup_max_lr": 6e-4}},
+                                     "warmup_max_lr": lr}},
+            "gradient_clipping": clip,
             "bf16": {"enabled": bf16},
             "zero_optimization": {"stage": 2},
             "steps_per_print": 10 ** 9,
@@ -228,6 +236,10 @@ def main():
         overrides.append(f"h{hidden}l{n_layers}")
     if not fused:
         overrides.append("nofusedce")
+    if lr != 6e-4:
+        overrides.append(f"lr{lr:g}")
+    if clip != 0.0:
+        overrides.append(f"clip{clip:g}")
     out_path = OUT_PATH
     if dev.platform != "tpu" or not result["converged"] or overrides:
         # platform is part of the key: the chip and CPU legs of the
