@@ -334,7 +334,8 @@ def bench_smoke():
 
 
 def bench_bert_z2(batch=32, seq=128, baseline=272.0,
-                  metric="bert_large_z2_samples_per_sec_1chip"):
+                  metric="bert_large_z2_samples_per_sec_1chip",
+                  remat=False):
     """BERT-large-class encoder, ZeRO-2 — BASELINE.md anchor rows.
 
     seq=128 vs the reference's 272 samples/s and seq=512 vs its 52
@@ -343,7 +344,8 @@ def bench_bert_z2(batch=32, seq=128, baseline=272.0,
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import BertConfig, BertModel
     cfg = BertConfig(max_position_embeddings=seq, hidden_size=1024,
-                     num_layers=24, num_heads=16, bf16=True)
+                     num_layers=24, num_heads=16, bf16=True,
+                     activation_checkpointing=remat)
     model = BertModel(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
 
@@ -748,9 +750,15 @@ def bench_infinity():
 
 
 def bench_bert_s512():
-    """BERT-large ZeRO-2 at seq 512 — BASELINE.md row 2 (52 samples/s)."""
+    """BERT-large ZeRO-2 at seq 512 — BASELINE.md row 2 (52 samples/s).
+
+    remat=True: 24 layers of S=512 attention activations blow past HBM
+    without per-layer rematerialization (measured: ResourceExhausted at
+    B=16 without it); the reference's seq-512 recipe likewise leans on
+    its activation-checkpointing tier."""
     return bench_bert_z2(batch=16, seq=512, baseline=52.0,
-                         metric="bert_large_z2_s512_samples_per_sec_1chip")
+                         metric="bert_large_z2_s512_samples_per_sec_1chip",
+                         remat=True)
 
 
 BENCHES = {"gpt2": bench_gpt2, "smoke": bench_smoke,

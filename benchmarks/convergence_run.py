@@ -103,7 +103,14 @@ def main():
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import GPT2Config, GPT2Model
 
-    lang = MarkovLanguage()
+    # DS_CONV_VOCAB / DS_CONV_NSUCC shrink the LANGUAGE (not the model):
+    # a rank-H model cannot represent a random V x n_succ transition
+    # structure when V >> H, so the shrunk-model probes need a task the
+    # model can actually fit (e.g. vocab 256 for hidden 256) before a
+    # plateau means anything.  The analytic floor adapts automatically.
+    vocab = int(os.environ.get("DS_CONV_VOCAB", VOCAB))
+    n_succ = int(os.environ.get("DS_CONV_NSUCC", N_SUCC))
+    lang = MarkovLanguage(vocab=vocab, n_succ=n_succ)
     val_rng = np.random.RandomState(9999)
     val_batches = [lang.sample(BATCH, SEQ, val_rng)
                    for _ in range(VAL_BATCHES)]
@@ -192,8 +199,8 @@ def main():
 
     dev = jax.devices()[0]
     result = {
-        "task": ("order1-markov-zipf64 (seed 1234), support 4096 of the "
-                 "model's 50304-token vocab"),
+        "task": (f"order1-markov-zipf{n_succ} (seed 1234), support "
+                 f"{vocab} of the model's 50304-token vocab"),
         "model": ((f"gpt2-124m" if (hidden, n_layers) == (768, 12)
                    else f"gpt2-h{hidden}l{n_layers}")
                   + f" {'bf16' if bf16 else 'fp32'} zero2 adamw"
@@ -240,6 +247,8 @@ def main():
         overrides.append(f"lr{lr:g}")
     if clip != 0.0:
         overrides.append(f"clip{clip:g}")
+    if vocab != VOCAB or n_succ != N_SUCC:
+        overrides.append(f"v{vocab}s{n_succ}")
     out_path = OUT_PATH
     if dev.platform != "tpu" or not result["converged"] or overrides:
         # platform is part of the key: the chip and CPU legs of the

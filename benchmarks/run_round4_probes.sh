@@ -1,0 +1,68 @@
+#!/bin/bash
+# Round-4 probe session #2 — runs AFTER run_round4_post.sh:
+#   1. conv_small_v256 — h256l4 model on a vocab-256/zipf-16 language the
+#      model can REPRESENT (the h256l4-at-vocab-4096 probes were
+#      capacity-confounded: a rank-256 head cannot fit a random 4096x64
+#      transition table, so their plateau proves nothing).  The identical
+#      config runs on CPU separately; chip-vs-CPU at a representable task
+#      is the clean discriminator.
+#   2. conv_124m_lrclip — the hyperparameter hypothesis at 124M: lr 1e-4
+#      + clip 1.0 (6e-4 at 8192 tokens/step is ~60x above standard LR
+#      scaling; the transition signal may simply drown in gradient noise
+#      while the consistent unigram signal fits).
+#   3. capability retry at --layers 20 (~4.2B): attempt #2 (5B) died of
+#      host OOM at 104.5 GB RSS.
+#   4. grad_diag cross-platform compare once the CPU leg has finished.
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/session_r4d
+mkdir -p "$OUT"
+. benchmarks/slot_lib.sh
+
+for i in $(seq 1 600); do
+  pgrep -f run_round4_post.sh > /dev/null 2>&1 || break
+  sleep 30
+done
+
+stage() {
+  done_skip "$1" && return 0
+  local name=$1 t=$2; shift 2
+  echo "== $name $(stamp)" | tee -a "$OUT/session.log"
+  if timeout -k 60 "$t" "$@" > "$OUT/$name.log" 2>&1; then
+    done_mark "$name"
+  else
+    echo "   $name rc=$? (left unmarked for resume)" \
+      | tee -a "$OUT/session.log"
+  fi
+  tail -4 "$OUT/$name.log" | tee -a "$OUT/session.log"
+}
+
+echo "== round-4 probe session start $(stamp)" | tee -a "$OUT/session.log"
+waitslot 40 || exit 1
+
+stage conv_small_v256 900 env DS_CONV_VOCAB=256 DS_CONV_NSUCC=16 \
+  DS_CONV_HIDDEN=256 DS_CONV_NLAYERS=4 DS_CONV_DROPOUT=0 \
+  DS_CONV_STEPS=500 python benchmarks/convergence_run.py
+waitslot 10 || exit 1
+
+# bert_s512 retry with per-layer remat (first attempt: ResourceExhausted
+# — 24 layers of S=512 activations without checkpointing exceed HBM)
+row bert_s512 bert_s512
+waitslot 10 || exit 1
+
+stage conv_124m_lrclip 1500 env DS_CONV_LR=1e-4 DS_CONV_CLIP=1.0 \
+  DS_CONV_DROPOUT=0 DS_CONV_STEPS=500 python benchmarks/convergence_run.py
+waitslot 10 || exit 1
+
+if [ -e /tmp/ds_diag_cpu/xla/manifest.json ] \
+    && [ -e /tmp/ds_diag_tpu/pallas/manifest.json ]; then
+  stage grad_diag_xplat 600 python benchmarks/grad_diag.py \
+    --compare /tmp/ds_diag_tpu/pallas /tmp/ds_diag_cpu/xla \
+    --labels tpu_pallas cpu_xla
+fi
+
+json_stage capability4b 5400 python benchmarks/infinity_capability.py \
+  --layers 20
+
+python benchmarks/render_results.py | tee -a "$OUT/session.log"
+echo "== round-4 probe session done $(stamp)" | tee -a "$OUT/session.log"
