@@ -33,39 +33,7 @@ for i in $(seq 1 240); do
   sleep 30
 done
 
-row() {
-  done_skip "row_$1" && return 0
-  echo "== row $1 $(stamp)" | tee -a "$OUT/session.log"
-  local out
-  out=$(DS_BENCH_WATCHDOG="${WATCHDOG:-1200}" DS_BENCH_RUN_MARGIN=700 \
-    timeout -k 30 "${ROWTIMEOUT:-1300}" python bench.py --config "$2" \
-    2>> "$OUT/row_$1.stderr.log" | tail -1)
-  echo "   row $1 raw: $out" >> "$OUT/session.log"   # keep failures visible
-  if fresh_json "$out"; then
-    echo "$out" | tee -a benchmarks/ladder_results.jsonl
-    done_mark "row_$1"
-  else
-    echo "   row $1 produced no fresh JSON" | tee -a "$OUT/session.log"
-  fi
-}
-
-json_stage() {
-  done_skip "$1" && return 0
-  local name=$1 t=$2; shift 2
-  echo "== $name $(stamp)" | tee -a "$OUT/session.log"
-  timeout -k 60 "$t" "$@" > "$OUT/$name.log" 2>&1
-  local last
-  last=$(grep -v '^\[' "$OUT/$name.log" | tail -1)
-  echo "   $name raw: $last" >> "$OUT/session.log"
-  if fresh_json "$last"; then
-    echo "$last" >> benchmarks/ladder_results.jsonl
-    echo "$last" | tee -a "$OUT/session.log"
-    done_mark "$name"
-  else
-    echo "   $name produced no fresh JSON (see $name.log)" \
-      | tee -a "$OUT/session.log"
-  fi
-}
+# row() / json_stage() come from slot_lib.sh (single shared copy).
 
 echo "== round-4 follow-up start $(stamp)" | tee -a "$OUT/session.log"
 waitslot 40 || exit 1

@@ -23,21 +23,7 @@ OUT=benchmarks/session_r4
 mkdir -p "$OUT"
 . benchmarks/slot_lib.sh
 
-row() {  # $1 = row stage name, $2 = bench config; appends one JSON line
-  done_skip "row_$1" && return 0
-  echo "== row $1 $(stamp)" | tee -a "$OUT/session.log"
-  local out
-  out=$(DS_BENCH_WATCHDOG="${WATCHDOG:-1200}" DS_BENCH_RUN_MARGIN=700 \
-    timeout -k 30 "${ROWTIMEOUT:-1300}" python bench.py --config "$2" \
-    2>> "$OUT/row_$1.stderr.log" | tail -1)
-  if fresh_json "$out"; then
-    echo "$out" | tee -a benchmarks/ladder_results.jsonl
-    done_mark "row_$1"
-  else
-    echo "   row $1 produced no fresh JSON (see row_$1.stderr.log)" \
-      | tee -a "$OUT/session.log"
-  fi
-}
+# row() comes from slot_lib.sh (single shared copy).
 
 prof() {  # $1 = stage name, $2 = timeout, $3... = command
   done_skip "$1" && return 0
