@@ -101,8 +101,64 @@ def causal_keep_mask(qi_block, ki_block, block_q, block_k):
     return col <= row
 
 
+# Dropout PRNG width: 32 generates one random word per mask BIT (the
+# conservative, chip-validated default); 8 generates one word per FOUR
+# bits and compares bytes — 4x fewer PRNG words in each of the three
+# kernels that regenerate the mask (measured r4: the 32-bit mask costs
+# ~10% of the flagship step).  Flip with DS_DROPOUT_BITS=8 or
+# set_dropout_bits(8); the mode is read at TRACE time, so fwd and bwd of
+# one step always agree (both trace under one jit).
+def _parse_dropout_bits(raw: str) -> int:
+    try:
+        n = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"DS_DROPOUT_BITS={raw!r}: must be 8 or 32") from None
+    if n not in (8, 32):
+        raise ValueError(f"DS_DROPOUT_BITS must be 8 or 32, got {n}")
+    return n
+
+
+_dropout_bits = _parse_dropout_bits(os.environ.get("DS_DROPOUT_BITS", "32"))
+
+
+def set_dropout_bits(n: int) -> None:
+    """Select the in-kernel dropout PRNG width (32 default, 8 = 4x
+    cheaper mask generation at 1/256 keep-probability granularity,
+    bias-corrected by the exact quantized scale).
+
+    Read at TRACE time: already-jit-compiled functions keep the width
+    they were traced with (XLA caches the executable) — re-trace (fresh
+    jax.jit, or new shapes) after flipping for the change to take
+    effect."""
+    if n not in (8, 32):
+        raise ValueError(f"dropout bits must be 8 or 32, got {n}")
+    global _dropout_bits
+    _dropout_bits = n
+
+
+def dropout_bits() -> int:
+    return _dropout_bits
+
+
+def _quantized_threshold(rate: float, bits: int) -> int:
+    """The integer threshold the kernel compares random values against —
+    the ONE definition shared by mask generation and its inverse scale
+    (two copies drifting apart would bias E[output])."""
+    if bits == 8:
+        return max(1, min(256, round((1.0 - rate) * 256)))
+    return min(int((1.0 - rate) * 2 ** 32), 2 ** 32 - 1)
+
+
+def _keep_scale(rate: float, bits: int) -> float:
+    """Exact inverse keep-probability for the quantized threshold the
+    kernel actually compares against — using 1/(1-rate) with the 8-bit
+    threshold would bias E[output] by up to ~0.2%."""
+    return float(2 ** bits) / _quantized_threshold(rate, bits)
+
+
 def _dropout_keep(seed_ref, b, h, qi, ki, rate, block_q, block_k,
-                  num_k_blocks):
+                  num_k_blocks, bits=32):
     """Regenerable per-tile keep mask: the PRNG is reseeded from the step
     seed and the tile's ABSOLUTE coordinates, so the forward kernel and
     both backward kernels (whose grids order (qi, ki) differently)
@@ -131,15 +187,39 @@ def _dropout_keep(seed_ref, b, h, qi, ki, rate, block_q, block_k,
     pltpu.prng_seed(seed_ref[0] + b * pl.num_programs(1) + h,
                     qi * num_k_blocks + ki
                     + seed_ref[0] * np.int32(-1640531527))
-    bits = pltpu.prng_random_bits((block_q, block_k))
-    threshold = np.uint32(min(int((1.0 - rate) * 2 ** 32), 2 ** 32 - 1))
-    return bits.astype(jnp.uint32) < threshold
+    if bits == 8:
+        # one 32-bit word per FOUR mask positions: byte j of word w maps
+        # to column j*block_k/4 + w (column-GROUP layout — no Mosaic
+        # lane interleave needed; each (word, byte) is used exactly
+        # once, so positions stay iid uniform bytes).  Callers decide
+        # bits where block_k is known (_effective_dropout_bits), so the
+        # divisibility precondition holds here by construction.
+        assert block_k % 4 == 0, "8-bit dropout requires block_k % 4 == 0"
+        w = pltpu.prng_random_bits((block_q, block_k // 4))
+        w = w.astype(jnp.uint32)
+        t8 = _quantized_threshold(rate, 8)
+        m = jnp.concatenate(
+            [(w >> np.uint32(8 * j)) & np.uint32(0xFF) for j in range(4)],
+            axis=1)
+        return m < np.uint32(t8)
+    rbits = pltpu.prng_random_bits((block_q, block_k))
+    threshold = np.uint32(_quantized_threshold(rate, 32))
+    return rbits.astype(jnp.uint32) < threshold
+
+
+def _effective_dropout_bits(block_k: int) -> int:
+    """The width BOTH the mask and the scale must use for this kernel
+    call: 8-bit needs four byte-columns per word, so non-multiple-of-4
+    k blocks fall back to 32 — decided once here so mask probability and
+    inverse scale can never disagree."""
+    return _dropout_bits if _dropout_bits == 32 or block_k % 4 == 0 else 32
 
 
 def _fa_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                m_scr, l_scr, acc_scr, *,
                causal: bool, sm_scale: float, block_q: int, block_k: int,
-               num_k_blocks: int, dropout_rate: float):
+               num_k_blocks: int, dropout_rate: float,
+               dropout_pbits: int = 32):
     b = pl.program_id(0)
     h = pl.program_id(1)
     qi = pl.program_id(2)
@@ -189,8 +269,10 @@ def _fa_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
             # probabilities; dropout applies to the normalized P, which
             # commutes with the final /l)
             keep = _dropout_keep(seed_ref, b, h, qi, ki, dropout_rate,
-                                 block_q, block_k, num_k_blocks)
-            p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+                                 block_q, block_k, num_k_blocks,
+                                 bits=dropout_pbits)
+            inv = _keep_scale(dropout_rate, dropout_pbits)
+            p = jnp.where(keep, p * inv, 0.0)
 
         v_blk = _ld(v_ref)                           # [bk, d]
         pv = jax.lax.dot_general(
@@ -322,7 +404,7 @@ def flash_attention_pallas(q, k, v, causal: bool = False,
     kernel = functools.partial(
         _fa_kernel, causal=causal, sm_scale=float(sm_scale),
         block_q=block_q, block_k=block_k, num_k_blocks=nk,
-        dropout_rate=float(dropout_rate))
+        dropout_rate=float(dropout_rate), dropout_pbits=_effective_dropout_bits(block_k))
 
     scratch = [
         pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
@@ -369,7 +451,7 @@ def flash_attention_pallas(q, k, v, causal: bool = False,
 def _fa_bwd_dkdv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                         delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
                         causal, sm_scale, block_q, block_k, num_q_blocks,
-                        num_k_blocks, dropout_rate):
+                        num_k_blocks, dropout_rate, dropout_pbits=32):
     b = pl.program_id(0)
     h = pl.program_id(1)
     ki = pl.program_id(2)
@@ -408,9 +490,11 @@ def _fa_bwd_dkdv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             # same (qi, ki) seeding as the forward — identical mask.
             # dV sees the DROPPED probabilities; dS = P*(D.dp - delta)
             keep = _dropout_keep(seed_ref, b, h, qi, ki, dropout_rate,
-                                 block_q, block_k, num_k_blocks)
-            p_drop = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
-            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
+                                 block_q, block_k, num_k_blocks,
+                                 bits=dropout_pbits)
+            inv = _keep_scale(dropout_rate, dropout_pbits)
+            p_drop = jnp.where(keep, p * inv, 0.0)
+            dp = jnp.where(keep, dp * inv, 0.0)
         else:
             p_drop = p
 
@@ -431,7 +515,7 @@ def _fa_bwd_dkdv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 def _fa_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                       delta_ref, dq_ref, dq_scr, *,
                       causal, sm_scale, block_q, block_k, num_k_blocks,
-                      dropout_rate):
+                      dropout_rate, dropout_pbits=32):
     b = pl.program_id(0)
     h = pl.program_id(1)
     qi = pl.program_id(2)
@@ -466,8 +550,10 @@ def _fa_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             preferred_element_type=jnp.float32)
         if dropout_rate > 0.0:
             keep = _dropout_keep(seed_ref, b, h, qi, ki, dropout_rate,
-                                 block_q, block_k, num_k_blocks)
-            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
+                                 block_q, block_k, num_k_blocks,
+                                 bits=dropout_pbits)
+            inv = _keep_scale(dropout_rate, dropout_pbits)
+            dp = jnp.where(keep, dp * inv, 0.0)
         ds = p * (dp - delta) * sm_scale
         dq_scr[...] += jax.lax.dot_general(            # ds @ k -> [bq, d]
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
@@ -534,7 +620,7 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
     dkdv_kernel = functools.partial(
         _fa_bwd_dkdv_kernel, causal=causal, sm_scale=float(sm_scale),
         block_q=block_q, block_k=block_k, num_q_blocks=nq, num_k_blocks=nk,
-        dropout_rate=float(dropout_rate))
+        dropout_rate=float(dropout_rate), dropout_pbits=_effective_dropout_bits(block_k))
     dk, dv = pl.pallas_call(
         dkdv_kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -572,7 +658,7 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
     dq_kernel = functools.partial(
         _fa_bwd_dq_kernel, causal=causal, sm_scale=float(sm_scale),
         block_q=block_q, block_k=block_k, num_k_blocks=nk,
-        dropout_rate=float(dropout_rate))
+        dropout_rate=float(dropout_rate), dropout_pbits=_effective_dropout_bits(block_k))
     dq = pl.pallas_call(
         dq_kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(

@@ -518,3 +518,38 @@ def test_dequantize_weight_delegates():
     deq = dequantize_weight(qw)
     assert deq.shape == (64, 32)
     np.testing.assert_allclose(np.asarray(deq), wfull, atol=0.05)
+
+
+def test_dropout_keep_scale_quantization():
+    """The in-kernel dropout scale must invert the EXACT quantized keep
+    probability the kernel thresholds against — 8-bit mode quantizes the
+    keep probability to n/256, and using 1/(1-rate) there would bias
+    E[attention output] by up to ~0.2%."""
+    from deepspeed_tpu.ops.flash_attention import (_keep_scale,
+                                                   _quantized_threshold,
+                                                   _effective_dropout_bits,
+                                                   set_dropout_bits,
+                                                   dropout_bits)
+    assert abs(_keep_scale(0.1, 32) - 1 / 0.9) < 1e-6
+    assert _keep_scale(0.1, 8) == 256.0 / round(0.9 * 256)
+    assert _keep_scale(0.0, 8) == 1.0   # keep-all: no scaling
+    # threshold*scale == 2^width exactly (the shared-definition invariant)
+    for rate in (0.05, 0.1, 0.2, 0.5):
+        for bits in (8, 32):
+            assert (_keep_scale(rate, bits)
+                    * _quantized_threshold(rate, bits) == float(2 ** bits))
+    # non-multiple-of-4 k blocks force the 32-bit width for mask AND scale
+    set_dropout_bits(8)
+    try:
+        assert _effective_dropout_bits(128) == 8
+        assert _effective_dropout_bits(6) == 32
+    finally:
+        set_dropout_bits(32)
+    assert _effective_dropout_bits(6) == 32
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        set_dropout_bits(16)
+    set_dropout_bits(8)
+    assert dropout_bits() == 8
+    set_dropout_bits(32)
+    assert dropout_bits() == 32
