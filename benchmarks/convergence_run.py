@@ -39,7 +39,7 @@ VOCAB = 4096         # language support — a strict subset of the model's
                      # (50304*64 would leave ~4 observations per
                      # transition: a memorization task, not a language)
 N_SUCC = 64          # successors per token
-STEPS = int(os.environ.get("DS_CONV_STEPS", 1500))
+STEPS = int(os.environ.get("DS_CONV_STEPS", 5000))
 VAL_EVERY = 100
 VAL_BATCHES = 4
 THRESH_MARGIN = 0.20  # nats above the analytic floor that counts as learned
@@ -141,13 +141,15 @@ def main():
     # does NOT toggle (it is plain XLA either way, but with a
     # hand-written VJP worth isolating)
     fused = bool(int(os.environ.get("DS_CONV_FUSED", "1")))
-    # Optimization knobs for the unigram-shelf probes: at 8192
-    # tokens/step the default 6e-4 is far above standard LR scaling for
-    # 124M (nanoGPT uses 6e-4 at ~500k tokens/step); DS_CONV_LR and
-    # DS_CONV_CLIP let the chip probe the shelf-vs-hyperparameter
-    # hypothesis without code edits.
-    lr = float(os.environ.get("DS_CONV_LR", 6e-4))
-    clip = float(os.environ.get("DS_CONV_CLIP", 0.0))
+    # PRODUCTION optimization config (r4 chip sweep, session_r4c/d/e):
+    # at 8192 tokens/step, lr 6e-4 (and 3e-4) pins the model on the
+    # ln(support)=8.32 unigram shelf — trajectories identical across
+    # fp32/bf16/Pallas/XLA, so pure dynamics, not numerics; 2e-4 + clip
+    # 1.0 breaks the shelf fastest (6.36 nats at step 500 vs 6.64 for
+    # 1e-4) and reaches 4.26 by step 2000 at constant LR.  The linear
+    # decay (WarmupDecayLR below) buys the final approach to the floor.
+    lr = float(os.environ.get("DS_CONV_LR", 2e-4))
+    clip = float(os.environ.get("DS_CONV_CLIP", 1.0))
     cfg = GPT2Config(n_positions=SEQ, bf16=bf16, embd_dropout=drop,
                      attn_dropout=drop, hidden_dropout=drop,
                      hidden_size=hidden, num_layers=n_layers,
@@ -161,9 +163,10 @@ def main():
             "train_micro_batch_size_per_gpu": BATCH,
             "optimizer": {"type": "AdamW",
                           "params": {"lr": lr, "weight_decay": 0.1}},
-            "scheduler": {"type": "WarmupLR",
+            "scheduler": {"type": "WarmupDecayLR",
                           "params": {"warmup_num_steps": 100,
-                                     "warmup_max_lr": lr}},
+                                     "warmup_max_lr": lr,
+                                     "total_num_steps": STEPS}},
             "gradient_clipping": clip,
             "bf16": {"enabled": bf16},
             "zero_optimization": {"stage": 2},
@@ -235,7 +238,7 @@ def main():
         overrides.append(f"drop{drop:g}")
     if not bf16:
         overrides.append("fp32")
-    if STEPS != 1500:
+    if STEPS != 5000:
         overrides.append(f"steps{STEPS}")
     if forced_xla:
         overrides.append("xlaops")
@@ -243,9 +246,9 @@ def main():
         overrides.append(f"h{hidden}l{n_layers}")
     if not fused:
         overrides.append("nofusedce")
-    if lr != 6e-4:
+    if lr != 2e-4:
         overrides.append(f"lr{lr:g}")
-    if clip != 0.0:
+    if clip != 1.0:
         overrides.append(f"clip{clip:g}")
     if vocab != VOCAB or n_succ != N_SUCC:
         overrides.append(f"v{vocab}s{n_succ}")

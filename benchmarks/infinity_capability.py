@@ -89,6 +89,27 @@ def main():
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import GPT2Config
 
+    import threading
+
+    def rss_gb():
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS"):
+                    return int(line.split()[1]) / 2 ** 20
+        return 0.0
+
+    peak = [0.0]
+
+    def _rss_watch():  # 4.2B attempt OOMed at 125 GB: localize the peak
+        while True:
+            r = rss_gb()
+            if r > peak[0] + 2.0:
+                peak[0] = r
+                print(f"[cap] rss {r:.1f} GB", flush=True)
+            time.sleep(10)
+
+    threading.Thread(target=_rss_watch, daemon=True).start()
+
     t_start = time.time()
     cfg = GPT2Config(vocab_size=50257, n_positions=args.seq,
                      hidden_size=args.hidden, num_layers=args.layers,
@@ -158,7 +179,7 @@ def main():
     tpuvm_step = (stream_bytes + grad_bytes) / 16e9
     dev = jax.devices()[0]
     out = {
-        "metric": "gpt_8b_infinity_capability_1chip",
+        "metric": "gpt_infinity_capability_1chip",
         "platform": dev.platform,
         "device_kind": dev.device_kind,
         "value": round(tokens_per_sec, 3),
@@ -172,6 +193,7 @@ def main():
         "hbm_window_groups": engine.max_live_param_groups,
         "step_seconds": round(step_s, 1),
         "first_step_seconds": round(first_step_s, 1),
+        "peak_host_rss_gb": round(max(peak[0], rss_gb()), 1),
         "note": ("measured through the harness tunnel (1.2 GB/s H2D, "
                  "0.02 GB/s D2H); same streaming on a TPU-VM PCIe "
                  f"(16 GB/s) moves all param+grad bytes in "

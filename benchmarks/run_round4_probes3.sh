@@ -1,0 +1,41 @@
+#!/bin/bash
+# Round-4 probe session #4:
+#   1. conv_production — THE convergence baseline run: no env overrides,
+#      the tuned production defaults (lr 2e-4, clip 1.0, WarmupDecayLR,
+#      5000 steps w/ early exit at floor+0.2).  A converged chip run
+#      writes tests/baselines/convergence_gpt2_124m.json and arms
+#      test_chip_convergence_baseline.
+#   2. capability5 — ZeRO-Infinity beyond-HBM retry at 4.2B with the
+#      leaf-streaming step memory fixes (consuming join + ownership-box
+#      grad sweep; the pre-fix step put ~34 GB of avoidable copies on a
+#      125 GB host and OOMed) + RSS telemetry.
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/session_r4f
+mkdir -p "$OUT"
+. benchmarks/slot_lib.sh
+
+stage() {
+  done_skip "$1" && return 0
+  local name=$1 t=$2; shift 2
+  echo "== $name $(stamp)" | tee -a "$OUT/session.log"
+  if timeout -k 60 "$t" "$@" > "$OUT/$name.log" 2>&1; then
+    done_mark "$name"
+  else
+    echo "   $name rc=$? (left unmarked for resume)" \
+      | tee -a "$OUT/session.log"
+  fi
+  tail -4 "$OUT/$name.log" | tee -a "$OUT/session.log"
+}
+
+echo "== round-4 probe session #4 start $(stamp)" | tee -a "$OUT/session.log"
+waitslot 40 || exit 1
+
+json_stage conv_production 3600 python benchmarks/convergence_run.py
+waitslot 10 || exit 1
+
+json_stage capability5 5400 python benchmarks/infinity_capability.py \
+  --layers 20
+
+python benchmarks/render_results.py | tee -a "$OUT/session.log"
+echo "== round-4 probe session #4 done $(stamp)" | tee -a "$OUT/session.log"

@@ -317,6 +317,40 @@ class GPT2Model:
                 params["lm_head"] = groups["head"]["lm_head"]
             return params
 
+        def join_consuming(groups):
+            """join, but each numpy layer-group leaf is FREED right after
+            its row is copied into the stacked array — the transient is
+            one stacked leaf instead of a full second copy of all layer
+            tensors.  The streaming engine's optimizer boundary calls
+            this on the accumulated grad tier, where the naive join's
+            extra full-model copy OOMed a 125 GB host at 4.2B (r4)."""
+            layer_groups = [groups[f"layer{i}"] for i in range(n)]
+            treedef = jax.tree.structure(layer_groups[0])
+            flats = [treedef.flatten_up_to(g) for g in layer_groups]
+            out_leaves = []
+            for li in range(treedef.num_leaves):
+                rows = [flats[i][li] for i in range(n)]
+                if isinstance(rows[0], np.ndarray):
+                    out = np.empty((n,) + rows[0].shape, rows[0].dtype)
+                    for i in range(n):
+                        out[i] = rows[i]
+                        flats[i][li] = None
+                        rows[i] = None
+                else:
+                    out = jnp.stack(rows)
+                out_leaves.append(out)
+            for i in range(n):
+                groups[f"layer{i}"] = None
+            params = {
+                "wte": groups["embed"]["wte"],
+                "wpe": groups["embed"]["wpe"],
+                "h": jax.tree_util.tree_unflatten(treedef, out_leaves),
+                "ln_f": groups["head"]["ln_f"],
+            }
+            if not cfg.tie_word_embeddings:
+                params["lm_head"] = groups["head"]["lm_head"]
+            return params
+
         def embed_fn(embed_g, input_ids, rng):
             wte = embed_g["wte"].astype(cfg.dtype)
             wpe = embed_g["wpe"].astype(cfg.dtype)
@@ -351,7 +385,8 @@ class GPT2Model:
             return optax.softmax_cross_entropy_with_integer_labels(
                 logits, labels).mean()
 
-        return {"split": split, "join": join, "embed_fn": embed_fn,
+        return {"split": split, "join": join,
+                "join_consuming": join_consuming, "embed_fn": embed_fn,
                 "layer_fn": layer_fn, "head_loss_fn": head_loss_fn,
                 "num_layers": n}
 

@@ -162,26 +162,40 @@ class DeepSpeedCPUAdam:
             weight_decay=self.weight_decay, step=self.step_count,
             adamw_mode=self.adamw_mode, bf16_out=bf16_out, lib=self._lib)
 
-    def step(self, grads: Any, lr: Optional[float] = None,
-             emit_bf16: bool = False) -> Optional[Any]:
-        """One fused update; returns the bf16 copy-out tree if emit_bf16."""
+    def step(self, grads: Any = None, lr: Optional[float] = None,
+             emit_bf16: bool = False, *,
+             leaf_list: Optional[list] = None) -> Optional[Any]:
+        """One fused update; returns the bf16 copy-out tree if emit_bf16.
+
+        Pass either `grads` (a pytree matching params — NEVER mutated) or
+        `leaf_list` (an already-flattened leaf list in param order, which
+        is CONSUMED: each entry is set to None right after its leaf
+        update, so a caller holding only the list sees its grad memory
+        released during the sweep — the offload/infinity tiers pass tens
+        of GB here at multi-B-param scale)."""
+        if (grads is None) == (leaf_list is None):
+            raise ValueError("pass exactly one of grads / leaf_list")
         if lr is not None:
             self.lr = float(lr)
         self.step_count += 1
-        g_leaves = self._treedef.flatten_up_to(grads)
+        g_leaves = (leaf_list if leaf_list is not None
+                    else self._treedef.flatten_up_to(grads))
         out_leaves = []
-        for p, m, v, g in zip(self._p_leaves, self.exp_avg,
-                              self.exp_avg_sq, g_leaves):
+        for idx, (p, m, v) in enumerate(zip(self._p_leaves, self.exp_avg,
+                                            self.exp_avg_sq)):
             if m is None:  # non-float leaf: pass through untouched
                 out_leaves.append(p)
                 continue
-            g = np.ascontiguousarray(np.asarray(g, dtype=np.float32))
+            g = np.ascontiguousarray(np.asarray(g_leaves[idx],
+                                                dtype=np.float32))
+            g_leaves[idx] = None  # consume: free the caller-side leaf
             if g.shape != p.shape:
                 raise ValueError(
                     f"grad shape {g.shape} != param shape {p.shape}")
             bf16_out = (np.empty(p.shape, dtype=np.uint16)
                         if emit_bf16 else None)
             self._step_leaf(p, m, v, g, bf16_out)
+            g = None
             out_leaves.append(bf16_out)
         if emit_bf16:
             import ml_dtypes

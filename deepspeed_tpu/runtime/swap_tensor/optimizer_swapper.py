@@ -161,15 +161,27 @@ class NVMeOffloadOptimizer:
 
     # ------------------------------------------------------------------ #
     def apply(self, grads_device: Any, scale_inv: float,
-              lr: Optional[float], store_dtype) -> Optional[Any]:
+              lr: Optional[float], store_dtype, *,
+              boxed: bool = False) -> Optional[Any]:
         """Pipelined swap-in → Adam → swap-out over leaves; returns the
-        updated device-ready param tree, or None on grad overflow."""
+        updated device-ready param tree, or None on grad overflow.
+
+        boxed=True: grads_device is a one-element-list ownership box (see
+        HostOffloadOptimizer.apply) — consumed so each grad leaf can be
+        freed right after its leaf update below."""
+        if boxed:
+            tree = grads_device[0]
+            grads_device[0] = None
+        else:
+            tree = grads_device
         if lr is not None:
             self.lr = float(lr)
         g_all = [np.asarray(g, dtype=np.float32)
-                 for g in jax.tree.leaves(grads_device)]
+                 for g in jax.tree.leaves(tree)]
+        tree = None
         idxs = self._float_indices()
         g_float = {i: g_all[i] for i in idxs}
+        g_all = None
         if not all(np.isfinite(g).all() for g in g_float.values()):
             return None
         if scale_inv != 1.0:
@@ -219,6 +231,7 @@ class NVMeOffloadOptimizer:
                     dt = np.dtype(store_dtype)
                     out[i] = (p.copy() if dt == np.float32
                               else p.astype(dt)).reshape(self._shapes[i])
+                g_float.pop(i, None)  # free this grad leaf (boxed callers)
                 self._write_leaf(i, cur, async_op=True)
                 if has_next:
                     self.read_handle.wait()

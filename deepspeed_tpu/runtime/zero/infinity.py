@@ -75,6 +75,9 @@ class ZeroInfinityEngine:
         api = model.layerwise_api()
         self._split = api["split"]
         self._join = api["join"]
+        # memory-lean variant (frees group leaves as it stacks); models
+        # that don't provide one fall back to the plain join
+        self._join_consuming = api.get("join_consuming", api["join"])
         self._embed_fn = api["embed_fn"]
         self._layer_fn = api["layer_fn"]
         self._head_loss_fn = api["head_loss_fn"]
@@ -412,16 +415,26 @@ class ZeroInfinityEngine:
             return
         assert self._grad_groups is not None, "step() before backward()"
         gas = self.gradient_accumulation_steps()
-        full_grads = self._join(self._grad_groups)
+        # consuming join: each layer-group grad leaf is freed as its row
+        # is copied into the stacked layout, so the join transient is one
+        # stacked leaf — the naive join's full second copy (~17 GB on a
+        # 4.2B model) OOMed a 125 GB host at exactly this point (r4)
+        box = [self._join_consuming(self._grad_groups)]
+        self._grad_groups = None  # leaves now owned by the box alone
         lr = None
         if self.lr_scheduler is not None:
             lr = float(self.lr_scheduler.lr_at(self._opt.step_count()))
-        new_host = self._opt.apply(full_grads, 1.0 / gas, lr,
-                                   self.compute_dtype)
+        # ownership-box call: apply takes the tree out of the box, so the
+        # native sweep can free each grad leaf right after its update
+        new_host = self._opt.apply(box, 1.0 / gas, lr,
+                                   self.compute_dtype, boxed=True)
         overflow = new_host is None
         if not overflow:
+            # astype(copy=False): the emit_bf16 path already returns the
+            # store dtype — an unconditional astype here was a second
+            # full-model copy at exactly the step's memory peak
             new_groups = self._split(jax.tree.map(
-                lambda a: np.asarray(a).astype(self._np_dtype)
+                lambda a: np.asarray(a).astype(self._np_dtype, copy=False)
                 if np.issubdtype(np.asarray(a).dtype, np.floating) or
                 str(np.asarray(a).dtype) == "bfloat16" else np.asarray(a),
                 new_host))
@@ -435,7 +448,6 @@ class ZeroInfinityEngine:
                 self.lr_scheduler.step()
         else:
             self.skipped_steps += 1
-        self._grad_groups = None
         self.global_steps += 1
         self.tput_timer.stop(global_step=True)
         if self.global_steps % self.config.steps_per_print == 0:

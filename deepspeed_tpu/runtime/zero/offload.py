@@ -68,11 +68,27 @@ class HostOffloadOptimizer:
         return self.opt.step_count
 
     def apply(self, grads_device: Any, scale_inv: float,
-              lr: Optional[float], store_dtype) -> Any:
+              lr: Optional[float], store_dtype, *,
+              boxed: bool = False) -> Any:
         """Fetch grads, step host Adam, return updated device-ready params
-        (or None on overflow — the caller skips and rescales)."""
+        (or None on overflow — the caller skips and rescales).
+
+        boxed=True: grads_device is a ONE-ELEMENT LIST ownership box —
+        the tree is taken out of it (box[0] -> None) so this call owns
+        the only reference and the native sweep can free each grad leaf
+        right after its update.  At multi-billion-param scale the grad
+        tier is tens of GB and holding it through the sweep doubles the
+        step's host peak (the r4 4.2B OOM).  Explicit keyword, not a
+        structural guess: a legitimate one-element-list PYTREE must never
+        be mutated."""
+        if boxed:
+            tree = grads_device[0]
+            grads_device[0] = None
+        else:
+            tree = grads_device
         g_leaves = [np.asarray(g, dtype=np.float32)
-                    for g in jax.tree.leaves(grads_device)]
+                    for g in jax.tree.leaves(tree)]
+        tree = None  # leaves now owned by g_leaves alone (when boxed)
         finite = all(np.isfinite(g).all() for g in g_leaves)
         if not finite:
             return None
@@ -85,12 +101,12 @@ class HostOffloadOptimizer:
                 clip = self.gradient_clipping / (norm + 1e-6)
                 for g in g_leaves:
                     g *= clip
-        treedef = jax.tree.structure(self.opt.params)
-        grads = jax.tree_util.tree_unflatten(treedef, g_leaves)
         if store_dtype == jnp.bfloat16:
-            # Native fused update+cast writes the device-bound bf16 copy.
-            return self.opt.step(grads, lr=lr, emit_bf16=True)
-        self.opt.step(grads, lr=lr)
+            # Native fused update+cast writes the device-bound bf16 copy;
+            # passing the leaf LIST lets the sweep free each grad leaf
+            # after its update (step Nones out consumed entries).
+            return self.opt.step(lr=lr, emit_bf16=True, leaf_list=g_leaves)
+        self.opt.step(lr=lr, leaf_list=g_leaves)
         return jax.tree.map(
             lambda pm: pm.astype(np.dtype(store_dtype))
             if pm.dtype == np.float32 and store_dtype != jnp.float32

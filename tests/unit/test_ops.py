@@ -549,7 +549,9 @@ def test_dropout_keep_scale_quantization():
     import pytest as _pytest
     with _pytest.raises(ValueError):
         set_dropout_bits(16)
-    set_dropout_bits(8)
-    assert dropout_bits() == 8
-    set_dropout_bits(32)
+    try:
+        set_dropout_bits(8)
+        assert dropout_bits() == 8
+    finally:
+        set_dropout_bits(32)
     assert dropout_bits() == 32
