@@ -35,11 +35,11 @@ BATCH = 8
 SEQ = 1024
 VOCAB = 4096         # language support — a strict subset of the model's
                      # 50304-token vocab, sized so each of the 4096*64
-                     # transitions is observed ~45x in a 1500-step run
-                     # (50304*64 would leave ~4 observations per
-                     # transition: a memorization task, not a language)
+                     # transitions is observed ~30x per 1000 steps
+                     # (50304*64 would leave ~3 observations per 1000:
+                     # a memorization task, not a language)
 N_SUCC = 64          # successors per token
-STEPS = int(os.environ.get("DS_CONV_STEPS", 5000))
+STEPS = int(os.environ.get("DS_CONV_STEPS", 8000))
 VAL_EVERY = 100
 VAL_BATCHES = 4
 THRESH_MARGIN = 0.20  # nats above the analytic floor that counts as learned
@@ -238,7 +238,7 @@ def main():
         overrides.append(f"drop{drop:g}")
     if not bf16:
         overrides.append("fp32")
-    if STEPS != 5000:
+    if STEPS != 8000:
         overrides.append(f"steps{STEPS}")
     if forced_xla:
         overrides.append("xlaops")

@@ -1,0 +1,24 @@
+#!/bin/bash
+# Round-4 probe session #5: the production convergence baseline, take 2.
+# Take 1 (session_r4f) ended at val 3.9000 vs threshold 3.8810 — 0.019
+# nats short at step 5000 with the LR fully decayed.  The production
+# default is now an 8000-step decay horizon (early exit on crossing the
+# threshold, so a converging run stops sooner).
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/session_r4g
+mkdir -p "$OUT"
+. benchmarks/slot_lib.sh
+
+for i in $(seq 1 600); do
+  pgrep -f run_round4_probes3.sh > /dev/null 2>&1 || break
+  sleep 30
+done
+
+echo "== round-4 probe session #5 start $(stamp)" | tee -a "$OUT/session.log"
+waitslot 60 || exit 1
+
+json_stage conv_production2 3600 python benchmarks/convergence_run.py
+
+python benchmarks/render_results.py | tee -a "$OUT/session.log"
+echo "== round-4 probe session #5 done $(stamp)" | tee -a "$OUT/session.log"
