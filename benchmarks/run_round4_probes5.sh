@@ -1,0 +1,45 @@
+#!/bin/bash
+# Round-4 probe session #6: chip-validate the 8-bit dropout PRNG mode and
+# A/B the flagship step with it.  The 32-bit in-kernel mask costs ~10% of
+# the dropout-on flagship step (94.3 nodrop vs 84.7 TFLOPS); 8-bit
+# generates a quarter of the random words.  Order:
+#   1. the parametrized tests/tpu dropout suite (statistics + FD at both
+#      widths) — Mosaic-validates the byte-unpack path
+#   2. only if green: flagship bench with DS_DROPOUT_BITS=8, stage-logged
+#      (NOT appended to the ladder — the canonical row only moves if the
+#      repo default flips after this reads out)
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/session_r4h
+mkdir -p "$OUT"
+. benchmarks/slot_lib.sh
+
+for i in $(seq 1 600); do
+  pgrep -f run_round4_probes4.sh > /dev/null 2>&1 || break
+  sleep 30
+done
+
+echo "== round-4 probe session #6 start $(stamp)" | tee -a "$OUT/session.log"
+waitslot 60 || exit 1
+
+if ! done_skip dropout8_tests; then
+  echo "== tests/tpu dropout (8+32 bit) $(stamp)" | tee -a "$OUT/session.log"
+  if timeout -k 30 1800 python -m pytest \
+      "tests/tpu/test_kernel_parity_tpu.py::test_flash_inkernel_dropout_tpu" \
+      -q -rs > "$OUT/dropout8_tests.log" 2>&1; then
+    done_mark dropout8_tests
+  fi
+  tail -3 "$OUT/dropout8_tests.log" | tee -a "$OUT/session.log"
+  waitslot 10 || exit 1
+fi
+
+if done_skip dropout8_tests && ! done_skip gpt2_bits8; then
+  echo "== flagship A/B DS_DROPOUT_BITS=8 $(stamp)" | tee -a "$OUT/session.log"
+  DS_DROPOUT_BITS=8 DS_BENCH_WATCHDOG=1200 DS_BENCH_RUN_MARGIN=700 \
+    timeout -k 30 1300 python bench.py --config gpt2 \
+    > "$OUT/gpt2_bits8.log" 2>&1
+  tail -1 "$OUT/gpt2_bits8.log" | tee -a "$OUT/session.log"
+  done_mark gpt2_bits8
+fi
+
+echo "== round-4 probe session #6 done $(stamp)" | tee -a "$OUT/session.log"
