@@ -1,0 +1,27 @@
+#!/bin/bash
+# Round-4 probe session #7: capability, take 3.  Scaling from the
+# measured 124M infinity row (~170 s/step, transfer-bound through the
+# 0.02 GB/s D2H tunnel), a 4.2B first step needs ~1.5-2 h — the take-2
+# run was healthy (RSS flat at ~71 GB with the step-memory fixes) but
+# the 5400 s stage budget could never contain it.  Take 3: ~3.0B
+# (--layers 14, inside the VERDICT's 3-7B ask), 9000 s budget, phase
+# tracing on so the budget is attributable.
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/session_r4i
+mkdir -p "$OUT"
+. benchmarks/slot_lib.sh
+
+for i in $(seq 1 600); do
+  pgrep -f run_round4_probes5.sh > /dev/null 2>&1 || break
+  sleep 30
+done
+
+echo "== round-4 probe session #7 start $(stamp)" | tee -a "$OUT/session.log"
+waitslot 60 || exit 1
+
+DS_INFINITY_TRACE=1 json_stage capability6 9000 \
+  python benchmarks/infinity_capability.py --layers 14
+
+python benchmarks/render_results.py | tee -a "$OUT/session.log"
+echo "== round-4 probe session #7 done $(stamp)" | tee -a "$OUT/session.log"

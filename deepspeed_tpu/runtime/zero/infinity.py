@@ -286,12 +286,20 @@ class ZeroInfinityEngine:
         return sub
 
     # ------------------------------------------------------------------ #
+    _trace = bool(int(os.environ.get("DS_INFINITY_TRACE", "0")))
+
+    def _t(self, msg):
+        if self._trace:
+            import time as _time
+            print(f"[inf-trace] {msg} @{_time.time():.1f}", flush=True)
+
     def forward(self, input_ids, labels=None):
         """Stream groups forward; returns the loss.  The head runs fused
         with value_and_grad so backward() starts with the cotangent ready
         (the reference's PreBackwardFunction re-fetch begins the same way,
         stage3.py:546)."""
         self.tput_timer.start()
+        self._t("fwd start")
         rng = self._next_rng() if self._is_dropout_mode() else None
         ids = jnp.asarray(input_ids)
         lbl = None if labels is None else jnp.asarray(labels)
@@ -317,6 +325,7 @@ class ZeroInfinityEngine:
             if self._swapper is not None:
                 self._swapper.release(f"layer{i}")
 
+        self._t("fwd layers done")
         head_g = self._fetch_device("head")
         embed_g = self._fetch_device("embed")
         loss, (g_head, g_embed_head, dh) = self._jit_head(
@@ -326,6 +335,7 @@ class ZeroInfinityEngine:
         if self._swapper is not None:
             self._swapper.release("head")
             self._swapper.release("embed")
+        self._t("fwd head done")
         self._acts = acts
         self._pending = {"rng": rng, "ids": ids, "dh": dh,
                          "g_head": g_head, "g_embed_head": g_embed_head}
@@ -374,6 +384,7 @@ class ZeroInfinityEngine:
                     leaf.copy_to_host_async()
             return (name, tree)
 
+        self._t("bwd start")
         inflight = start_copy("head", pend["g_head"])
         self._prefetch(f"layer{self.num_layers - 1}")
         for i in reversed(range(self.num_layers)):
@@ -391,6 +402,7 @@ class ZeroInfinityEngine:
             p = self._release_device(p)
             if self._swapper is not None:
                 self._swapper.release(f"layer{i}")
+            self._t(f"bwd layer{i} done")
 
         embed_g = self._fetch_device("embed")
         g_embed = self._jit_embed_vjp(embed_g, ids, dh, rng)
@@ -419,6 +431,7 @@ class ZeroInfinityEngine:
         # is copied into the stacked layout, so the join transient is one
         # stacked leaf — the naive join's full second copy (~17 GB on a
         # 4.2B model) OOMed a 125 GB host at exactly this point (r4)
+        self._t("step join start")
         box = [self._join_consuming(self._grad_groups)]
         self._grad_groups = None  # leaves now owned by the box alone
         lr = None
@@ -426,8 +439,10 @@ class ZeroInfinityEngine:
             lr = float(self.lr_scheduler.lr_at(self._opt.step_count()))
         # ownership-box call: apply takes the tree out of the box, so the
         # native sweep can free each grad leaf right after its update
+        self._t("step apply start")
         new_host = self._opt.apply(box, 1.0 / gas, lr,
                                    self.compute_dtype, boxed=True)
+        self._t("step apply done")
         overflow = new_host is None
         if not overflow:
             # astype(copy=False): the emit_bf16 path already returns the
