@@ -1,0 +1,44 @@
+#!/bin/bash
+# Round-4 tail watcher: the relay (stdio tunnel bridge) died at ~01:40Z
+# (its stdin EOF'd — only the driver side can re-establish it; round 3
+# saw both multi-hour outages and recoveries).  The remaining chip
+# stages are all marker-resumable, so this watcher probes every 4 min
+# and, whenever the slot answers, (re)runs the chain serially:
+#   probes4 (conv take-2) -> probes5 (8-bit dropout) -> probes6 (3B
+#   capability).  Scripts exit fast when all their markers are done.
+set -u
+cd "$(dirname "$0")/.."
+LOG=benchmarks/session_r4_tail.log
+
+probe_ok() {
+  timeout -k 10 75 python -c "import jax; jax.devices()[0]" \
+    > /dev/null 2>&1
+}
+
+chain_running() {
+  pgrep -f "run_round4_probes[456].sh" > /dev/null 2>&1
+}
+
+all_done() {
+  [ -e benchmarks/session_r4g/done/conv_production2 ] &&
+  [ -e benchmarks/session_r4h/done/gpt2_bits8 ] &&
+  [ -e benchmarks/session_r4i/done/capability6 ]
+}
+
+echo "== tail watcher start $(date -u +%FT%TZ)" >> "$LOG"
+while true; do
+  if all_done; then
+    echo "== all tail stages done $(date -u +%FT%TZ)" >> "$LOG"
+    break
+  fi
+  if ! chain_running && probe_ok; then
+    echo "== slot ok, (re)launching chain $(date -u +%FT%TZ)" >> "$LOG"
+    bash benchmarks/run_round4_probes4.sh \
+      >> benchmarks/session_r4g_nohup.log 2>&1
+    bash benchmarks/run_round4_probes5.sh \
+      >> benchmarks/session_r4h_nohup.log 2>&1
+    bash benchmarks/run_round4_probes6.sh \
+      >> benchmarks/session_r4i_nohup.log 2>&1
+  fi
+  sleep 240
+done
