@@ -185,3 +185,31 @@ def test_checkpoint_roundtrip(tmp_path):
     for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
         np.testing.assert_array_equal(a, b)
     assert engine2.global_steps == 2
+
+
+def test_join_consuming_matches_join_and_frees():
+    """join_consuming must produce a tree EQUAL to join (same stacked
+    layout the optimizer tier was built around) while consuming its
+    input: every numpy layer-group leaf reference is dropped (set to
+    None) once stacked — the r4 fix for the optimizer-boundary OOM at
+    multi-B params (a full second copy of all layer grads)."""
+    model = _model()
+    api = model.layerwise_api()
+    params = model.init_params(jax.random.PRNGKey(0))
+    host = jax.tree.map(lambda a: np.asarray(a, np.float32), params)
+
+    groups_a = api["split"](host)
+    groups_b = api["split"](host)
+    # split returns views of the SAME host arrays for both copies, so
+    # value comparison below is against independent reconstructions
+    joined = api["join"](groups_a)
+    consumed = api["join_consuming"](groups_b)
+
+    la = jax.tree.leaves(joined)
+    lb = jax.tree.leaves(consumed)
+    assert len(la) == len(lb)
+    for a, b in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # the consuming join must have dropped every layer-group reference
+    for i in range(api["num_layers"]):
+        assert groups_b[f"layer{i}"] is None
