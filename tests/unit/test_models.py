@@ -142,3 +142,23 @@ def test_gpt2_tensor_parallel_training_on_mesh():
     engine.backward(loss1)
     engine.step()
     assert float(loss1) < float(loss0)
+
+
+def test_bert_activation_checkpointing_same_loss_and_grads():
+    """BertConfig.activation_checkpointing must be a pure memory knob —
+    identical loss and gradients (it is what lets bert_s512 fit 24 layers
+    of seq-512 activations in HBM; bench.py r4)."""
+    cfg_kw = dict(vocab_size=128, max_position_embeddings=32,
+                  hidden_size=32, num_layers=2, num_heads=2, bf16=False,
+                  embd_dropout=0.0, attn_dropout=0.0, hidden_dropout=0.0)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 128)
+    out = {}
+    for ckpt in (False, True):
+        model = BertModel(BertConfig(activation_checkpointing=ckpt,
+                                     **cfg_kw))
+        params = model.init_params(jax.random.PRNGKey(0))
+        loss, grads = jax.value_and_grad(
+            lambda p: model.mlm_loss(p, None, ids, ids))(params)
+        out[ckpt] = (float(loss),
+                     float(jnp.mean(jnp.abs(jax.tree.leaves(grads)[0]))))
+    assert np.allclose(out[False], out[True], rtol=1e-5)
