@@ -245,3 +245,18 @@ def test_time_steps_gas_alignment(monkeypatch):
     monkeypatch.delenv("DS_BENCH_ITERS")
     dt, _, n = bench._time_steps(step, warmup=1, iters=10, align=3)
     assert n == 12 and calls["n"] == 13
+
+
+def test_benches_and_metric_names_stay_in_sync():
+    """Every --config has an error-path metric entry and vice versa, and
+    the success-path metric a bench emits matches it — a drifted entry
+    makes the failure JSON carry a DIFFERENT metric name than the
+    success row, orphaning the stale-fallback lookup (bench.py's
+    _last_measured matches by metric name)."""
+    import bench
+    assert set(bench.BENCHES) == set(bench.METRIC_NAMES)
+    # spot-verify the parameterized rows' success metric == error metric
+    assert bench.METRIC_NAMES["bert_s512"][0] == \
+        "bert_large_z2_s512_samples_per_sec_1chip"
+    assert bench.METRIC_NAMES["bert_z2"][0] == \
+        "bert_large_z2_samples_per_sec_1chip"
