@@ -39,8 +39,8 @@ while true; do
       >> benchmarks/session_r4h_nohup.log 2>&1
     # the ~2.5 h capability stage must NOT hold the single claim slot
     # into the driver's end-of-round bench window — only start it with
-    # a wide margin (before 07:30Z)
-    if [ "$(date -u +%Y%m%d%H%M)" -lt 202608010730 ]; then
+    # a wide margin (round restarted 08:24Z Aug 1; ends ~20:24Z)
+    if [ "$(date -u +%Y%m%d%H%M)" -lt 202608011630 ]; then
       bash benchmarks/run_round4_probes6.sh \
         >> benchmarks/session_r4i_nohup.log 2>&1
     else

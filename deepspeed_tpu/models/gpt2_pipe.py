@@ -63,6 +63,31 @@ class GPT2BlockPipe(PipeLayer):
     def param_partition_specs(self):
         return type(self.layer).param_partition_specs()
 
+    # -- explicit-collective TP (the gated 1F1B executor's manual mode;
+    #    ops/transformer.py tp_axis= / tp_manual_* docstrings) ---------- #
+    def supports_manual_tp(self, tp_size: int) -> bool:
+        """Config-level gate for the manual mode: sparse attention builds
+        its layouts for the GLOBAL head count (SparseSelfAttention rejects
+        a local head shard), and shard_map needs the heads dim to divide
+        evenly over the model axis (GSPMD's column split tolerated uneven
+        shards via padding; the manual split does not)."""
+        return (self.layer.config.sparsity_config is None
+                and self.cfg.num_heads % tp_size == 0)
+
+    def apply_manual_tp(self, params, x, rng=None, tp_axis=None):
+        from ..parallel.mesh import MODEL_AXIS
+        return self.layer(params, x, rng=rng, deterministic=rng is None,
+                          tp_axis=tp_axis or MODEL_AXIS)
+
+    def tp_manual_views(self, params):
+        return type(self.layer).tp_manual_views(params, self.cfg.num_heads)
+
+    def tp_manual_unview(self, params):
+        return type(self.layer).tp_manual_unview(params)
+
+    def tp_manual_view_specs(self):
+        return type(self.layer).tp_manual_view_specs()
+
 
 class GPT2HeadPipe(PipeLayer):
     """Final LN + (untied) LM head producing fp32 logits."""

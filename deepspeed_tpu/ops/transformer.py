@@ -9,15 +9,20 @@ names as the reference for checkpoint parity).  Attention runs the Pallas
 flash kernel; LN the fused LN; bias/gelu/dropout chains are left to XLA
 fusion.  Tensor parallelism is declared, not coded: `param_partition_specs`
 returns the Megatron-style column/row split over the "model" mesh axis and
-GSPMD inserts the per-layer collectives.
+GSPMD inserts the per-layer collectives.  (Exception: inside shard_map-manual
+regions — the gated 1F1B executor — `__call__(tp_axis=...)` runs the same
+split with EXPLICIT psums so the collectives stay out of divergent control
+flow; see tp_grad_psum_specs.)
 """
 
 import math
 from dataclasses import dataclass, field
+from functools import partial
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import MODEL_AXIS
@@ -108,6 +113,51 @@ class DeepSpeedTransformerConfig:
         return jnp.float32
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _tp_psum(x, axis):
+    """Megatron's "g" operator for MANUAL TP under check_vma=False:
+    all-reduce forward, IDENTITY backward.  shard_map without vma
+    tracking transposes lax.psum to psum, which would multiply every
+    upstream cotangent by tp_size (the output cotangent is replicated);
+    the counterpart "f" (identity forward, psum backward) is the
+    executor's explicit psum of the layer-input cotangent."""
+    return lax.psum(x, axis)
+
+
+def _tp_psum_fwd(x, axis):
+    return lax.psum(x, axis), None
+
+
+def _tp_psum_bwd(axis, _, ct):
+    return (ct,)
+
+
+_tp_psum.defvjp(_tp_psum_fwd, _tp_psum_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _tp_fcast(x, axis):
+    """Megatron's "f" operator: IDENTITY forward, all-reduce backward.
+    Placed at each sublayer input (the replicated->column-parallel
+    boundary): the per-peer cotangent arriving there is only that peer's
+    partial (it flowed through the peer's own weight shards), and the
+    backward psum restores the full cotangent — so every upstream grad
+    (LN scales, the residual stream, the layer input) is exact
+    per-device with no post-hoc correction."""
+    return x
+
+
+def _tp_fcast_fwd(x, axis):
+    return x, None
+
+
+def _tp_fcast_bwd(axis, _, ct):
+    return (lax.psum(ct, axis),)
+
+
+_tp_fcast.defvjp(_tp_fcast_fwd, _tp_fcast_bwd)
+
+
 class DeepSpeedTransformerLayer:
     """Fused transformer layer (reference: transformer.py:462).
 
@@ -172,6 +222,53 @@ class DeepSpeedTransformerLayer:
             })
         return specs
 
+    @staticmethod
+    def tp_manual_views(params, heads: int):
+        """Rearrange the fused qkv leaves head-major for MANUAL TP.
+
+        Storage keeps the reference's blocked [q|k|v] layout (attn_qkvw
+        [..., H, 3H], attn_qkvb [..., 3H]) — HF policy imports, the MP
+        resize merge/split (state_dict_factory) and inference all assume
+        it.  A contiguous model-axis shard of that layout holds
+        MISmatched q/k/v pieces, so the gated executor views them as
+        [..., H, heads, 3, d] / [..., heads, 3, d] (a free in-graph
+        reshape+swap applied OUTSIDE the shard_map; AD transposes it) —
+        any contiguous shard of the heads dim then carries matched head
+        groups.  Returns the viewed tree; `tp_manual_unview` restores
+        storage layout (for the grads)."""
+        p = dict(params)
+        w = p["attn_qkvw"]
+        d = w.shape[-2] // heads
+        p["attn_qkvw"] = w.reshape(
+            w.shape[:-1] + (3, heads, d)).swapaxes(-3, -2)
+        bias = p["attn_qkvb"]
+        p["attn_qkvb"] = bias.reshape(
+            bias.shape[:-1] + (3, heads, d)).swapaxes(-3, -2)
+        return p
+
+    @staticmethod
+    def tp_manual_unview(params):
+        """Inverse of tp_manual_views (applied to the grads)."""
+        p = dict(params)
+        w = p["attn_qkvw"]  # [..., H, heads, 3, d]
+        heads, _, d = w.shape[-3:]
+        p["attn_qkvw"] = w.swapaxes(-3, -2).reshape(
+            w.shape[:-3] + (3 * heads * d,))
+        bias = p["attn_qkvb"]
+        p["attn_qkvb"] = bias.swapaxes(-3, -2).reshape(
+            bias.shape[:-3] + (3 * heads * d,))
+        return p
+
+    @staticmethod
+    def tp_manual_view_specs(ffn: str = "dense"):
+        """param_partition_specs in the tp_manual_views layout: the qkv
+        leaves shard on their heads dim; everything else is unchanged
+        (attn_ow's row shard is already head-contiguous)."""
+        specs = DeepSpeedTransformerLayer.param_partition_specs(ffn)
+        specs["attn_qkvw"] = P(None, MODEL_AXIS, None, None)
+        specs["attn_qkvb"] = P(MODEL_AXIS, None, None)
+        return specs
+
     def num_params(self):
         h, i = self.config.hidden_size, self.config.intermediate_size
         if self.config.ffn != "dense":
@@ -181,14 +278,29 @@ class DeepSpeedTransformerLayer:
 
     # -- forward ------------------------------------------------------- #
     def __call__(self, params, x, attn_mask=None, rng=None,
-                 deterministic: bool = False):
+                 deterministic: bool = False, tp_axis: Optional[str] = None):
         """x: [B, S, H] -> [B, S, H].  attn_mask: additive [B, 1, 1, S] or
-        [B, 1, S, S] bias, like the reference's input_mask."""
+        [B, 1, S, S] bias, like the reference's input_mask.
+
+        tp_axis: MANUAL tensor parallelism — params are LOCAL Megatron
+        shards (param_partition_specs layout over that mesh axis) and the
+        row-parallel matmul outputs are psum'd explicitly here, instead of
+        GSPMD inserting the collectives from sharding annotations.  Used
+        inside shard_map-manual regions where GSPMD-placed collectives
+        would land in divergent control flow (the gated 1F1B executor's
+        per-stage lax.cond branches — one_f_one_b.py).  x and the returned
+        activation are replicated over tp_axis."""
         cfg = self.config
         eps = cfg.layer_norm_eps
         heads = cfg.heads
         b, s, h = x.shape
         d = h // heads
+        if tp_axis is not None:
+            # local heads from the head-major qkv view [H, hl, 3, d]
+            # (tp_manual_views — a contiguous model-axis shard of the
+            # blocked [q|k|v] layout would hold MISmatched q/k/v pieces)
+            heads = params["attn_qkvw"].shape[-3]
+        hw = heads * d  # local attention width (== h without tp_axis)
         has_dropout = (cfg.attn_dropout_ratio > 0.0 or
                        cfg.hidden_dropout_ratio > 0.0)
         if rng is None:
@@ -199,6 +311,12 @@ class DeepSpeedTransformerLayer:
             rng = jax.random.PRNGKey(0)
             deterministic = True
         r_attn, r_hid1, r_hid2 = jax.random.split(rng, 3)
+        if tp_axis is not None:
+            # decorrelate the attention-probability dropout across head
+            # shards (each peer sees only its local heads); the hidden
+            # dropouts run AFTER the psums on replicated values and must
+            # keep the shared key
+            r_attn = jax.random.fold_in(r_attn, lax.axis_index(tp_axis))
 
         x = x.astype(cfg.dtype)
         residual = x
@@ -207,10 +325,22 @@ class DeepSpeedTransformerLayer:
                                        eps)
         else:
             attn_in = x
+        if tp_axis is not None:
+            attn_in = _tp_fcast(attn_in, tp_axis)
 
-        qkv = matmul_maybe_int8(attn_in, params["attn_qkvw"]) + \
-            params["attn_qkvb"].astype(attn_in.dtype)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        if tp_axis is None:
+            qkv = matmul_maybe_int8(attn_in, params["attn_qkvw"]) + \
+                params["attn_qkvb"].astype(attn_in.dtype)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+        else:
+            # head-major local view: w [H, hl, 3, d], b [hl, 3, d]
+            qkv = jnp.einsum(
+                "bsh,hjcd->bsjcd", attn_in,
+                params["attn_qkvw"].astype(attn_in.dtype)) + \
+                params["attn_qkvb"].astype(attn_in.dtype)
+            q, k, v = (qkv[..., 0, :].reshape(b, s, hw),
+                       qkv[..., 1, :].reshape(b, s, hw),
+                       qkv[..., 2, :].reshape(b, s, hw))
 
         # attention dropout placement (attn_dropout_impl):
         #   "kernel" (default) — probability dropout INSIDE the flash
@@ -261,7 +391,7 @@ class DeepSpeedTransformerLayer:
                                     causal=cfg.causal,
                                     key_padding_mask=sparse_kp,
                                     attn_mask=sparse_am)
-            ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h)
+            ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, hw)
             ctx = dropout(ctx, cfg.attn_dropout_ratio, r_attn, deterministic)
         elif cfg.attn_layout == "bshd":
             # [B,S,H] -> [B,S,heads,d] is a free view; the layout
@@ -277,7 +407,7 @@ class DeepSpeedTransformerLayer:
                 block_q=cfg.block_q, block_k=cfg.block_k,
                 impl=cfg.attn_impl, dropout_rate=attn_rate,
                 dropout_seed=attn_seed())
-            ctx = ctx.reshape(b, s, h)
+            ctx = ctx.reshape(b, s, hw)
             if not kernel_drop:
                 ctx = dropout(ctx, cfg.attn_dropout_ratio, r_attn,
                               deterministic)
@@ -290,12 +420,16 @@ class DeepSpeedTransformerLayer:
                 bias=attn_mask, block_q=cfg.block_q, block_k=cfg.block_k,
                 impl=cfg.attn_impl, dropout_rate=attn_rate,
                 dropout_seed=attn_seed())
-            ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, h)
+            ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, hw)
             if not kernel_drop:
                 ctx = dropout(ctx, cfg.attn_dropout_ratio, r_attn,
                               deterministic)
 
         attn_out = matmul_maybe_int8(ctx, params["attn_ow"])
+        if tp_axis is not None:
+            # row-parallel output projection: merge the per-peer partials
+            # BEFORE bias/dropout/residual (replicated from here on)
+            attn_out = _tp_psum(attn_out, tp_axis)
         attn_out = bias_dropout_residual(
             attn_out, params["attn_ob"].astype(attn_out.dtype), residual,
             cfg.hidden_dropout_ratio, r_hid1, deterministic)
@@ -316,11 +450,15 @@ class DeepSpeedTransformerLayer:
                                         params["attn_nb"], eps)
             mlp_in = attn_out
             mlp_residual = attn_out
+        if tp_axis is not None:
+            mlp_in = _tp_fcast(mlp_in, tp_axis)
 
         inter = bias_gelu(matmul_maybe_int8(mlp_in, params["inter_w"]),
                           params["inter_b"].astype(mlp_in.dtype),
                           approximate=cfg.gelu_approximate)
         out = matmul_maybe_int8(inter, params["output_w"])
+        if tp_axis is not None:
+            out = _tp_psum(out, tp_axis)
         out = bias_dropout_residual(
             out, params["output_b"].astype(out.dtype), mlp_residual,
             cfg.hidden_dropout_ratio, r_hid2, deterministic)

@@ -129,35 +129,65 @@ class PipelineEngine(DeepSpeedEngine):
         # schedule_efficiency) — one program for every device, no
         # divergent control flow.
         #
-        # TP limitation (measured on the 8-device mesh, round 4): with a
-        # model axis > 1, GSPMD places whole-mesh collectives INSIDE the
-        # divergent cond branches (the TP reductions of the stage body),
-        # and devices in different pipe rows then wait on different
-        # collectives — a rendezvous deadlock (4+4 split on collective
-        # permutes).  Until the stage body's collectives can be hoisted
-        # out of the gates, pipe×model meshes run the masked executor.
+        # TP composition (round 4): GSPMD-auto TP deadlocks under the
+        # gates (GSPMD places the stage body's TP reductions INSIDE the
+        # divergent cond branches; pipe rows then wait on different
+        # collectives — 4+4 split measured on the 8-device mesh).  The
+        # gated executor instead takes the model axis MANUAL when the
+        # body layer implements the explicit-collective Megatron split
+        # (apply_manual_tp — ops/transformer.py tp_axis mode); model
+        # peers share their pipe row's predicate so in-branch psums
+        # can't diverge.  Seq-parallel ring permutes in the body remain
+        # unsupported under the gates (masked executor).  data/expert
+        # grad reductions happen OUTSIDE the gates (out_specs /
+        # end-of-scan psums) and are safe — measured green at pipe×data.
         gated_cfg = (raw.get("pipeline") or {}).get("gated")
-        # any non-pipe axis whose collectives can appear in the stage
-        # body (TP reductions, sequence-parallel ppermutes) hits the
-        # same mechanism; data/expert grad reductions happen OUTSIDE the
-        # gates (out_specs / end-of-scan psums) and are safe — measured
-        # green at pipe×data on the 8-device mesh.
-        inbody_axes = (ctx.model_parallel_world_size > 1 or
-                       ctx.seq_parallel_world_size > 1)
-        if gated_cfg and inbody_axes:
+        body = model.body_layer()
+        # the manual mode needs the full API (views/unview/specs are all
+        # called by _make_1f1b_program) AND a config-level yes from the
+        # body (sparse-attention layouts are built for global head
+        # counts; heads must divide the model axis — supports_manual_tp)
+        _manual_api = ("apply_manual_tp", "tp_manual_views",
+                       "tp_manual_unview", "tp_manual_view_specs")
+        tp_world = ctx.model_parallel_world_size > 1
+        if not all(hasattr(body, m) for m in _manual_api):
+            tp_manual_why = (
+                "this body only declares GSPMD specs (no explicit-"
+                "collective TP mode — apply_manual_tp/tp_manual_*), and "
+                "GSPMD places the TP collectives inside the divergent "
+                "branches: a rendezvous deadlock")
+        elif (hasattr(body, "supports_manual_tp") and tp_world and
+              not body.supports_manual_tp(ctx.model_parallel_world_size)):
+            tp_manual_why = (
+                "the body declines manual TP for this config "
+                "(supports_manual_tp=False: sparse-attention layouts "
+                "need global head counts, or num_heads does not divide "
+                "the model axis)")
+        else:
+            tp_manual_why = None
+        seq_inbody = ctx.seq_parallel_world_size > 1
+        gating_blocked = seq_inbody or (tp_world and tp_manual_why
+                                        is not None)
+        if gated_cfg and gating_blocked:
             raise ValueError(
-                "pipeline.gated=true cannot compose with model/seq "
-                "axes > 1: GSPMD places the stage body's collectives "
-                "(TP reductions, ring-attention permutes) inside the "
-                "divergent per-stage branches, which deadlocks — drop "
-                "the explicit gated flag to use the masked executor on "
-                "this mesh")
-        self.schedule_gated = (bool(gated_cfg)
-                               if gated_cfg is not None else not inbody_axes)
-        if inbody_axes and gated_cfg is None:
+                "pipeline.gated=true cannot run on this mesh: "
+                + ("sequence-parallel ring permutes inside the stage "
+                   "body do not compose with the divergent per-stage "
+                   "branches" if seq_inbody else
+                   "a model axis > 1 needs the body's manual TP mode — "
+                   + tp_manual_why)
+                + " — drop the explicit gated flag to use the masked "
+                "executor")
+        self.schedule_gated = (bool(gated_cfg) if gated_cfg is not None
+                               else not gating_blocked)
+        self._tp_manual = (self.schedule_gated and tp_world)
+        if gating_blocked and gated_cfg is None:
             log_dist(
                 "PipelineEngine: masked 1F1B executor (gated executor "
-                "does not compose with model/seq axes yet)", ranks=[0])
+                "does not compose with "
+                + ("seq axes" if seq_inbody else
+                   "this body/config under TP: " + str(tp_manual_why))
+                + ")", ranks=[0])
         if schedule == "1f1b":
             # hand-scheduled fwd/bwd interleave: the base engine compiles
             # this program directly instead of value_and_grad
@@ -236,6 +266,8 @@ class PipelineEngine(DeepSpeedEngine):
             return lax.with_sharding_constraint(
                 x, NamedSharding(mesh, PartitionSpec(*spec)))
 
+        tp_manual = getattr(self, "_tp_manual", False)
+
         def stage_apply(stage_params, x, mb, stage_idx, rng_base):
             # dropout seeds keyed by (microbatch, global layer index) so the
             # backward-lane remat replays the forward bit-exactly
@@ -243,6 +275,10 @@ class PipelineEngine(DeepSpeedEngine):
                 lp, j = lp_j
                 r = jax.random.fold_in(
                     rng_base, mb * n_layers + lo + stage_idx * k + j)
+                if tp_manual:
+                    # explicit-collective Megatron split (params arrive in
+                    # the head-major tp_manual_views layout)
+                    return body_layer.apply_manual_tp(lp, carry, rng=r), None
                 return body_layer.apply(lp, carry, rng=r), None
 
             x, _ = lax.scan(one_layer, x, (stage_params, jnp.arange(k)))
@@ -259,7 +295,28 @@ class PipelineEngine(DeepSpeedEngine):
                 rng=jax.random.fold_in(rng_post, mb))
             return loss_fn(o, y_mb)
 
-        if self.schedule_gated:
+        if self.schedule_gated and tp_manual:
+            from ...parallel.mesh import MODEL_AXIS
+            body = body_layer
+            inner = make_gated_1f1b_grad_fn(
+                mesh=mesh, stage_apply=stage_apply, pre_apply=pre_apply,
+                post_loss=post_loss, micro_batches=M, num_stages=S,
+                model_axis=MODEL_AXIS,
+                block_specs=body.tp_manual_view_specs())
+
+            def grad_fn(params, loss_scale, rng, xm, ym):
+                # storage keeps the blocked [q|k|v] qkv layout (checkpoint
+                # and GSPMD-path parity); the head-major view is a free
+                # in-graph rearrange whose transpose AD applies to the
+                # grads — the resharding it implies happens once at the
+                # shard_map boundary
+                p2 = dict(params)
+                p2["blocks"] = body.tp_manual_views(params["blocks"])
+                loss, grads = inner(p2, loss_scale, rng, xm, ym)
+                g2 = dict(grads)
+                g2["blocks"] = body.tp_manual_unview(grads["blocks"])
+                return loss, g2
+        elif self.schedule_gated:
             grad_fn = make_gated_1f1b_grad_fn(
                 mesh=mesh, stage_apply=stage_apply, pre_apply=pre_apply,
                 post_loss=post_loss, micro_batches=M, num_stages=S)

@@ -162,9 +162,12 @@ def test_schedule_efficiency_quantified():
 
 
 def test_gated_with_tensor_parallel_guard():
-    """Explicit gated=true under TP must be a loud config error (GSPMD
-    puts TP collectives inside the divergent branches — deadlock), and
-    the default must silently select the masked executor there."""
+    """Explicit gated=true under TP with a body that has NO manual-TP
+    mode (test_pipe's plain Block declares only GSPMD specs) must be a
+    loud config error (GSPMD would put the TP collectives inside the
+    divergent branches — deadlock), and the default must silently select
+    the masked executor there.  Bodies WITH the explicit-collective mode
+    (GPT2BlockPipe) gate under TP — test_gated_tp_manual_default."""
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from test_pipe import CONFIG, make_module
 
@@ -185,6 +188,123 @@ def test_gated_with_tensor_parallel_guard():
         example_input=jnp.zeros((4, 8), jnp.float32),
         rng=jax.random.PRNGKey(3))
     assert engine.schedule_gated is False
+    deepspeed_tpu.reset_mesh_context()
+
+
+def test_gated_tp_manual_default():
+    """pipe×model with a manual-TP-capable body (GPT2BlockPipe) defaults
+    to the GATED executor — the round-4 explicit-collective Megatron
+    split keeps the TP psums inside uniform-predicate branches, so the
+    GSPMD-auto deadlock mechanism never arises.  One train_batch runs as
+    the deadlock regression check; trajectory equality vs the pipe=1/tp=1
+    baseline is test_3d_matrix's job."""
+    from deepspeed_tpu.models import GPT2Config
+    from deepspeed_tpu.models.gpt2_pipe import gpt2_pipeline_module
+
+    deepspeed_tpu.reset_mesh_context()
+    deepspeed_tpu.initialize_mesh(pipe=2, model=2, data=-1)
+    cfg = GPT2Config(vocab_size=64, n_positions=16, hidden_size=32,
+                     num_layers=4, num_heads=4, bf16=False,
+                     embd_dropout=0.0, attn_dropout=0.0, hidden_dropout=0.0)
+    conf = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 10 ** 9,
+        # explicit gated=true must be ACCEPTED on this mesh (the guard
+        # only fires for bodies without apply_manual_tp)
+        "pipeline": {"gated": True},
+    }
+    engine = PipelineEngine(
+        model=gpt2_pipeline_module(cfg, num_stages=2), config=conf,
+        example_input=jnp.zeros((4, 16), jnp.int32),
+        rng=jax.random.PRNGKey(0))
+    assert engine.schedule_gated is True
+    assert engine._tp_manual is True
+    ids = np.random.RandomState(0).randint(0, 64, size=(4, 16)).astype(
+        np.int32)
+    loss = engine.train_batch(iter([(ids, ids), (ids, ids)]))
+    assert np.isfinite(loss)
+    deepspeed_tpu.reset_mesh_context()
+
+
+def test_gated_tp_config_level_fallbacks():
+    """The gated-manual default must be a CONFIG-level decision, not a
+    type-level one (round-4 review): a sparse-attention body (layouts
+    built for global head counts) and a heads-indivisible body must both
+    fall back to the masked executor, and explicit gated=true must be a
+    clean ValueError — not an AttributeError or a shard_map crash."""
+    from deepspeed_tpu.models import GPT2Config
+    from deepspeed_tpu.models.gpt2_pipe import gpt2_pipeline_module
+    from deepspeed_tpu.ops.sparse_attention import FixedSparsityConfig
+
+    def build(cfg, gated=None):
+        conf = {
+            "train_batch_size": 8,
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "steps_per_print": 10 ** 9,
+        }
+        if gated is not None:
+            conf["pipeline"] = {"gated": gated}
+        return PipelineEngine(
+            model=gpt2_pipeline_module(cfg, num_stages=2), config=conf,
+            example_input=jnp.zeros((4, 32), jnp.int32),
+            rng=jax.random.PRNGKey(0))
+
+    sparse_cfg = GPT2Config(
+        vocab_size=64, n_positions=32, hidden_size=32, num_layers=4,
+        num_heads=4, bf16=False, embd_dropout=0.0, attn_dropout=0.0,
+        hidden_dropout=0.0,
+        sparse_attention=FixedSparsityConfig(num_heads=4, block=16))
+    odd_heads_cfg = GPT2Config(
+        vocab_size=64, n_positions=32, hidden_size=24, num_layers=4,
+        num_heads=3, bf16=False, embd_dropout=0.0, attn_dropout=0.0,
+        hidden_dropout=0.0)
+    for cfg in (sparse_cfg, odd_heads_cfg):
+        deepspeed_tpu.reset_mesh_context()
+        deepspeed_tpu.initialize_mesh(pipe=2, model=2, data=-1)
+        engine = build(cfg)
+        assert engine.schedule_gated is False, cfg
+        assert engine._tp_manual is False
+        deepspeed_tpu.reset_mesh_context()
+        deepspeed_tpu.initialize_mesh(pipe=2, model=2, data=-1)
+        with pytest.raises(ValueError, match="manual TP"):
+            build(cfg, gated=True)
+        deepspeed_tpu.reset_mesh_context()
+
+
+def test_gated_tp_partial_api_body_falls_back():
+    """A body implementing only part of the manual-TP API must hit the
+    guard (masked fallback / clean error), not an AttributeError inside
+    _make_1f1b_program."""
+    from deepspeed_tpu.runtime.pipe.module import (LayerSpec,
+                                                   PipelineModule)
+    from test_pipe import EmbedLayer, HeadLayer, Block, mse_loss
+
+    class HalfManualBlock(Block):
+        def apply_manual_tp(self, params, x, rng=None, tp_axis=None):
+            return self.apply(params, x, rng)
+
+        def tp_manual_views(self, params):
+            return params
+        # tp_manual_unview / tp_manual_view_specs MISSING on purpose
+
+    module = PipelineModule(
+        [LayerSpec(EmbedLayer)] + [LayerSpec(HalfManualBlock)
+                                   for _ in range(4)] +
+        [LayerSpec(HeadLayer)], num_stages=2, loss_fn=mse_loss)
+    deepspeed_tpu.reset_mesh_context()
+    deepspeed_tpu.initialize_mesh(pipe=2, model=2, data=-1)
+    cfg = dict(CONFIG)
+    cfg["mesh"] = {"pipe": 2, "model": 2, "data": -1}
+    cfg["pipeline"] = {"gated": True}
+    with pytest.raises(ValueError, match="gated"):
+        PipelineEngine(model=module, config=cfg, schedule="1f1b",
+                       example_input=jnp.zeros((4, 8), jnp.float32),
+                       rng=jax.random.PRNGKey(3))
     deepspeed_tpu.reset_mesh_context()
 
 

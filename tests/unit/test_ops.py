@@ -162,6 +162,79 @@ def test_tp_partition_specs_cover_all_params():
     assert set(specs) == set(params)
 
 
+@pytest.mark.parametrize("tp", [2, 4])
+def test_transformer_layer_manual_tp_matches_single(tp):
+    """The explicit-collective TP mode (tp_axis=, used by the gated 1F1B
+    executor) must match the single-device layer bit-for-tolerance:
+    forward, input grad, and EVERY param grad — the f/g operator pair
+    (_tp_fcast/_tp_psum) restores full cotangents per device, so no
+    post-hoc grad correction exists to hide an error."""
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    cfg = DeepSpeedTransformerConfig(
+        batch_size=2, hidden_size=32, heads=4, num_hidden_layers=1,
+        bf16=False, causal=True,
+        attn_dropout_ratio=0.0, hidden_dropout_ratio=0.0)
+    layer = DeepSpeedTransformerLayer(cfg)
+    params = layer.init_params(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 32))
+
+    def ref_loss(p, x):
+        return (layer(p, x, deterministic=True).astype(jnp.float32)
+                ** 2).sum()
+
+    ref_y = layer(params, x, deterministic=True)
+    ref_gp, ref_gx = jax.grad(ref_loss, argnums=(0, 1))(params, x)
+
+    mesh = Mesh(np.array(jax.devices()[:tp]).reshape(tp), ("model",))
+    specs = DeepSpeedTransformerLayer.tp_manual_view_specs()
+
+    def region(p_local, x):
+        def loss(p, x):
+            y = layer(p, x, deterministic=True, tp_axis="model")
+            return (y.astype(jnp.float32) ** 2).sum()
+
+        y = layer(p_local, x, deterministic=True, tp_axis="model")
+        gp, gx = jax.grad(loss, argnums=(0, 1))(p_local, x)
+        return y, gp, gx
+
+    f = jax.jit(jax.shard_map(
+        region, mesh=mesh, in_specs=(specs, P()),
+        out_specs=(P(), specs, P()),
+        axis_names=frozenset({"model"}), check_vma=False))
+    viewed = DeepSpeedTransformerLayer.tp_manual_views(params, cfg.heads)
+    y, gp, gx = f(viewed, x)
+    gp = DeepSpeedTransformerLayer.tp_manual_unview(gp)
+
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref_y), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(ref_gx),
+                               atol=1e-4)
+    for key in params:
+        np.testing.assert_allclose(
+            np.asarray(gp[key]), np.asarray(ref_gp[key]), atol=1e-4,
+            err_msg=f"param grad mismatch: {key}")
+
+
+def test_tp_manual_view_roundtrip():
+    """tp_manual_views/unview must be exact inverses on stacked
+    [S, k, ...] pipeline leaves (the engine applies the view before the
+    shard_map and the unview to the returned grads)."""
+    cfg = DeepSpeedTransformerConfig(batch_size=1, hidden_size=32, heads=4,
+                                     num_hidden_layers=1)
+    layer = DeepSpeedTransformerLayer(cfg)
+    single = layer.init_params(jax.random.PRNGKey(0))
+    stacked = jax.tree.map(
+        lambda l: jnp.stack([jnp.stack([l, l + 1.0])] * 3), single)
+    viewed = DeepSpeedTransformerLayer.tp_manual_views(stacked, cfg.heads)
+    assert viewed["attn_qkvw"].shape == (3, 2, 32, 4, 3, 8)
+    assert viewed["attn_qkvb"].shape == (3, 2, 4, 3, 8)
+    back = DeepSpeedTransformerLayer.tp_manual_unview(viewed)
+    for key in stacked:
+        np.testing.assert_array_equal(np.asarray(back[key]),
+                                      np.asarray(stacked[key]))
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_attention_bsh_layout_matches_reference(causal):
     """The transpose-free [B, S, heads, d] layout (BlockSpecs index the
