@@ -275,11 +275,13 @@ def test_flash_inkernel_dropout_tpu(pbits, monkeypatch):
     deterministic).  Parametrized over the PRNG width: 8-bit mode packs
     four mask bytes per random word (4x cheaper generation) and must pass
     the same statistics/FD bars as the 32-bit default."""
-    from deepspeed_tpu.ops.flash_attention import (flash_attention,
-                                                   DEFAULT_BLOCK_Q,
-                                                   DEFAULT_BLOCK_K)
-    monkeypatch.setattr("deepspeed_tpu.ops.flash_attention._dropout_bits",
-                        pbits)
+    import importlib
+    from deepspeed_tpu.ops.flash_attention import flash_attention
+    # monkeypatch by module OBJECT: the string path resolves through
+    # deepspeed_tpu.ops.__init__, where the re-exported flash_attention
+    # FUNCTION shadows the submodule attribute of the same name
+    fa_mod = importlib.import_module("deepspeed_tpu.ops.flash_attention")
+    monkeypatch.setattr(fa_mod, "_dropout_bits", pbits)
     ks = jax.random.split(jax.random.PRNGKey(3), 4)
     shape = (2, 4, 1024, 64)
     q, k, v = (jax.random.normal(kk, shape, jnp.float32) for kk in ks[:3])
