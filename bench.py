@@ -239,12 +239,12 @@ def _time_steps(step, warmup=3, iters=30, align=1, final_sync=None):
     return time.time() - t0, final_loss, iters
 
 
-def bench_gpt2():
+def bench_gpt2(batch=8, metric="gpt2_124m_train_tokens_per_sec_1chip"):
     import jax
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import GPT2Config, GPT2Model
 
-    batch, seq = 8, 1024
+    seq = 1024
     # DS_BENCH_ATTN_LAYOUT=bshd A/Bs the transpose-free kernel layout
     # without a code change (default stays the Mosaic-proven bhsd)
     cfg = GPT2Config(n_positions=seq, bf16=True,  # GPT-2 124M
@@ -281,7 +281,7 @@ def bench_gpt2():
     tflops = tokens_per_sec * cfg.flops_per_token() / 1e12
     peak = _peak_tflops()
     return {
-        "metric": "gpt2_124m_train_tokens_per_sec_1chip",
+        "metric": metric,
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(tflops / REFERENCE_TFLOPS, 3),
@@ -761,7 +761,20 @@ def bench_bert_s512():
                          remat=True)
 
 
+def bench_gpt2_b16():
+    """Flagship shape at batch 16 — the MFU-ceiling probe (the b=8 row
+    may be underfeeding the MXU; same model/optimizer/zero config)."""
+    return bench_gpt2(batch=16,
+                      metric="gpt2_124m_b16_train_tokens_per_sec_1chip")
+
+
+def bench_gpt2_b32():
+    return bench_gpt2(batch=32,
+                      metric="gpt2_124m_b32_train_tokens_per_sec_1chip")
+
+
 BENCHES = {"gpt2": bench_gpt2, "smoke": bench_smoke,
+           "gpt2_b16": bench_gpt2_b16, "gpt2_b32": bench_gpt2_b32,
            "bert_z2": bench_bert_z2, "bert_s512": bench_bert_s512,
            "decode": bench_decode, "moe": bench_moe,
            "gpt_moe": bench_gpt_moe,
@@ -770,6 +783,8 @@ BENCHES = {"gpt2": bench_gpt2, "smoke": bench_smoke,
            "infinity": bench_infinity}
 METRIC_NAMES = {  # error-path metric must match the success-path name
     "gpt2": ("gpt2_124m_train_tokens_per_sec_1chip", "tokens/s"),
+    "gpt2_b16": ("gpt2_124m_b16_train_tokens_per_sec_1chip", "tokens/s"),
+    "gpt2_b32": ("gpt2_124m_b32_train_tokens_per_sec_1chip", "tokens/s"),
     "smoke": ("smoke_tiny_gpt2_train_tokens_per_sec", "tokens/s"),
     "bert_z2": ("bert_large_z2_samples_per_sec_1chip", "samples/s"),
     "bert_s512": ("bert_large_z2_s512_samples_per_sec_1chip", "samples/s"),

@@ -1,0 +1,29 @@
+#!/bin/bash
+# Round-4 probe session #8: the remaining round-5 leads that only need
+# the chip, in value order:
+#   1. decode re-measure (r4's 9.5k vs r3's 10.5k — noise or regression?)
+#   2. flagship at batch 16 and 32 — the MFU-ceiling probe (is the b=8
+#      row underfeeding the MXU?)
+# Runs after the tail-watcher chain (probes4-6) is idle; marker-resumable.
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/session_r4j
+mkdir -p "$OUT"
+. benchmarks/slot_lib.sh
+
+for i in $(seq 1 600); do
+  pgrep -f "run_round4_probes[456].sh" > /dev/null 2>&1 || break
+  sleep 30
+done
+
+echo "== round-4 probe session #8 start $(stamp)" | tee -a "$OUT/session.log"
+waitslot 60 || exit 1
+
+row decode decode
+waitslot 10 || exit 1
+row gpt2_b16 gpt2_b16
+waitslot 10 || exit 1
+row gpt2_b32 gpt2_b32
+
+python benchmarks/render_results.py | tee -a "$OUT/session.log"
+echo "== round-4 probe session #8 done $(stamp)" | tee -a "$OUT/session.log"

@@ -1,0 +1,31 @@
+#!/bin/bash
+# Round-4 probe session #9: re-measure the dropout-bearing canonical
+# rows after the 8-bit in-kernel dropout PRNG became the default
+# (chip-validated stats+FD at both widths; flagship A/B 86.99 vs 84.67
+# TFLOPS).  The O(S^2) mask cost shrinks most at long sequence, so
+# longseq/sparse_longseq are re-measured alongside the flagship;
+# bert_s512 sits on the Pallas path too (post-crossover S>=512).
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/session_r4k
+mkdir -p "$OUT"
+. benchmarks/slot_lib.sh
+
+for i in $(seq 1 600); do
+  pgrep -f "run_round4_probes[4567].sh" > /dev/null 2>&1 || break
+  sleep 30
+done
+
+echo "== round-4 probe session #9 start $(stamp)" | tee -a "$OUT/session.log"
+waitslot 60 || exit 1
+
+row gpt2 gpt2
+waitslot 10 || exit 1
+row longseq longseq
+waitslot 10 || exit 1
+row sparse_longseq sparse_longseq
+waitslot 10 || exit 1
+WATCHDOG=1500 ROWTIMEOUT=1600 row bert_s512 bert_s512
+
+python benchmarks/render_results.py | tee -a "$OUT/session.log"
+echo "== round-4 probe session #9 done $(stamp)" | tee -a "$OUT/session.log"

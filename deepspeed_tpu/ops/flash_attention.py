@@ -101,13 +101,16 @@ def causal_keep_mask(qi_block, ki_block, block_q, block_k):
     return col <= row
 
 
-# Dropout PRNG width: 32 generates one random word per mask BIT (the
-# conservative, chip-validated default); 8 generates one word per FOUR
-# bits and compares bytes — 4x fewer PRNG words in each of the three
-# kernels that regenerate the mask (measured r4: the 32-bit mask costs
-# ~10% of the flagship step).  Flip with DS_DROPOUT_BITS=8 or
-# set_dropout_bits(8); the mode is read at TRACE time, so fwd and bwd of
-# one step always agree (both trace under one jit).
+# Dropout PRNG width: 8 (default since r4 session 2) generates one
+# random word per FOUR mask positions and compares bytes — 4x fewer
+# PRNG words in each of the three kernels that regenerate the mask,
+# bias-corrected by the exact quantized keep probability; 32 is one
+# word per mask BIT (the conservative fallback, and forced whenever
+# block_k % 4 != 0 — _effective_dropout_bits).  Chip-validated r4 at
+# both widths (statistics + FD); flagship A/B: 86.99 vs 84.67 TFLOPS
+# dropout-on (+2.7%).  Flip with DS_DROPOUT_BITS or set_dropout_bits;
+# the mode is read at TRACE time, so fwd and bwd of one step always
+# agree (both trace under one jit).
 def _parse_dropout_bits(raw: str) -> int:
     try:
         n = int(raw)
@@ -119,13 +122,15 @@ def _parse_dropout_bits(raw: str) -> int:
     return n
 
 
-_dropout_bits = _parse_dropout_bits(os.environ.get("DS_DROPOUT_BITS", "32"))
+_DEFAULT_DROPOUT_BITS = 8
+_dropout_bits = _parse_dropout_bits(
+    os.environ.get("DS_DROPOUT_BITS", str(_DEFAULT_DROPOUT_BITS)))
 
 
 def set_dropout_bits(n: int) -> None:
-    """Select the in-kernel dropout PRNG width (32 default, 8 = 4x
-    cheaper mask generation at 1/256 keep-probability granularity,
-    bias-corrected by the exact quantized scale).
+    """Select the in-kernel dropout PRNG width (8 default — 4x cheaper
+    mask generation at 1/256 keep-probability granularity, bias-corrected
+    by the exact quantized scale; 32 = one word per bit).
 
     Read at TRACE time: already-jit-compiled functions keep the width
     they were traced with (XLA caches the executable) — re-trace (fresh

@@ -260,3 +260,7 @@ def test_benches_and_metric_names_stay_in_sync():
         "bert_large_z2_s512_samples_per_sec_1chip"
     assert bench.METRIC_NAMES["bert_z2"][0] == \
         "bert_large_z2_samples_per_sec_1chip"
+    assert bench.METRIC_NAMES["gpt2_b16"][0] == \
+        "gpt2_124m_b16_train_tokens_per_sec_1chip"
+    assert bench.METRIC_NAMES["gpt2_b32"][0] == \
+        "gpt2_124m_b32_train_tokens_per_sec_1chip"

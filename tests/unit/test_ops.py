@@ -612,19 +612,23 @@ def test_dropout_keep_scale_quantization():
             assert (_keep_scale(rate, bits)
                     * _quantized_threshold(rate, bits) == float(2 ** bits))
     # non-multiple-of-4 k blocks force the 32-bit width for mask AND scale
-    set_dropout_bits(8)
+    from deepspeed_tpu.ops.flash_attention import _DEFAULT_DROPOUT_BITS
+    # the SHIPPED default (not the live global, which DS_DROPOUT_BITS or
+    # an earlier set_dropout_bits may have overridden)
+    assert _DEFAULT_DROPOUT_BITS == 8, \
+        "repo default is 8-bit since r4 (chip-validated A/B)"
+    prior = dropout_bits()
     try:
+        set_dropout_bits(8)
         assert _effective_dropout_bits(128) == 8
         assert _effective_dropout_bits(6) == 32
-    finally:
         set_dropout_bits(32)
-    assert _effective_dropout_bits(6) == 32
+        assert _effective_dropout_bits(6) == 32
+        assert _effective_dropout_bits(128) == 32
+        assert dropout_bits() == 32
+    finally:
+        set_dropout_bits(prior)
     import pytest as _pytest
     with _pytest.raises(ValueError):
         set_dropout_bits(16)
-    try:
-        set_dropout_bits(8)
-        assert dropout_bits() == 8
-    finally:
-        set_dropout_bits(32)
-    assert dropout_bits() == 32
+    assert dropout_bits() == prior
