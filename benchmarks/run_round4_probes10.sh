@@ -1,0 +1,25 @@
+#!/bin/bash
+# Round-4 probe session #11: MFU-scaling showcase rows — GPT-2 medium
+# (355M) and large (774M, remat) on one chip.  The 124M flagship is
+# overhead-bound; these rows show where the kernel/engine stack lands
+# when the matmuls are big enough to feed the MXU.
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/session_r4m
+mkdir -p "$OUT"
+. benchmarks/slot_lib.sh
+
+for i in $(seq 1 600); do
+  pgrep -f "run_round4_probes9.sh" > /dev/null 2>&1 || break
+  sleep 30
+done
+
+echo "== round-4 probe session #11 start $(stamp)" | tee -a "$OUT/session.log"
+waitslot 60 || exit 1
+
+row gpt2_medium gpt2_medium
+waitslot 10 || exit 1
+WATCHDOG=1500 ROWTIMEOUT=1600 row gpt2_large gpt2_large
+
+python benchmarks/render_results.py | tee -a "$OUT/session.log"
+echo "== round-4 probe session #11 done $(stamp)" | tee -a "$OUT/session.log"

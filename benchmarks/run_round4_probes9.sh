@@ -1,0 +1,41 @@
+#!/bin/bash
+# Round-4 probe session #10: (1) the full tests/tpu lane against the
+# current tree — first complete lane run with the 8-bit dropout default
+# and the round's kernel changes; (2) capability take-4 at ~4.2B params
+# (--layers 20): take-2 at this size was healthy (RSS ~71 GB with the
+# step-memory fixes) when the old tunnel died mid-step, and the 3.03B
+# take-3 completed at 951.8 s/step — the scaled step (~1350 s) fits a
+# 9500 s budget.  Raises the recorded peak trainable params/chip.
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/session_r4l
+mkdir -p "$OUT"
+. benchmarks/slot_lib.sh
+
+for i in $(seq 1 600); do
+  pgrep -f "run_round4_probes[45678].sh" > /dev/null 2>&1 || break
+  sleep 30
+done
+
+echo "== round-4 probe session #10 start $(stamp)" | tee -a "$OUT/session.log"
+waitslot 60 || exit 1
+
+if ! done_skip tpu_lane; then
+  echo "== tests/tpu full lane $(stamp)" | tee -a "$OUT/session.log"
+  if timeout -k 30 2700 python -m pytest tests/tpu -q -rs \
+      > "$OUT/tpu_lane.log" 2>&1; then
+    done_mark tpu_lane
+  fi
+  tail -3 "$OUT/tpu_lane.log" | tee -a "$OUT/session.log"
+  waitslot 10 || exit 1
+fi
+
+# the ~25 min capability step must not collide with the driver's
+# end-of-round bench window — wide margin only (round ends ~20:24Z)
+if [ "$(date -u +%Y%m%d%H%M)" -lt 202608011700 ]; then
+  DS_INFINITY_TRACE=1 json_stage capability7 9500 \
+    python benchmarks/infinity_capability.py --layers 20
+fi
+
+python benchmarks/render_results.py | tee -a "$OUT/session.log"
+echo "== round-4 probe session #10 done $(stamp)" | tee -a "$OUT/session.log"

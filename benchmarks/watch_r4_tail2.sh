@@ -1,0 +1,44 @@
+#!/bin/bash
+# Second round-4 tail watcher: the upstream TPU transport wedged at
+# ~10:05Z mid-stream during the 4.2B capability backward (relay alive,
+# accepts connections, upstream never answers — the round-3 pattern;
+# only the driver side can recover it).  Probe every 4 min; when the
+# slot answers, run the remaining showcase rows (probes10 is
+# marker-resumable and exits fast once its rows are done).
+set -u
+cd "$(dirname "$0")/.."
+LOG=benchmarks/session_r4_tail2.log
+
+probe_ok() {
+  timeout -k 10 75 python -c "import jax; jax.devices()[0]" \
+    > /dev/null 2>&1
+}
+
+chain_running() {
+  pgrep -f "run_round4_probes10.sh" > /dev/null 2>&1
+}
+
+all_done() {
+  [ -e benchmarks/session_r4m/done/row_gpt2_medium ] &&
+  [ -e benchmarks/session_r4m/done/row_gpt2_large ]
+}
+
+echo "== tail watcher 2 start $(date -u +%FT%TZ)" >> "$LOG"
+while true; do
+  if all_done; then
+    echo "== all stages done $(date -u +%FT%TZ)" >> "$LOG"
+    break
+  fi
+  # stop launching new chip work close to the driver's end-of-round
+  # bench window (round ends ~20:24Z)
+  if [ "$(date -u +%Y%m%d%H%M)" -ge 202608011830 ]; then
+    echo "== too close to round end; stopping $(date -u +%FT%TZ)" >> "$LOG"
+    break
+  fi
+  if ! chain_running && probe_ok; then
+    echo "== slot ok, launching probes10 $(date -u +%FT%TZ)" >> "$LOG"
+    bash benchmarks/run_round4_probes10.sh \
+      >> benchmarks/session_r4m_nohup.log 2>&1
+  fi
+  sleep 240
+done

@@ -264,3 +264,7 @@ def test_benches_and_metric_names_stay_in_sync():
         "gpt2_124m_b16_train_tokens_per_sec_1chip"
     assert bench.METRIC_NAMES["gpt2_b32"][0] == \
         "gpt2_124m_b32_train_tokens_per_sec_1chip"
+    assert bench.METRIC_NAMES["gpt2_medium"][0] == \
+        "gpt2_355m_train_tokens_per_sec_1chip"
+    assert bench.METRIC_NAMES["gpt2_large"][0] == \
+        "gpt2_774m_train_tokens_per_sec_1chip"

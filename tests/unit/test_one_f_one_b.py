@@ -228,6 +228,24 @@ def test_gated_tp_manual_default():
     assert np.isfinite(loss)
     deepspeed_tpu.reset_mesh_context()
 
+    # dropout ON must also trace and run: the manual mode folds
+    # lax.axis_index(model) into the attention-dropout key (head-shard
+    # decorrelation) — a trace-time failure there would only surface in
+    # real training configs
+    deepspeed_tpu.initialize_mesh(pipe=2, model=2, data=-1)
+    cfg_do = GPT2Config(vocab_size=64, n_positions=16, hidden_size=32,
+                        num_layers=4, num_heads=4, bf16=False,
+                        embd_dropout=0.1, attn_dropout=0.1,
+                        hidden_dropout=0.1)
+    engine2 = PipelineEngine(
+        model=gpt2_pipeline_module(cfg_do, num_stages=2), config=conf,
+        example_input=jnp.zeros((4, 16), jnp.int32),
+        rng=jax.random.PRNGKey(0))
+    assert engine2.schedule_gated is True
+    loss2 = engine2.train_batch(iter([(ids, ids), (ids, ids)]))
+    assert np.isfinite(loss2)
+    deepspeed_tpu.reset_mesh_context()
+
 
 def test_gated_tp_config_level_fallbacks():
     """The gated-manual default must be a CONFIG-level decision, not a
