@@ -16,6 +16,7 @@ import optax
 
 from ..ops.activations import dropout
 from ..ops.normalize import fused_layer_norm
+from ..parallel.mesh import MODEL_AXIS
 from ..runtime.pipe.module import (LayerSpec, PipeLayer, PipelineModule,
                                    TiedLayerSpec)
 from .gpt2 import GPT2Config
@@ -160,6 +161,70 @@ def gpt2_pipeline_module(cfg: GPT2Config,
     else:
         layers = ([LayerSpec(GPT2EmbedPipe, cfg)] + blocks +
                   [LayerSpec(GPT2HeadPipe, cfg)])
-    return PipelineModule(
+    module = PipelineModule(
         layers, num_stages=num_stages, loss_fn=gpt2_next_token_loss,
         activation_checkpoint_interval=activation_checkpoint_interval)
+    _attach_vocab_parallel_aux(module, cfg)
+    return module
+
+
+def _attach_vocab_parallel_aux(module, cfg: GPT2Config):
+    """Manual-TP pre/post chains for the gated 1F1B executor (pipe×model
+    meshes): vocab-parallel embedding lookup and fused vocab-parallel
+    linear+CE — the Megatron VocabParallelEmbedding/parallel-CE role,
+    which the replicated aux chains otherwise duplicate on every model
+    peer (the head matmul is ~2 layers' worth of FLOPs at GPT-2 scale).
+    Consumed by PipelineEngine when the executor gates with a model
+    axis; the GSPMD (non-gated) engines shard the embedding
+    declaratively instead (models/gpt2.py param_partition_specs).
+
+    Numerics note: the vocab-parallel CE accumulates logits in fp32
+    (preferred_element_type) where the replicated head rounds them
+    through bf16 first — equal under fp32 configs (the trajectory
+    tests), one rounding better under bf16."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.vocab_parallel import (vocab_parallel_embedding,
+                                      vocab_parallel_linear_cross_entropy)
+
+    tied_case = cfg.tie_word_embeddings
+
+    def supports(tp_size: int) -> bool:
+        return cfg.vocab_size % tp_size == 0
+
+    def pre_apply(pre, tied, ids, rng, tp_axis):
+        p = tied["embed"] if tied_case else pre[0]
+        h = vocab_parallel_embedding(p["wte"].astype(cfg.dtype), ids,
+                                     tp_axis)
+        h = h + p["wpe"].astype(cfg.dtype)[jnp.arange(ids.shape[1])]
+        return dropout(h, cfg.embd_dropout, rng, deterministic=rng is None)
+
+    def post_loss(post, tied, h, y_mb, rng, tp_axis):
+        lnp = post[0]
+        if tied_case:
+            w, b = lnp["w"], lnp["b"]
+            head_local = tied["embed"]["wte"].T      # [H, V_local]
+        else:
+            w, b = lnp["ln_f"]["w"], lnp["ln_f"]["b"]
+            head_local = lnp["lm_head"]
+        h = fused_layer_norm(h, w, b, cfg.layer_norm_eps)
+        hid = h.shape[-1]
+        h2 = h[:, :-1].reshape(-1, hid)
+        labels = y_mb[:, 1:].astype(jnp.int32).reshape(-1)
+        return vocab_parallel_linear_cross_entropy(
+            h2, head_local.astype(h.dtype), labels, tp_axis)
+
+    def aux_specs(pre, post, tied):
+        rep = lambda t: jax.tree.map(lambda _: P(), t)  # noqa: E731
+        pre_s, post_s, tied_s = rep(pre), rep(post), rep(tied)
+        if tied_case:
+            tied_s["embed"]["wte"] = P(MODEL_AXIS, None)
+        else:
+            pre_s[0]["wte"] = P(MODEL_AXIS, None)
+            post_s[0]["lm_head"] = P(None, MODEL_AXIS)
+        return pre_s, post_s, tied_s
+
+    module.tp_manual_aux_supports = supports
+    module.tp_manual_pre_apply = pre_apply
+    module.tp_manual_post_loss = post_loss
+    module.tp_manual_aux_specs = aux_specs

@@ -1,0 +1,122 @@
+"""Vocab-parallel embedding + fused linear cross-entropy for MANUAL TP.
+
+The role of Megatron's VocabParallelEmbedding + vocab-parallel CE (the
+reference delegates both to the external Megatron mpu — SURVEY.md §2.3
+"TP: integration, not implementation").  The GSPMD engines already
+vocab-shard the embedding declaratively (models/gpt2.py
+param_partition_specs); THESE ops are for shard_map-manual regions —
+the gated 1F1B executor — where GSPMD-placed collectives would land in
+divergent control flow (ops/transformer.py tp_axis mode has the full
+story, ARCHITECTURE.md invariant 10).
+
+Collective/AD discipline under check_vma=False:
+  - the embedding merge is a "g" operator (psum forward, identity
+    backward): the arriving output cotangent is already full, and each
+    peer's masked scatter-add against it is its exact local wte grad;
+  - the cross-entropy is ONE custom_vjp whose backward is local given
+    the global softmax statistics (max, sum-exp) — the classic
+    vocab-parallel softmax identity dlogits = p - onehot — with the
+    input-activation cotangent psum'd INSIDE the backward (the "f"
+    position at the head boundary), so LN/residual grads upstream are
+    exact per-device with no post-hoc correction.
+"""
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _vp_psum(x, axis):
+    """psum forward, identity backward (Megatron "g")."""
+    return lax.psum(x, axis)
+
+
+def _vp_psum_fwd(x, axis):
+    return lax.psum(x, axis), None
+
+
+def _vp_psum_bwd(axis, _, ct):
+    return (ct,)
+
+
+_vp_psum.defvjp(_vp_psum_fwd, _vp_psum_bwd)
+
+
+def vocab_parallel_embedding(wte_local, ids, axis):
+    """Lookup into a vocab-sharded table inside a manual region.
+
+    wte_local: [V_local, H] — this peer's contiguous vocab slice
+    (slice p covers rows [p*V_local, (p+1)*V_local)).
+    ids: int [...] global token ids.  Returns [..., H] replicated.
+    """
+    v_local = wte_local.shape[0]
+    start = lax.axis_index(axis) * v_local
+    local = ids - start
+    mask = (local >= 0) & (local < v_local)
+    safe = jnp.where(mask, local, 0)
+    part = wte_local[safe] * mask[..., None].astype(wte_local.dtype)
+    return _vp_psum(part, axis)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def vocab_parallel_linear_cross_entropy(h, w_local, labels, axis):
+    """mean softmax-CE of logits = h @ w_local over a vocab-sharded head.
+
+    h: [N, H] replicated; w_local: [H, V_local] this peer's vocab slice;
+    labels: int [N] global ids.  Returns the scalar fp32 mean loss
+    (identical on every peer).  Numerically matches
+    optax.softmax_cross_entropy_with_integer_labels on the full fp32
+    logits: loss_i = log(sum_v exp(l_iv)) - l_i,label, computed with the
+    global row max subtracted.
+    """
+    loss, _ = _vp_ce_stats(h, w_local, labels, axis)
+    return loss
+
+
+def _vp_ce_stats(h, w_local, labels, axis):
+    v_local = w_local.shape[1]
+    start = lax.axis_index(axis) * v_local
+    logits = jnp.matmul(h, w_local,
+                        preferred_element_type=jnp.float32)  # [N, Vl]
+    m = lax.pmax(jnp.max(logits, axis=-1), axis)             # [N] global max
+    se = lax.psum(jnp.sum(jnp.exp(logits - m[:, None]), axis=-1), axis)
+    local = labels - start
+    mask = (local >= 0) & (local < v_local)
+    safe = jnp.where(mask, local, 0)
+    picked = jnp.take_along_axis(logits, safe[:, None], axis=1)[:, 0]
+    ll = lax.psum(jnp.where(mask, picked, 0.0), axis)        # label logit
+    loss = jnp.mean(jnp.log(se) + m - ll)
+    return loss, (m, se)
+
+
+def _vp_ce_fwd(h, w_local, labels, axis):
+    loss, (m, se) = _vp_ce_stats(h, w_local, labels, axis)
+    return loss, (h, w_local, labels, m, se)
+
+
+def _vp_ce_bwd(axis, res, g):
+    h, w_local, labels, m, se = res
+    v_local = w_local.shape[1]
+    start = lax.axis_index(axis) * v_local
+    n = h.shape[0]
+    # recompute the local logits (cheaper than saving [N, Vl] fp32)
+    logits = jnp.matmul(h, w_local, preferred_element_type=jnp.float32)
+    p = jnp.exp(logits - m[:, None]) / se[:, None]
+    local = labels - start
+    mask = (local >= 0) & (local < v_local)
+    onehot = (jnp.arange(v_local)[None, :] == local[:, None]) & mask[:, None]
+    dlogits = (p - onehot.astype(p.dtype)) * (g / n)
+    # "f" position: each peer's dh is only its vocab slice's partial
+    dh = lax.psum(jnp.matmul(dlogits, w_local.T.astype(dlogits.dtype)),
+                  axis).astype(h.dtype)
+    dw = jnp.matmul(h.T.astype(dlogits.dtype), dlogits).astype(w_local.dtype)
+    dlabels = np.zeros(labels.shape, dtype=jax.dtypes.float0)
+    return dh, dw, dlabels
+
+
+vocab_parallel_linear_cross_entropy.defvjp(_vp_ce_fwd, _vp_ce_bwd)

@@ -181,6 +181,7 @@ class PipelineEngine(DeepSpeedEngine):
         self.schedule_gated = (bool(gated_cfg) if gated_cfg is not None
                                else not gating_blocked)
         self._tp_manual = (self.schedule_gated and tp_world)
+        self._tp_aux_manual = False  # set by the gated-TP program build
         if gating_blocked and gated_cfg is None:
             log_dist(
                 "PipelineEngine: masked 1F1B executor (gated executor "
@@ -191,7 +192,8 @@ class PipelineEngine(DeepSpeedEngine):
         if schedule == "1f1b":
             # hand-scheduled fwd/bwd interleave: the base engine compiles
             # this program directly instead of value_and_grad
-            self._custom_grad_program = self._make_1f1b_program(ctx)
+            self._custom_grad_program = self._make_1f1b_program(
+                ctx, pipeline_params)
         apply_fn = self._make_pipelined_apply(ctx, deterministic=False)
         self._eval_apply = self._make_pipelined_apply(ctx, deterministic=True)
         specs = self._make_partition_specs(pipeline_params)
@@ -245,7 +247,7 @@ class PipelineEngine(DeepSpeedEngine):
         return {"pre": None, "blocks": blocks, "post": None, "tied": None}
 
     # ------------------------------------------------------------------ #
-    def _make_1f1b_program(self, ctx):
+    def _make_1f1b_program(self, ctx, pipeline_params):
         """Build the 1F1B interleaved fwd/bwd program (one_f_one_b.py) —
         the compiled execution of schedule.py's TrainSchedule."""
         from .one_f_one_b import make_1f1b_grad_fn, make_gated_1f1b_grad_fn
@@ -298,11 +300,40 @@ class PipelineEngine(DeepSpeedEngine):
         if self.schedule_gated and tp_manual:
             from ...parallel.mesh import MODEL_AXIS
             body = body_layer
+            # vocab-parallel aux chains (module opt-in): the embedding
+            # lookup and the head+CE run vocab-sharded inside the manual
+            # region instead of replicated per model peer — the Megatron
+            # VocabParallelEmbedding / parallel-CE role
+            # (models/gpt2_pipe.py _attach_vocab_parallel_aux)
+            aux_sup = getattr(module, "tp_manual_aux_supports", None)
+            aux_manual = (aux_sup is not None and
+                          aux_sup(ctx.model_parallel_world_size))
+            self._tp_aux_manual = aux_manual
+            pre_region = post_region = aux_spec_trees = None
+            if aux_manual:
+                mp_pre = module.tp_manual_pre_apply
+                mp_post = module.tp_manual_post_loss
+
+                def pre_region(pre, tied, x_mb, mb, rng_pre):
+                    return mp_pre(pre, tied, x_mb,
+                                  jax.random.fold_in(rng_pre, mb),
+                                  MODEL_AXIS)
+
+                def post_region(post, tied, h, y_mb, mb, rng_post):
+                    return mp_post(post, tied, h, y_mb,
+                                   jax.random.fold_in(rng_post, mb),
+                                   MODEL_AXIS)
+
+                aux_spec_trees = module.tp_manual_aux_specs(
+                    pipeline_params["pre"], pipeline_params["post"],
+                    pipeline_params["tied"])
             inner = make_gated_1f1b_grad_fn(
                 mesh=mesh, stage_apply=stage_apply, pre_apply=pre_apply,
                 post_loss=post_loss, micro_batches=M, num_stages=S,
                 model_axis=MODEL_AXIS,
-                block_specs=body.tp_manual_view_specs())
+                block_specs=body.tp_manual_view_specs(),
+                pre_apply_region=pre_region, post_loss_region=post_region,
+                aux_specs=aux_spec_trees)
 
             def grad_fn(params, loss_scale, rng, xm, ym):
                 # storage keeps the blocked [q|k|v] qkv layout (checkpoint

@@ -222,6 +222,9 @@ def test_gated_tp_manual_default():
         rng=jax.random.PRNGKey(0))
     assert engine.schedule_gated is True
     assert engine._tp_manual is True
+    # vocab-parallel aux chains active (vocab 64 divides tp 2): the
+    # embedding lookup and head+CE run vocab-sharded, not replicated
+    assert engine._tp_aux_manual is True
     ids = np.random.RandomState(0).randint(0, 64, size=(4, 16)).astype(
         np.int32)
     loss = engine.train_batch(iter([(ids, ids), (ids, ids)]))
