@@ -76,7 +76,6 @@ class GPT2BlockPipe(PipeLayer):
                 and self.cfg.num_heads % tp_size == 0)
 
     def apply_manual_tp(self, params, x, rng=None, tp_axis=None):
-        from ..parallel.mesh import MODEL_AXIS
         return self.layer(params, x, rng=rng, deterministic=rng is None,
                           tp_axis=tp_axis or MODEL_AXIS)
 

@@ -1,0 +1,58 @@
+"""Megatron's f/g collective operators for MANUAL-TP regions.
+
+Used by every explicit-collective tensor-parallel path (the transformer
+layer's tp_axis mode, the vocab-parallel embedding/CE) inside
+shard_map-manual regions compiled with check_vma=False — where shard_map
+cannot track the replicated/varying boundary, so plain lax.psum
+transposes to psum and multiplies upstream cotangents by tp_size.  The
+custom VJPs encode the boundary instead (ARCHITECTURE.md invariant 10):
+
+  tp_psum  ("g"): all-reduce forward, IDENTITY backward — placed where
+      row-parallel partial outputs merge; the output cotangent arriving
+      from replicated downstream compute is already full.
+  tp_fcast ("f"): IDENTITY forward, all-reduce backward — placed at each
+      replicated->column-parallel input boundary; the per-peer cotangent
+      there is only that peer's partial (it flowed through the peer's own
+      weight shards) and the backward psum restores the full cotangent,
+      so every upstream grad is exact per-device with no post-hoc
+      correction.
+"""
+
+from functools import partial
+
+import jax
+from jax import lax
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_psum(x, axis):
+    """All-reduce forward, identity backward (Megatron "g")."""
+    return lax.psum(x, axis)
+
+
+def _tp_psum_fwd(x, axis):
+    return lax.psum(x, axis), None
+
+
+def _tp_psum_bwd(axis, _, ct):
+    return (ct,)
+
+
+tp_psum.defvjp(_tp_psum_fwd, _tp_psum_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_fcast(x, axis):
+    """Identity forward, all-reduce backward (Megatron "f")."""
+    return x
+
+
+def _tp_fcast_fwd(x, axis):
+    return x, None
+
+
+def _tp_fcast_bwd(axis, _, ct):
+    return (lax.psum(ct, axis),)
+
+
+tp_fcast.defvjp(_tp_fcast_fwd, _tp_fcast_bwd)

@@ -11,13 +11,12 @@ fusion.  Tensor parallelism is declared, not coded: `param_partition_specs`
 returns the Megatron-style column/row split over the "model" mesh axis and
 GSPMD inserts the per-layer collectives.  (Exception: inside shard_map-manual
 regions — the gated 1F1B executor — `__call__(tp_axis=...)` runs the same
-split with EXPLICIT psums so the collectives stay out of divergent control
-flow; see tp_grad_psum_specs.)
+split with EXPLICIT collectives, the f/g operator pair of
+ops/tp_collectives.py, so they stay out of divergent control flow.)
 """
 
 import math
 from dataclasses import dataclass, field
-from functools import partial
 from typing import Optional
 
 import jax
@@ -30,6 +29,7 @@ from .activations import bias_gelu, bias_dropout_residual, dropout
 from .flash_attention import flash_attention, flash_attention_bsh
 from .normalize import fused_layer_norm
 from .quant import matmul_maybe_int8
+from .tp_collectives import tp_fcast, tp_psum
 
 
 @dataclass
@@ -111,51 +111,6 @@ class DeepSpeedTransformerConfig:
         if self.fp16:
             return jnp.float16
         return jnp.float32
-
-
-@partial(jax.custom_vjp, nondiff_argnums=(1,))
-def _tp_psum(x, axis):
-    """Megatron's "g" operator for MANUAL TP under check_vma=False:
-    all-reduce forward, IDENTITY backward.  shard_map without vma
-    tracking transposes lax.psum to psum, which would multiply every
-    upstream cotangent by tp_size (the output cotangent is replicated);
-    the counterpart "f" (identity forward, psum backward) is the
-    executor's explicit psum of the layer-input cotangent."""
-    return lax.psum(x, axis)
-
-
-def _tp_psum_fwd(x, axis):
-    return lax.psum(x, axis), None
-
-
-def _tp_psum_bwd(axis, _, ct):
-    return (ct,)
-
-
-_tp_psum.defvjp(_tp_psum_fwd, _tp_psum_bwd)
-
-
-@partial(jax.custom_vjp, nondiff_argnums=(1,))
-def _tp_fcast(x, axis):
-    """Megatron's "f" operator: IDENTITY forward, all-reduce backward.
-    Placed at each sublayer input (the replicated->column-parallel
-    boundary): the per-peer cotangent arriving there is only that peer's
-    partial (it flowed through the peer's own weight shards), and the
-    backward psum restores the full cotangent — so every upstream grad
-    (LN scales, the residual stream, the layer input) is exact
-    per-device with no post-hoc correction."""
-    return x
-
-
-def _tp_fcast_fwd(x, axis):
-    return x, None
-
-
-def _tp_fcast_bwd(axis, _, ct):
-    return (lax.psum(ct, axis),)
-
-
-_tp_fcast.defvjp(_tp_fcast_fwd, _tp_fcast_bwd)
 
 
 class DeepSpeedTransformerLayer:
@@ -326,7 +281,7 @@ class DeepSpeedTransformerLayer:
         else:
             attn_in = x
         if tp_axis is not None:
-            attn_in = _tp_fcast(attn_in, tp_axis)
+            attn_in = tp_fcast(attn_in, tp_axis)
 
         if tp_axis is None:
             qkv = matmul_maybe_int8(attn_in, params["attn_qkvw"]) + \
@@ -429,7 +384,7 @@ class DeepSpeedTransformerLayer:
         if tp_axis is not None:
             # row-parallel output projection: merge the per-peer partials
             # BEFORE bias/dropout/residual (replicated from here on)
-            attn_out = _tp_psum(attn_out, tp_axis)
+            attn_out = tp_psum(attn_out, tp_axis)
         attn_out = bias_dropout_residual(
             attn_out, params["attn_ob"].astype(attn_out.dtype), residual,
             cfg.hidden_dropout_ratio, r_hid1, deterministic)
@@ -451,14 +406,14 @@ class DeepSpeedTransformerLayer:
             mlp_in = attn_out
             mlp_residual = attn_out
         if tp_axis is not None:
-            mlp_in = _tp_fcast(mlp_in, tp_axis)
+            mlp_in = tp_fcast(mlp_in, tp_axis)
 
         inter = bias_gelu(matmul_maybe_int8(mlp_in, params["inter_w"]),
                           params["inter_b"].astype(mlp_in.dtype),
                           approximate=cfg.gelu_approximate)
         out = matmul_maybe_int8(inter, params["output_w"])
         if tp_axis is not None:
-            out = _tp_psum(out, tp_axis)
+            out = tp_psum(out, tp_axis)
         out = bias_dropout_residual(
             out, params["output_b"].astype(out.dtype), mlp_residual,
             cfg.hidden_dropout_ratio, r_hid2, deterministic)

@@ -29,22 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-
-@partial(jax.custom_vjp, nondiff_argnums=(1,))
-def _vp_psum(x, axis):
-    """psum forward, identity backward (Megatron "g")."""
-    return lax.psum(x, axis)
-
-
-def _vp_psum_fwd(x, axis):
-    return lax.psum(x, axis), None
-
-
-def _vp_psum_bwd(axis, _, ct):
-    return (ct,)
-
-
-_vp_psum.defvjp(_vp_psum_fwd, _vp_psum_bwd)
+from .tp_collectives import tp_psum
 
 
 def vocab_parallel_embedding(wte_local, ids, axis):
@@ -60,7 +45,7 @@ def vocab_parallel_embedding(wte_local, ids, axis):
     mask = (local >= 0) & (local < v_local)
     safe = jnp.where(mask, local, 0)
     part = wte_local[safe] * mask[..., None].astype(wte_local.dtype)
-    return _vp_psum(part, axis)
+    return tp_psum(part, axis)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3,))

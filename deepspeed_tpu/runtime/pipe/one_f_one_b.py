@@ -298,7 +298,7 @@ def make_gated_1f1b_grad_fn(*, mesh, stage_apply: Callable,
     the 8-device CPU mesh).  The fix (round 4): pass `model_axis` to
     make that axis MANUAL too — the stage body must then run the
     Megatron split with EXPLICIT collectives (the layer's tp_axis= mode,
-    ops/transformer.py _tp_psum/_tp_fcast).  Every model-group peer
+    ops/tp_collectives.py tp_psum/tp_fcast).  Every model-group peer
     shares its pipe row and therefore its cond predicate, so the
     in-branch psums always rendezvous within one branch.  `block_specs`
     (per-leaf PartitionSpecs in the tp_manual_views layout) describes

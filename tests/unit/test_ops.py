@@ -167,7 +167,7 @@ def test_transformer_layer_manual_tp_matches_single(tp):
     """The explicit-collective TP mode (tp_axis=, used by the gated 1F1B
     executor) must match the single-device layer bit-for-tolerance:
     forward, input grad, and EVERY param grad — the f/g operator pair
-    (_tp_fcast/_tp_psum) restores full cotangents per device, so no
+    (tp_fcast/tp_psum, ops/tp_collectives.py) restores full cotangents per device, so no
     post-hoc grad correction exists to hide an error."""
     from jax import lax
     from jax.sharding import Mesh, PartitionSpec as P
