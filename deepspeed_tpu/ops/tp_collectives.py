@@ -21,17 +21,33 @@ custom VJPs encode the boundary instead (ARCHITECTURE.md invariant 10):
 from functools import partial
 
 import jax
+import jax.numpy as jnp
 from jax import lax
+
+
+def _psum_compilable(x, axis):
+    """lax.psum that compiles on every backend.
+
+    XLA CPU's AllReducePromotion pass CRASHES (hlo_instruction.cc
+    "Invalid binary instruction opcode copy") cloning the sub-f32
+    all-reduces these manual regions emit, so promote them explicitly
+    there — the same discipline the ZeRO-3 streamed region adopted in
+    round 3 (ARCHITECTURE.md invariant 4).  TPU keeps the native width
+    on the wire."""
+    if (x.dtype in (jnp.bfloat16, jnp.float16)
+            and jax.default_backend() == "cpu"):
+        return lax.psum(x.astype(jnp.float32), axis).astype(x.dtype)
+    return lax.psum(x, axis)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(1,))
 def tp_psum(x, axis):
     """All-reduce forward, identity backward (Megatron "g")."""
-    return lax.psum(x, axis)
+    return _psum_compilable(x, axis)
 
 
 def _tp_psum_fwd(x, axis):
-    return lax.psum(x, axis), None
+    return _psum_compilable(x, axis), None
 
 
 def _tp_psum_bwd(axis, _, ct):
@@ -52,7 +68,7 @@ def _tp_fcast_fwd(x, axis):
 
 
 def _tp_fcast_bwd(axis, _, ct):
-    return (lax.psum(ct, axis),)
+    return (_psum_compilable(ct, axis),)
 
 
 tp_fcast.defvjp(_tp_fcast_fwd, _tp_fcast_bwd)

@@ -117,6 +117,41 @@ def test_indivisible_vocab_declines_aux_manual():
     deepspeed_tpu.reset_mesh_context()
 
 
+def test_gated_tp_bf16_smoke():
+    """bf16 gated-TP with vocab-parallel aux: the manual branches cast
+    params/activations at several boundaries (qkv einsum, psum merges,
+    CE's fp32 logits accumulation) — all trajectory tests run fp32, so
+    this is the only exercise of those casts.  One step, finite loss."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT2Config
+    from deepspeed_tpu.models.gpt2_pipe import gpt2_pipeline_module
+    from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+
+    deepspeed_tpu.reset_mesh_context()
+    deepspeed_tpu.initialize_mesh(pipe=2, model=2, data=-1)
+    cfg = GPT2Config(vocab_size=64, n_positions=16, hidden_size=32,
+                     num_layers=4, num_heads=4, bf16=True,
+                     embd_dropout=0.1, attn_dropout=0.1,
+                     hidden_dropout=0.1)
+    engine = PipelineEngine(
+        model=gpt2_pipeline_module(cfg, num_stages=2),
+        config={"train_batch_size": 8,
+                "train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 2,
+                "bf16": {"enabled": True},
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "steps_per_print": 10 ** 9},
+        example_input=jnp.zeros((4, 16), jnp.int32),
+        rng=jax.random.PRNGKey(0))
+    assert engine.schedule_gated and engine._tp_manual
+    assert engine._tp_aux_manual
+    ids = np.random.RandomState(0).randint(0, 64, size=(4, 16)).astype(
+        np.int32)
+    loss = engine.train_batch(iter([(ids, ids), (ids, ids)]))
+    assert np.isfinite(loss)
+    deepspeed_tpu.reset_mesh_context()
+
+
 def test_untied_head_vocab_parallel_trajectory():
     """Untied-head GPT-2 (independent lm_head, vocab-sharded over the
     model axis through pre_s/post_s specs) under pipe=2 x tp=2 matches
