@@ -26,16 +26,19 @@ from jax import lax
 
 
 def _psum_compilable(x, axis):
-    """lax.psum that compiles on every backend.
+    """lax.psum with sub-f32 inputs promoted to f32, unconditionally.
 
-    XLA CPU's AllReducePromotion pass CRASHES (hlo_instruction.cc
-    "Invalid binary instruction opcode copy") cloning the sub-f32
-    all-reduces these manual regions emit, so promote them explicitly
-    there — the same discipline the ZeRO-3 streamed region adopted in
-    round 3 (ARCHITECTURE.md invariant 4).  TPU keeps the native width
-    on the wire."""
-    if (x.dtype in (jnp.bfloat16, jnp.float16)
-            and jax.default_backend() == "cpu"):
+    Two reasons, same as the ZeRO-3 streamed region's round-3 rule
+    (ARCHITECTURE.md invariant 4: manual regions run every reduction
+    collective they emit in fp32): XLA CPU's AllReducePromotion pass
+    CRASHES (hlo_instruction.cc "Invalid binary instruction opcode
+    copy") cloning these manual-region bf16 all-reduces, and a
+    backend-conditional gate cannot be trusted here —
+    jax.default_backend() misreports "tpu" in the CPU-sim dryrun
+    scenario dispatch.py documents.  Cost on real TPU: 2x wire bytes on
+    these boundaries; a measured native-width mode can revisit this
+    when multi-chip hardware is available."""
+    if x.dtype in (jnp.bfloat16, jnp.float16):
         return lax.psum(x.astype(jnp.float32), axis).astype(x.dtype)
     return lax.psum(x, axis)
 
