@@ -76,6 +76,11 @@ def _baseline():
     (2, 2, 0),   # pipe × tp
     (2, 2, 1),   # pipe × tp × zero — 3D
     (1, 2, 2),   # tp × zero-2 (pipeline module, no pipe axis)
+    # pipe × zero-2/3: the reference RESTRICTS pipeline parallelism to
+    # ZeRO-1 (grad/param partitioning fights its hook-based schedule);
+    # sharding-as-policy composes them for free — trajectory-exact
+    (4, 1, 2),   # pipe × zero-2 — beyond the reference
+    (2, 2, 3),   # pipe × tp × zero-3 — beyond the reference
 ])
 def test_composition_matches_baseline(pipe, tp, zero):
     base_losses, base_params = _baseline()
