@@ -1,0 +1,88 @@
+"""Host C++ Adam micro-benchmark — the reference's tests/perf/adam_test1.py
+analog (1B-param CPU-Adam step timing; reference: csrc/adam/cpu_adam.cpp's
+role in ZeRO-Offload).  Times `adam_step_buffers` (csrc/adam/host_adam.cpp
+via ctypes) against the NumPy fallback on flat fp32 buffers, plus the
+fused bf16-emit variant the offload/infinity engines use.
+
+Pure host CPU — runs without the chip.  Prints one JSON line:
+params/s for the native kernel at the largest size.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepspeed_tpu.ops.adam.cpu_adam import (adam_step_buffers,
+                                             get_native_lib)
+
+HYPER = dict(lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
+             weight_decay=0.01, adamw_mode=True)
+
+
+def time_step(n, lib, bf16=False, iters=5):
+    rng = np.random.RandomState(0)
+    p = rng.standard_normal(n).astype(np.float32)
+    m = np.zeros(n, np.float32)
+    v = np.zeros(n, np.float32)
+    g = rng.standard_normal(n).astype(np.float32) * 1e-2
+    out = np.empty(n, np.uint16) if bf16 else None
+    adam_step_buffers(p, m, v, g, step=1, lib=lib, bf16_out=out, **HYPER)
+    t0 = time.time()
+    for i in range(iters):
+        adam_step_buffers(p, m, v, g, step=2 + i, lib=lib, bf16_out=out,
+                          **HYPER)
+    return (time.time() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--params", type=int, default=100_000_000,
+                    help="largest size (default 100M; the reference's "
+                    "harness runs 1B)")
+    args = ap.parse_args()
+
+    native = get_native_lib()
+    cores = os.cpu_count()
+    rows = []
+    sizes = [1_000_000, 10_000_000, args.params]
+    for n in sizes:
+        dt_native = time_step(n, native) if native is not None else None
+        dt_numpy = time_step(n, None, iters=2) if n <= 10_000_000 else None
+        dt_bf16 = (time_step(n, native, bf16=True)
+                   if native is not None else None)
+        row = {"params": n,
+               "native_ms": None if dt_native is None
+               else round(dt_native * 1e3, 2),
+               "numpy_ms": None if dt_numpy is None
+               else round(dt_numpy * 1e3, 2),
+               "native_bf16emit_ms": None if dt_bf16 is None
+               else round(dt_bf16 * 1e3, 2)}
+        rows.append(row)
+        print(f"[host_adam] {row}", file=sys.stderr)
+
+    top = rows[-1]
+    dt = top["native_ms"] if top["native_ms"] is not None \
+        else time_step(args.params, None, iters=1) * 1e3
+    print(json.dumps({
+        "metric": "host_adam_params_per_sec",
+        "value": round(args.params / (dt / 1e3), 1),
+        "unit": "params/s",
+        "vs_baseline": 0.0,
+        "params": args.params,
+        "step_ms": dt,
+        "native": top["native_ms"] is not None,
+        "bf16_emit_step_ms": top["native_bf16emit_ms"],
+        "host_cores": cores,
+        "platform": "host-cpu",
+        "sizes": rows,
+    }))
+
+
+if __name__ == "__main__":
+    main()
