@@ -15,7 +15,6 @@ split with EXPLICIT collectives, the f/g operator pair of
 ops/tp_collectives.py, so they stay out of divergent control flow.)
 """
 
-import math
 from dataclasses import dataclass, field
 from typing import Optional
 

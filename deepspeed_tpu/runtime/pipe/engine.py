@@ -28,8 +28,6 @@ train_batch consumes gradient_accumulation_steps microbatches per call like
 the reference (pipe/engine.py:250).
 """
 
-from typing import Any, Optional
-
 import numpy as np
 
 import jax
