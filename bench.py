@@ -70,7 +70,10 @@ def _reap_probe(proc, grace=20):
 def _probe_tpu(timeout):
     """Probe backend usability in a SUBPROCESS so a stale-claim hang can be
     killed (a hung jax.devices() in-process can never be interrupted —
-    that is exactly how round 2's bench wedged).  Returns (ok, info)."""
+    that is exactly how round 2's bench wedged).  Returns (ok, hung, info):
+    `hung` is the structured wedge signature (probe ran to its timeout),
+    distinct from a fast rc!=0 failure whose stderr might merely say
+    'hung up'."""
     global _active_probe
     proc = subprocess.Popen([sys.executable, "-c", _PROBE_CODE],
                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
@@ -79,32 +82,52 @@ def _probe_tpu(timeout):
     try:
         out, err = proc.communicate(timeout=timeout)
         if proc.returncode == 0:
-            return True, out.strip()
-        return False, f"probe rc={proc.returncode}: {(err or '')[-300:]}"
+            return True, False, out.strip()
+        return False, False, f"probe rc={proc.returncode}: {(err or '')[-300:]}"
     except subprocess.TimeoutExpired:
         _reap_probe(proc)
-        return False, f"probe hung >{timeout:.0f}s (stale TPU claim?)"
+        return False, True, f"probe hung >{timeout:.0f}s (stale TPU claim?)"
     finally:
         _active_probe = None
 
 
-def _await_tpu_slot(budget, probe_timeout=180.0, retry_delay=30.0):
+def _await_tpu_slot(budget, probe_timeout=180.0, retry_delay=30.0,
+                    max_hung=None):
     """Loop a bounded probe until the tunnel's single claim slot is usable,
     waiting for the relay to reap any stale claim — consuming up to
     `budget` seconds before giving up.  Round-2 lesson: the relay DOES
     reap stale claims eventually; the bench just has to outlast it.
+
+    Round-4 lesson (BENCH_r04: 8 x 180 s probes burned the whole driver
+    window before the stale fallback spoke): a probe that HANGS to its
+    timeout is the wedged-transport signature, and a wedged transport
+    never recovers inside a bench window — only the driver side restarts
+    it.  So hung probes are capped at `max_hung` (default 2, env
+    DS_BENCH_MAX_HUNG_PROBES); fast failures (rc != 0: backend races,
+    claim-release blips) keep retrying within `budget` as before.
     Returns (ok, info, waited_seconds)."""
+    if max_hung is None:
+        try:
+            max_hung = int(os.environ.get("DS_BENCH_MAX_HUNG_PROBES", 2))
+        except ValueError:  # junk env must not breach the one-line contract
+            max_hung = 2
     t0 = time.time()
-    attempt = 0
+    attempt = hung = 0
     while True:
         attempt += 1
         remaining = budget - (time.time() - t0)
-        ok, info = _probe_tpu(min(probe_timeout, max(30.0, remaining)))
+        ok, hung_probe, info = _probe_tpu(
+            min(probe_timeout, max(30.0, remaining)))
         waited = time.time() - t0
         if ok:
             return True, info, waited
         print(f"[bench] probe {attempt} failed after {waited:.0f}s: {info}",
               file=sys.stderr, flush=True)
+        if hung_probe:
+            hung += 1
+            if hung >= max_hung:
+                return False, (f"{info}; {hung} hung probes — wedged "
+                               "transport, falling back early"), waited
         if waited + retry_delay >= budget:
             return False, info, waited
         time.sleep(retry_delay)

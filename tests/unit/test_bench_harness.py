@@ -29,15 +29,16 @@ def test_probe_succeeds_on_cpu(monkeypatch):
     # the env's sitecustomize routes a bare jax.devices() at the real TPU
     # tunnel — tests must never touch it, so pin the probe to CPU
     monkeypatch.setenv("DS_BENCH_PROBE_PLATFORM", "cpu")
-    ok, info = bench._probe_tpu(timeout=120)
+    ok, hung, info = bench._probe_tpu(timeout=120)
     assert ok, info
+    assert not hung
 
 
 def test_probe_kills_hung_subprocess(monkeypatch):
     monkeypatch.setattr(bench, "_PROBE_CODE", "import time; time.sleep(600)")
     t0 = time.time()
-    ok, info = bench._probe_tpu(timeout=2)
-    assert not ok and "hung" in info
+    ok, hung, info = bench._probe_tpu(timeout=2)
+    assert not ok and hung
     assert time.time() - t0 < 60  # killed, not waited out
 
 
@@ -49,17 +50,51 @@ def test_await_slot_retries_until_reaped(monkeypatch):
     def fake_probe(timeout):
         calls["n"] += 1
         if calls["n"] < 3:
-            return False, "stale claim"
-        return True, "cpu"
+            return False, False, "stale claim"
+        return True, False, "cpu"
 
     monkeypatch.setattr(bench, "_probe_tpu", fake_probe)
     ok, info, waited = bench._await_tpu_slot(budget=60, retry_delay=0.05)
     assert ok and calls["n"] == 3
 
 
+def test_await_slot_caps_hung_probes(monkeypatch):
+    """Round-4 failure mode (BENCH_r04): 8 x 180 s hung probes exhausted
+    the driver window before the stale fallback spoke.  A probe that hangs
+    to its timeout means a wedged transport, which never recovers within a
+    bench window — the loop must give up after max_hung (2) hung probes
+    even with budget to spare, while fast failures keep retrying."""
+    calls = {"n": 0}
+
+    def hung_probe(timeout):
+        calls["n"] += 1
+        return False, True, f"probe hung >{timeout:.0f}s (stale TPU claim?)"
+
+    monkeypatch.setattr(bench, "_probe_tpu", hung_probe)
+    ok, info, waited = bench._await_tpu_slot(budget=3600, retry_delay=0.05)
+    assert not ok and calls["n"] == 2
+    assert "wedged" in info
+    # fast failures (no hang) are NOT capped at 2 — they ride the budget,
+    # even when the error text happens to contain the word "hung"
+    calls["n"] = 0
+    monkeypatch.setattr(
+        bench, "_probe_tpu",
+        lambda timeout: (calls.__setitem__("n", calls["n"] + 1),
+                         (False, False,
+                          "probe rc=1: remote end hung up unexpectedly"))[1])
+    ok, info, waited = bench._await_tpu_slot(budget=0.5, retry_delay=0.1)
+    assert not ok and calls["n"] >= 2
+    # env override widens the cap
+    calls["n"] = 0
+    monkeypatch.setenv("DS_BENCH_MAX_HUNG_PROBES", "4")
+    monkeypatch.setattr(bench, "_probe_tpu", hung_probe)
+    ok, info, waited = bench._await_tpu_slot(budget=3600, retry_delay=0.05)
+    assert not ok and calls["n"] == 4
+
+
 def test_await_slot_gives_up_at_budget(monkeypatch):
     monkeypatch.setattr(bench, "_probe_tpu",
-                        lambda timeout: (False, "stale claim"))
+                        lambda timeout: (False, False, "stale claim"))
     t0 = time.time()
     ok, info, waited = bench._await_tpu_slot(budget=1.0, retry_delay=0.2)
     assert not ok
