@@ -76,6 +76,20 @@ def main():
     ap.add_argument("--seq", type=int, default=512)
     ap.add_argument("--steps", type=int, default=1)
     ap.add_argument("--nvme-path", default="/tmp/ds_infinity_capability")
+    ap.add_argument("--param-tier", choices=("nvme", "cpu"), default="nvme",
+                    help="parameter tier: 'nvme' pages bf16 params through "
+                    "the param swapper; 'cpu' keeps them as host arrays — "
+                    "used when the NVMe budget is spent on the optimizer "
+                    "tier (disk = master+moments 12 B/param; the 5B row "
+                    "needs ~60 GB of the ~70 GB free)")
+    ap.add_argument("--opt-tier", choices=("cpu", "nvme"), default="cpu",
+                    help="optimizer-state tier: 'cpu' keeps fp32 master + "
+                    "moments in host RAM (~12 B/param — OOMs past ~8B on "
+                    "this 125 GB host); 'nvme' pages them through the "
+                    "optimizer swapper (runtime/zero/infinity.py -> "
+                    "swap_tensor/optimizer_swapper.py), the reference's "
+                    "partitioned_optimizer_swapper.py:27 role — required "
+                    "for the >=5B capability row")
     args = ap.parse_args()
 
     import jax
@@ -137,9 +151,12 @@ def main():
         "bf16": {"enabled": True},
         "zero_optimization": {
             "stage": 3,
-            "offload_param": {"device": "nvme",
-                              "nvme_path": args.nvme_path},
-            "offload_optimizer": {"device": "cpu"},
+            "offload_param": (
+                {"device": "nvme", "nvme_path": args.nvme_path}
+                if args.param_tier == "nvme" else {"device": "cpu"}),
+            "offload_optimizer": (
+                {"device": "nvme", "nvme_path": args.nvme_path}
+                if args.opt_tier == "nvme" else {"device": "cpu"}),
         },
         "steps_per_print": 10 ** 9,
     }
@@ -191,6 +208,8 @@ def main():
         "params_exceed_hbm": bool(hbm_total and
                                   param_bytes_bf16 > hbm_total),
         "hbm_window_groups": engine.max_live_param_groups,
+        "optimizer_tier": args.opt_tier,
+        "param_tier": args.param_tier,
         "step_seconds": round(step_s, 1),
         "first_step_seconds": round(first_step_s, 1),
         "peak_host_rss_gb": round(max(peak[0], rss_gb()), 1),

@@ -1,0 +1,62 @@
+#!/bin/bash
+# Round-5 probe session: the VERDICT r4 chip asks, in leverage order.
+#   1. live flagship row (repairs the round-4 stale BENCH capture)
+#   2. gpt2_medium / gpt2_large MFU-scaling rows (>50% MFU target)
+#   3. bert_z2 gap probe (LAMB-vs-AdamW engine A/B) + fresh bert_z2 row
+#   4. convergence baseline re-run with DS_CONV_OVERSHOOT=0.05 (widens
+#      the 0.0016-nat gate margin)
+#   5. LAST (wedge-prone: ~10 GB D2H through the tunnel): >=5B capability
+#      via the NVMe optimizer tier.
+# Marker-resumable: a supervisor relaunch skips finished stages.
+set -u
+cd "$(dirname "$0")/.."
+OUT=benchmarks/session_r5
+mkdir -p "$OUT"
+. benchmarks/slot_lib.sh
+
+echo "== round-5 probe session start $(stamp)" | tee -a "$OUT/session.log"
+waitslot 60 || exit 1
+
+row flagship gpt2
+waitslot 10 || exit 1
+row gpt2_medium gpt2_medium
+waitslot 10 || exit 1
+WATCHDOG=1500 ROWTIMEOUT=1600 row gpt2_large gpt2_large
+waitslot 10 || exit 1
+
+json_stage bert_gap 1500 python benchmarks/profile_bert_gap.py
+waitslot 10 || exit 1
+row bert_z2 bert_z2
+
+# Convergence overshoot run: writes tests/baselines/ itself; done-marker
+# keyed on the stage, gated on the script's own converged=true output.
+if ! done_skip conv_overshoot; then
+  echo "== conv_overshoot $(stamp)" | tee -a "$OUT/session.log"
+  waitslot 10 || exit 1
+  if DS_CONV_OVERSHOOT=0.05 timeout -k 60 3000 \
+       python benchmarks/convergence_run.py > "$OUT/conv_overshoot.log" 2>&1
+  then
+    tail -3 "$OUT/conv_overshoot.log" | tee -a "$OUT/session.log"
+    grep -q '"converged": true' tests/baselines/convergence_gpt2_124m.json \
+      && done_mark conv_overshoot
+  else
+    echo "   conv_overshoot failed (see log)" | tee -a "$OUT/session.log"
+  fi
+fi
+
+# Capability >=5B, NVMe optimizer tier (VERDICT r4 #2).  hidden 4096 x
+# 24 layers + tied 50257-vocab embed = 5.04B params; fp32 master+moments
+# = 60.5 GB on NVMe (the 125 GB host tier OOMed at 8.46B in round 4),
+# bf16 params as host arrays (disk budget: ~71 GB free).  Runs LAST:
+# the 10 GB D2H grad stream is the transport-wedge trigger profile.
+if ! done_skip cap5b; then
+  waitslot 10 || exit 1
+  json_stage cap5b 5400 python benchmarks/infinity_capability.py \
+    --layers 24 --hidden 4096 --heads 32 --steps 2 \
+    --opt-tier nvme --param-tier cpu \
+    --nvme-path /tmp/ds_cap5b
+  rm -rf /tmp/ds_cap5b
+fi
+
+python benchmarks/render_results.py | tee -a "$OUT/session.log"
+echo "== round-5 probe session done $(stamp)" | tee -a "$OUT/session.log"

@@ -1,0 +1,50 @@
+#!/bin/bash
+# Round-5 transport watcher: the tunnel relay was already dead at round
+# start (21:00Z Aug 1; probes hang — the round-4 wedge pattern, only the
+# driver side can restart it).  Probe every 4 min; when the slot
+# answers, run the round-5 probe session (marker-resumable, exits fast
+# once all stages are done).  Stops near the driver's end-of-round
+# bench window so bench.py gets a free slot.
+set -u
+cd "$(dirname "$0")/.."
+LOG=benchmarks/session_r5_watch.log
+
+probe_ok() {
+  timeout -k 10 75 python -c "import jax; jax.devices()[0]" \
+    > /dev/null 2>&1
+}
+
+chain_running() {
+  pgrep -f "run_round5_probes.sh" > /dev/null 2>&1
+}
+
+all_done() {
+  [ -e benchmarks/session_r5/done/row_flagship ] &&
+  [ -e benchmarks/session_r5/done/row_gpt2_medium ] &&
+  [ -e benchmarks/session_r5/done/row_gpt2_large ] &&
+  [ -e benchmarks/session_r5/done/bert_gap ] &&
+  [ -e benchmarks/session_r5/done/row_bert_z2 ] &&
+  [ -e benchmarks/session_r5/done/conv_overshoot ] &&
+  [ -e benchmarks/session_r5/done/cap5b ]
+}
+
+echo "== r5 watcher start $(date -u +%FT%TZ)" >> "$LOG"
+while true; do
+  if all_done; then
+    echo "== all stages done $(date -u +%FT%TZ)" >> "$LOG"
+    break
+  fi
+  # driver round ends ~08:54Z Aug 2; leave the slot free from 06:45Z so
+  # in-flight stages finish before the driver's bench window
+  if [ "$(date -u +%Y%m%d%H%M)" -ge 202608020645 ]; then
+    echo "== too close to round end; stopping $(date -u +%FT%TZ)" >> "$LOG"
+    break
+  fi
+  if ! chain_running && probe_ok; then
+    echo "== slot ok, launching probes $(date -u +%FT%TZ)" >> "$LOG"
+    bash benchmarks/run_round5_probes.sh \
+      >> benchmarks/session_r5_chain.log 2>&1
+    echo "== chain exited $(date -u +%FT%TZ)" >> "$LOG"
+  fi
+  sleep 240
+done

@@ -64,6 +64,7 @@ def test_await_slot_caps_hung_probes(monkeypatch):
     to its timeout means a wedged transport, which never recovers within a
     bench window — the loop must give up after max_hung (2) hung probes
     even with budget to spare, while fast failures keep retrying."""
+    monkeypatch.delenv("DS_BENCH_MAX_HUNG_PROBES", raising=False)
     calls = {"n": 0}
 
     def hung_probe(timeout):
