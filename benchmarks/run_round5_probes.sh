@@ -37,8 +37,14 @@ if ! done_skip conv_overshoot; then
        python benchmarks/convergence_run.py > "$OUT/conv_overshoot.log" 2>&1
   then
     tail -3 "$OUT/conv_overshoot.log" | tee -a "$OUT/session.log"
-    grep -q '"converged": true' tests/baselines/convergence_gpt2_124m.json \
-      && done_mark conv_overshoot
+    # gate on THIS RUN's output (a quarantined/CPU run exits 0 but must
+    # not mark the stage done on the strength of the round-4 baseline):
+    # the final JSON line must say converged on the chip
+    tail -1 "$OUT/conv_overshoot.log" | python -c '
+import json, sys
+row = json.loads(sys.stdin.read())
+sys.exit(0 if row.get("converged") and row.get("platform") == "tpu" else 1)
+' && done_mark conv_overshoot
   else
     echo "   conv_overshoot failed (see log)" | tee -a "$OUT/session.log"
   fi

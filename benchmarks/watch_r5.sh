@@ -3,29 +3,29 @@
 # start (21:00Z Aug 1; probes hang — the round-4 wedge pattern, only the
 # driver side can restart it).  Probe every 4 min; when the slot
 # answers, run the round-5 probe session (marker-resumable, exits fast
-# once all stages are done).  Stops near the driver's end-of-round
-# bench window so bench.py gets a free slot.
+# once all stages are done).  At the cutoff it touches the session's
+# STOP file so an IN-FLIGHT chain also cedes the slot between stages
+# (slot_lib.sh waitslot honors STOP) before the driver's end-of-round
+# bench window.
 set -u
 cd "$(dirname "$0")/.."
 LOG=benchmarks/session_r5_watch.log
-
-probe_ok() {
-  timeout -k 10 75 python -c "import jax; jax.devices()[0]" \
-    > /dev/null 2>&1
-}
+OUT=benchmarks/session_r5
+mkdir -p "$OUT"
+. benchmarks/slot_lib.sh   # probe(), one shared copy
 
 chain_running() {
   pgrep -f "run_round5_probes.sh" > /dev/null 2>&1
 }
 
 all_done() {
-  [ -e benchmarks/session_r5/done/row_flagship ] &&
-  [ -e benchmarks/session_r5/done/row_gpt2_medium ] &&
-  [ -e benchmarks/session_r5/done/row_gpt2_large ] &&
-  [ -e benchmarks/session_r5/done/bert_gap ] &&
-  [ -e benchmarks/session_r5/done/row_bert_z2 ] &&
-  [ -e benchmarks/session_r5/done/conv_overshoot ] &&
-  [ -e benchmarks/session_r5/done/cap5b ]
+  [ -e "$OUT/done/row_flagship" ] &&
+  [ -e "$OUT/done/row_gpt2_medium" ] &&
+  [ -e "$OUT/done/row_gpt2_large" ] &&
+  [ -e "$OUT/done/bert_gap" ] &&
+  [ -e "$OUT/done/row_bert_z2" ] &&
+  [ -e "$OUT/done/conv_overshoot" ] &&
+  [ -e "$OUT/done/cap5b" ]
 }
 
 echo "== r5 watcher start $(date -u +%FT%TZ)" >> "$LOG"
@@ -34,13 +34,16 @@ while true; do
     echo "== all stages done $(date -u +%FT%TZ)" >> "$LOG"
     break
   fi
-  # driver round ends ~08:54Z Aug 2; leave the slot free from 06:45Z so
-  # in-flight stages finish before the driver's bench window
+  # driver round ends ~08:54Z Aug 2; cede the slot from 06:45Z so the
+  # driver's bench window finds it free (STOP stops an in-flight chain
+  # at its next waitslot)
   if [ "$(date -u +%Y%m%d%H%M)" -ge 202608020645 ]; then
-    echo "== too close to round end; stopping $(date -u +%FT%TZ)" >> "$LOG"
+    touch "$OUT/STOP"
+    echo "== cutoff: STOP touched, watcher exiting $(date -u +%FT%TZ)" \
+      >> "$LOG"
     break
   fi
-  if ! chain_running && probe_ok; then
+  if ! chain_running && probe; then
     echo "== slot ok, launching probes $(date -u +%FT%TZ)" >> "$LOG"
     bash benchmarks/run_round5_probes.sh \
       >> benchmarks/session_r5_chain.log 2>&1

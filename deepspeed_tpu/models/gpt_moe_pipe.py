@@ -1,0 +1,148 @@
+"""GPT-MoE as a PipelineModule — the PP × EP composition.
+
+Reference: the reference runs MoE models under any of its engines — expert
+grads are reduced per expert-data group uniformly
+(deepspeed/runtime/engine.py:1714-1727) and nothing in its PipelineEngine
+forbids an MoE layer inside a stage.  Here the SPMD pipeline body must be
+HOMOGENEOUS (stacked params, runtime/pipe/module.py), so the dense/MoE
+interleave (gpt_moe.py is_moe_layer: layer i is MoE when
+i % moe_every == moe_every - 1) is expressed as a stackable "MoE group"
+unit: (moe_every - 1) dense transformer layers followed by one
+attention-only layer + gated expert FFN.  Every group has an identical
+param signature, so `num_layers // moe_every` groups stack into the
+pipeline body and partition over stages.
+
+The GShard load-balance loss rides the executors' aux-loss channel
+(PipeLayer.apply_with_aux -> one_f_one_b.py): each group's l_aux is
+pre-scaled by moe_aux_loss_coef here, summed into the training loss for
+active (stage, microbatch) forwards, and its gradient is injected with a
+loss_scale vjp seed — exact under fp16 dynamic scaling because the aux
+term is additive in the scaled total loss.
+
+Expert parallelism: the MOELayer's [E, C, d] dispatch buffers carry
+expert-axis sharding constraints (moe/sharded_moe.py _constrain_expert);
+under the masked 1F1B executor GSPMD lowers the token->slot resharding to
+all-to-alls WITHIN each pipe row (the batch is sharded over (data,
+expert); the blocks' expert dim over the expert axis) — the composition
+the reference gets from its expert process groups (moe/sharded_moe.py
+_AllToAll over the expert group).
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..moe import MoE
+from ..ops.normalize import fused_layer_norm
+from ..ops.activations import dropout
+from ..ops.transformer import DeepSpeedTransformerLayer
+from ..runtime.pipe.module import (LayerSpec, PipeLayer, PipelineModule,
+                                   TiedLayerSpec)
+from .gpt_moe import GPTMoEConfig
+from .gpt2_pipe import (GPT2EmbedPipe, GPT2FinalLNPipe, GPT2HeadPipe,
+                        gpt2_next_token_loss)
+
+
+class GPTMoEGroupPipe(PipeLayer):
+    """One stackable MoE group: (moe_every - 1) dense transformer layers,
+    then [attention-only layer -> pre-LN -> top-k gated experts ->
+    dropout -> residual] (the GShard interleave as a homogeneous unit)."""
+
+    def __init__(self, cfg: GPTMoEConfig):
+        self.cfg = cfg
+        self.dense_layer = DeepSpeedTransformerLayer(
+            cfg.layer_config("dense"))
+        self.attn_layer = DeepSpeedTransformerLayer(cfg.layer_config("none"))
+        self.moe = MoE(hidden_size=cfg.hidden_size,
+                       num_experts=cfg.num_experts, k=cfg.top_k,
+                       capacity_factor=cfg.capacity_factor,
+                       min_capacity=cfg.min_capacity)
+
+    def init_params(self, rng, x):
+        cfg = self.cfg
+        keys = jax.random.split(rng, cfg.moe_every + 1)
+        probe = jnp.zeros((1, cfg.hidden_size), jnp.float32)
+        return {
+            "dense": tuple(self.dense_layer.init_params(keys[j])
+                           for j in range(cfg.moe_every - 1)),
+            "attn": self.attn_layer.init_params(keys[-2]),
+            "moe_nw": jnp.ones((cfg.hidden_size,), jnp.float32),
+            "moe_nb": jnp.zeros((cfg.hidden_size,), jnp.float32),
+            "moe": self.moe.init_params(keys[-1], probe),
+        }
+
+    def param_partition_specs(self):
+        from jax.sharding import PartitionSpec as P
+        cfg = self.cfg
+        return {
+            "dense": tuple(
+                DeepSpeedTransformerLayer.param_partition_specs("dense")
+                for _ in range(cfg.moe_every - 1)),
+            "attn": DeepSpeedTransformerLayer.param_partition_specs("none"),
+            "moe_nw": P(), "moe_nb": P(),
+            "moe": self.moe.param_partition_specs(),
+        }
+
+    def apply_with_aux(self, params, x, rng=None):
+        """x: [B, S, H] -> (y, aux) with aux = moe_aux_loss_coef * l_aux
+        (pre-scaled: the executors sum aux terms directly into the loss)."""
+        cfg = self.cfg
+        deterministic = rng is None
+        b, s, hid = x.shape
+        for j, dp in enumerate(params["dense"]):
+            r = None if deterministic else jax.random.fold_in(rng, j)
+            x = self.dense_layer(dp, x, rng=r, deterministic=deterministic)
+        r_attn = (None if deterministic
+                  else jax.random.fold_in(rng, cfg.moe_every + 1))
+        x = self.attn_layer(params["attn"], x, rng=r_attn,
+                            deterministic=deterministic)
+        moe_in = fused_layer_norm(x, params["moe_nw"], params["moe_nb"],
+                                  cfg.layer_norm_eps)
+        r_moe = (None if deterministic
+                 else jax.random.fold_in(rng, cfg.moe_every + 2))
+        out, l_aux, _ = self.moe.apply(params["moe"],
+                                       moe_in.reshape(b * s, hid),
+                                       rng=r_moe, train=not deterministic)
+        out = out.reshape(b, s, hid).astype(x.dtype)
+        r_drop = (jax.random.fold_in(rng, cfg.moe_every + 3)
+                  if not deterministic else None)
+        out = dropout(out, cfg.hidden_dropout, r_drop,
+                      deterministic=deterministic)
+        aux = cfg.moe_aux_loss_coef * l_aux.astype(jnp.float32)
+        return x + out, aux
+
+    def apply(self, params, x, rng=None):
+        y, _ = self.apply_with_aux(params, x, rng=rng)
+        return y
+
+
+def gpt_moe_pipeline_module(cfg: GPTMoEConfig,
+                            num_stages: Optional[int] = None,
+                            activation_checkpoint_interval: int = 0
+                            ) -> PipelineModule:
+    """GPT-MoE as [embed] + (num_layers / moe_every) x [MoE group] +
+    [ln_f, head] pipeline stages.  The embed/head stages are GPT-2's
+    (gpt2_pipe.py); tied embeddings route through a TiedLayerSpec."""
+    if cfg.moe_every < 1 or cfg.num_layers % cfg.moe_every != 0:
+        raise ValueError(
+            f"num_layers={cfg.num_layers} must be a positive multiple of "
+            f"moe_every={cfg.moe_every}: the pipeline body stacks "
+            "homogeneous [dense^(moe_every-1), moe] groups")
+    n_groups = cfg.num_layers // cfg.moe_every
+    blocks = [LayerSpec(GPTMoEGroupPipe, cfg) for _ in range(n_groups)]
+    if cfg.tie_word_embeddings:
+        def tied_head(params, h):
+            head = params["wte"].astype(h.dtype).T
+            return (h @ head).astype(jnp.float32)
+
+        layers = ([TiedLayerSpec("embed", GPT2EmbedPipe, cfg)] + blocks +
+                  [LayerSpec(GPT2FinalLNPipe, cfg),
+                   TiedLayerSpec("embed", GPT2EmbedPipe, cfg,
+                                 forward_fn=tied_head)])
+    else:
+        layers = ([LayerSpec(GPT2EmbedPipe, cfg)] + blocks +
+                  [LayerSpec(GPT2HeadPipe, cfg)])
+    return PipelineModule(
+        layers, num_stages=num_stages, loss_fn=gpt2_next_token_loss,
+        activation_checkpoint_interval=activation_checkpoint_interval)

@@ -69,13 +69,6 @@ class PipelineEngine(DeepSpeedEngine):
         assert isinstance(model, PipelineModule), \
             "PipelineEngine needs a PipelineModule"
         ctx = resolve_mesh_ctx(config, mesh)
-        if ctx.expert_parallel_world_size > 1:
-            raise NotImplementedError(
-                "pipeline × expert parallelism is not composed yet — run "
-                "MoE models under the non-pipeline engine (expert axis) or "
-                "the pipeline without an expert axis; silently combining "
-                "them would misroute the all-to-all over the pipe-sharded "
-                "buffers")
         num_stages = ctx.pipe_parallel_world_size
         if model.num_stages in (None, 1):
             model.num_stages = num_stages
@@ -164,14 +157,27 @@ class PipelineEngine(DeepSpeedEngine):
         else:
             tp_manual_why = None
         seq_inbody = ctx.seq_parallel_world_size > 1
-        gating_blocked = seq_inbody or (tp_world and tp_manual_why
-                                        is not None)
+        # PP × EP (round 5): an expert axis with an MoE body runs the
+        # MASKED executor — GSPMD places the expert all-to-alls inside
+        # the gated executor's divergent branches (the same mechanism
+        # that deadlocked GSPMD-auto TP; reference composes MoE under
+        # any engine via per-group expert-grad reduction,
+        # deepspeed/runtime/engine.py:1714-1727).  An expert axis with a
+        # PLAIN body only shards the batch (expert-data), whose grad
+        # reductions happen outside the gates — still gated.
+        ep_moe_inbody = (ctx.expert_parallel_world_size > 1 and
+                         hasattr(body, "apply_with_aux"))
+        gating_blocked = (seq_inbody or ep_moe_inbody or
+                          (tp_world and tp_manual_why is not None))
         if gated_cfg and gating_blocked:
             raise ValueError(
                 "pipeline.gated=true cannot run on this mesh: "
                 + ("sequence-parallel ring permutes inside the stage "
                    "body do not compose with the divergent per-stage "
                    "branches" if seq_inbody else
+                   "an expert axis with an MoE body needs the expert "
+                   "all-to-alls out of the divergent branches"
+                   if ep_moe_inbody else
                    "a model axis > 1 needs the body's manual TP mode — "
                    + tp_manual_why)
                 + " — drop the explicit gated flag to use the masked "
@@ -185,6 +191,8 @@ class PipelineEngine(DeepSpeedEngine):
                 "PipelineEngine: masked 1F1B executor (gated executor "
                 "does not compose with "
                 + ("seq axes" if seq_inbody else
+                   "expert all-to-alls inside an MoE body"
+                   if ep_moe_inbody else
                    "this body/config under TP: " + str(tp_manual_why))
                 + ")", ranks=[0])
         if schedule == "1f1b":
@@ -267,22 +275,35 @@ class PipelineEngine(DeepSpeedEngine):
                 x, NamedSharding(mesh, PartitionSpec(*spec)))
 
         tp_manual = getattr(self, "_tp_manual", False)
+        has_aux = hasattr(body_layer, "apply_with_aux")
 
         def stage_apply(stage_params, x, mb, stage_idx, rng_base):
             # dropout seeds keyed by (microbatch, global layer index) so the
-            # backward-lane remat replays the forward bit-exactly
+            # backward-lane remat replays the forward bit-exactly.
+            # Returns (y, aux): aux is the stage's summed pre-scaled
+            # auxiliary loss (MoE l_aux; 0.0 for plain bodies) — the
+            # executors add it to the loss and seed its gradient with
+            # loss_scale (one_f_one_b.py).
             def one_layer(carry, lp_j):
+                x, aux = carry
                 lp, j = lp_j
                 r = jax.random.fold_in(
                     rng_base, mb * n_layers + lo + stage_idx * k + j)
                 if tp_manual:
                     # explicit-collective Megatron split (params arrive in
                     # the head-major tp_manual_views layout)
-                    return body_layer.apply_manual_tp(lp, carry, rng=r), None
-                return body_layer.apply(lp, carry, rng=r), None
+                    y = body_layer.apply_manual_tp(lp, x, rng=r)
+                    a = jnp.float32(0.0)
+                elif has_aux:
+                    y, a = body_layer.apply_with_aux(lp, x, rng=r)
+                else:
+                    y = body_layer.apply(lp, x, rng=r)
+                    a = jnp.float32(0.0)
+                return (y, aux + a.astype(jnp.float32)), None
 
-            x, _ = lax.scan(one_layer, x, (stage_params, jnp.arange(k)))
-            return x
+            (x, aux), _ = lax.scan(one_layer, (x, jnp.float32(0.0)),
+                                   (stage_params, jnp.arange(k)))
+            return x, aux
 
         def pre_apply(pre, tied, x_mb, mb, rng_pre):
             return module.chain_apply(
@@ -381,11 +402,19 @@ class PipelineEngine(DeepSpeedEngine):
             return lax.with_sharding_constraint(
                 x, NamedSharding(mesh, PartitionSpec(*spec)))
 
+        has_aux = hasattr(body_layer, "apply_with_aux")
+
         def one_layer(carry, layer_params_and_idx):
+            x, aux = carry
             layer_params, seed = layer_params_and_idx
             r = (None if deterministic
                  else jax.random.fold_in(jax.random.PRNGKey(0), seed))
-            return body_layer.apply(layer_params, carry, rng=r), None
+            if has_aux:
+                y, a = body_layer.apply_with_aux(layer_params, x, rng=r)
+            else:
+                y = body_layer.apply(layer_params, x, rng=r)
+                a = jnp.float32(0.0)
+            return (y, aux + a.astype(jnp.float32)), None
 
         # activation checkpointing: any interval > 0 remats at per-layer
         # granularity — the finest; recompute is cheap relative to holding
@@ -395,11 +424,14 @@ class PipelineEngine(DeepSpeedEngine):
             one_layer = jax.checkpoint(one_layer)
 
         def stage_apply(stage_params, x, seed):
-            # scan over this stage's layers_per_stage blocks
+            # scan over this stage's layers_per_stage blocks; returns
+            # (y, aux) with aux the stage's summed pre-scaled auxiliary
+            # loss (MoE l_aux; 0.0 for plain bodies)
             k = jax.tree.leaves(stage_params)[0].shape[0]
             seeds = seed + jnp.arange(k)
-            x, _ = lax.scan(one_layer, x, (stage_params, seeds))
-            return x
+            (x, aux), _ = lax.scan(one_layer, (x, jnp.float32(0.0)),
+                                   (stage_params, seeds))
+            return x, aux
 
         def pipelined_apply(params, rng, x, y):
             pre, blocks = params["pre"], params["blocks"]
@@ -429,15 +461,21 @@ class PipelineEngine(DeepSpeedEngine):
             pad = jnp.zeros((S - 1,) + h.shape[1:], h.dtype)
             h_pad = jnp.concatenate([h, pad], axis=0)
             seed_base = jax.random.randint(rng_body, (), 0, 2**31 - 1)
+            stage_ids = jnp.arange(S)
 
             def tick(carry, t):
-                buf, outs = carry
+                buf, outs, aux_acc = carry
                 inp = lax.dynamic_index_in_dim(h_pad, t, 0, keepdims=False)
                 buf = buf.at[0].set(inp)
                 buf = constrain(buf, PIPE_AXIS, (DATA_AXIS, EXPERT_AXIS))
                 seeds = seed_base + t * (S * 131071) + jnp.arange(S) * 8191
-                yb = jax.vmap(stage_apply)(blocks, buf, seeds)
+                yb, aux_s = jax.vmap(stage_apply)(blocks, buf, seeds)
                 yb = constrain(yb, PIPE_AXIS, (DATA_AXIS, EXPERT_AXIS))
+                # stage s is computing real microbatch t-s only while
+                # 0 <= t-s < M; fill/drain ticks run on zero padding whose
+                # aux (MoE gating of zero tokens) must not enter the loss
+                active = (t >= stage_ids) & (t < stage_ids + M)
+                aux_acc = aux_acc + jnp.where(active, aux_s, 0.0).sum()
                 out_t = yb[S - 1]
                 idx = jnp.clip(t - (S - 1), 0, M - 1)
                 outs = lax.cond(
@@ -448,9 +486,10 @@ class PipelineEngine(DeepSpeedEngine):
                 # the SendActivation/RecvActivation pair: collective-permute
                 # over the pipe axis
                 buf = jnp.roll(yb, 1, axis=0)
-                return (buf, outs), None
+                return (buf, outs, aux_acc), None
 
-            (_, outs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(T))
+            (_, outs, aux_total), _ = lax.scan(
+                tick, (buf0, outs0, jnp.float32(0.0)), jnp.arange(T))
             outs = constrain(outs, None, (DATA_AXIS, EXPERT_AXIS))
 
             def per_micro_loss(h_out, yb, r):
@@ -466,8 +505,11 @@ class PipelineEngine(DeepSpeedEngine):
                 post_keys = jax.random.split(rng_post, M)
                 losses = jax.vmap(per_micro_loss)(outs, ym, post_keys)
             # sum over microbatches: the base engine's apply_step divides by
-            # gradient_accumulation_steps, recovering the mean
-            return losses.sum()
+            # gradient_accumulation_steps, recovering the mean.  aux_total
+            # (MoE load-balance, pre-scaled, one term per active
+            # stage-microbatch forward) joins additively — autodiff carries
+            # its gradient on this path.
+            return losses.sum() + aux_total
 
         return pipelined_apply
 

@@ -314,6 +314,19 @@ def make_gated_1f1b_grad_fn(*, mesh, stage_apply: Callable,
     spec trees describing their vocab-sharded leaves.  The replicated
     `pre_apply` still provides the boundary activation shape (it is
     evaluated OUTSIDE the region, where axis_index is unbound).
+
+    AUXILIARY LOSSES (MoE load balancing): `stage_apply` returns
+    (y, aux) where aux is a pre-scaled fp32 scalar (the layer owns its
+    coefficient — reference: engine.py's l_aux accumulation via the
+    MoE layers).  The total loss is loss_sum + Σ aux over active
+    (stage, microbatch) forwards; since aux enters the TOTAL scaled
+    loss additively, its backward seed is exactly `loss_scale` — a
+    constant — so the gradient is injected at each stage's vjp without
+    threading the value through the pipeline transport.  Exact under
+    fp16 dynamic loss scaling by construction.  (An MoE body with an
+    expert axis > 1 is routed to the MASKED executor by the engine —
+    GSPMD would place the expert all-to-alls inside these divergent
+    branches; see pipe/engine.py ep_moe_inbody.)
     """
     tables = simulate_global_clock(micro_batches, num_stages)
     S, M, C = tables.num_stages, tables.micro_batches, tables.max_slots
@@ -384,11 +397,15 @@ def make_gated_1f1b_grad_fn(*, mesh, stage_apply: Callable,
                                                 keepdims=False)
 
                 def run_fwd(x):
-                    return stage_apply(my_blocks, x, f_mb, me,
-                                       rng_body).astype(rot.dtype)
+                    y, aux = stage_apply(my_blocks, x, f_mb, me, rng_body)
+                    return y.astype(rot.dtype), aux.astype(jnp.float32)
 
-                y = lax.cond(f_act, run_fwd, lambda x: jnp.zeros_like(x),
-                             x_in)
+                y, aux_f = lax.cond(
+                    f_act, run_fwd,
+                    lambda x: (jnp.zeros_like(x), jnp.float32(0.0)), x_in)
+                # stage aux losses (MoE l_aux, pre-scaled) join the loss;
+                # their grads are seeded in the backward lane below
+                loss_acc = loss_acc + aux_f
                 # same-tick fwd+bwd of one microbatch: backward input is
                 # the forward lane's fresh (post-park) read
                 x_saved = jnp.where(b_from_f, x_in, x_saved)
@@ -438,7 +455,11 @@ def make_gated_1f1b_grad_fn(*, mesh, stage_apply: Callable,
                         lambda pp, xx: stage_apply(pp, xx, b_mb, me,
                                                    rng_body),
                         my_blocks, x)
-                    gp, gx = vjp(c.astype(h_shape.dtype))
+                    # cotangents: (activation, aux) — the aux seed is the
+                    # loss scale exactly (aux is additive in the scaled
+                    # total loss)
+                    gp, gx = vjp((c.astype(h_shape.dtype),
+                                  loss_scale.astype(jnp.float32)))
                     return gp, gx.astype(cot.dtype)
 
                 def skip_bwd(args):
@@ -491,14 +512,16 @@ def make_gated_1f1b_grad_fn(*, mesh, stage_apply: Callable,
             return loss_sum, {"pre": g_pre, "blocks": g_blocks,
                               "post": g_post, "tied": g_tied}
 
-        if model_axis is None:
+        axis_names = frozenset(
+            {PIPE_AXIS} | ({model_axis} if model_axis is not None
+                           else set()))
+        if block_specs is None:
             blocks_spec = P(PIPE_AXIS)
-            axis_names = frozenset({PIPE_AXIS})
         else:
             blocks_spec = jax.tree.map(
-                lambda sp: P(PIPE_AXIS, None, *sp), block_specs,
-                is_leaf=lambda x: isinstance(x, P))
-            axis_names = frozenset({PIPE_AXIS, model_axis})
+                lambda sp: (P(PIPE_AXIS) if sp is None
+                            else P(PIPE_AXIS, None, *sp)), block_specs,
+                is_leaf=lambda x: x is None or isinstance(x, P))
         if aux_specs is None:
             pre_spec = post_spec = tied_spec = P()
         else:
@@ -522,7 +545,10 @@ def make_1f1b_grad_fn(*, module, constrain, stage_apply: Callable,
                       ) -> Callable:
     """Build `f(params, loss_scale, rng, xm, ym) -> (loss_sum, grads)`.
 
-    stage_apply(stage_params, x, mb, stage_idx, rng_base) -> y
+    stage_apply(stage_params, x, mb, stage_idx, rng_base) -> (y, aux)
+        aux: pre-scaled fp32 auxiliary loss (MoE load balancing; 0.0 for
+        plain bodies) — added to the loss for active forwards, gradient
+        injected via a loss_scale vjp seed (see make_gated_1f1b_grad_fn)
     pre_apply(pre, tied, x_mb, mb, rng_base) -> h           (embedding chain)
     post_loss(post, tied, h_out, y_mb, mb, rng_base) -> loss (head chain)
 
@@ -601,9 +627,12 @@ def make_1f1b_grad_fn(*, module, constrain, stage_apply: Callable,
             x_in = jax.vmap(
                 lambda r, sl: lax.dynamic_index_in_dim(
                     r, sl, 0, keepdims=False))(rot, f_slot)
-            y = jax.vmap(stage_apply, in_axes=(0, 0, 0, 0, None))(
+            y, aux_s = jax.vmap(stage_apply, in_axes=(0, 0, 0, 0, None))(
                 blocks, x_in, f_mb, stage_ids, rng_body)
             y = c_wave(y)
+            # stage aux losses (MoE l_aux, pre-scaled), active cells only
+            loss_acc = loss_acc + jnp.where(
+                f_act, aux_s.astype(jnp.float32), 0.0).sum()
             # same-tick fwd+bwd of one microbatch: the backward's input is
             # the forward lane's fresh (post-park) read
             x_saved = jnp.where(bmask(b_from_f, x_saved), x_in, x_saved)
@@ -641,7 +670,10 @@ def make_1f1b_grad_fn(*, module, constrain, stage_apply: Callable,
                 _, vjp = jax.vjp(
                     lambda pp, xx: stage_apply(pp, xx, mb, sid, rng_body),
                     p, x)
-                return vjp(c)
+                # aux seed = loss_scale exactly (additive in the scaled
+                # total loss); inactive cells' contributions are masked
+                # out of the accumulators below
+                return vjp((c, loss_scale.astype(jnp.float32)))
 
             gp, gx = jax.vmap(stage_vjp)(blocks, x_saved, ct, b_mb,
                                          stage_ids)

@@ -96,13 +96,92 @@ def test_composition_matches_baseline(pipe, tp, zero):
         np.testing.assert_allclose(a, b, rtol=5e-5, atol=1e-5)
 
 
-def test_pipe_with_expert_axis_raises():
-    """moe × pipe is not a supported composition yet — it must be a loud
-    config error, not a silent wrong answer."""
-    with pytest.raises((NotImplementedError, ValueError),
-                       match="expert"):
-        _train_pipe(pipe=2, tp=1, zero_stage=0, expert=2, steps=1)
+def test_plain_body_pipe_expert_matches_baseline():
+    """A PLAIN (dense GPT-2) body with an expert axis: the expert axis only
+    shards the batch (expert-data parallelism), so the gated executor stays
+    on and the trajectory must match — the silent-wrong-answer risk the old
+    engine guard protected against, now asserted instead of forbidden."""
+    base_losses, base_params = _baseline()
+    losses, params = _train_pipe(pipe=2, tp=1, zero_stage=0, expert=2)
+    np.testing.assert_allclose(losses, base_losses, rtol=2e-5)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(base_params)):
+        if a.shape != b.shape:
+            a = a.reshape((-1,) + a.shape[2:])
+            b = b.reshape((-1,) + b.shape[2:])
+        np.testing.assert_allclose(a, b, rtol=5e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------- #
+# PP × EP cells (round 5): an MoE pipeline body with the expert axis —
+# the composition the reference gets from running MoE under any engine
+# (deepspeed/runtime/engine.py:1714-1727 per-group expert-grad reduction).
+# ---------------------------------------------------------------------- #
+def _train_moe_pipe(pipe, expert, zero_stage=0, steps=3):
+    from deepspeed_tpu.models import GPTMoEConfig
+    from deepspeed_tpu.models.gpt_moe_pipe import gpt_moe_pipeline_module
+
     ds.reset_mesh_context()
+    mesh = ds.initialize_mesh(pipe=pipe, expert=expert, data=-1)
+    dp = mesh.data_parallel_world_size
+    cfg = GPTMoEConfig(
+        vocab_size=64, n_positions=SEQ, hidden_size=32, num_layers=4,
+        num_heads=4, bf16=False, num_experts=4, top_k=2,
+        capacity_factor=2.0, min_capacity=4, moe_every=2,
+        embd_dropout=0.0, attn_dropout=0.0, hidden_dropout=0.0)
+    module = gpt_moe_pipeline_module(cfg, num_stages=pipe)
+    conf = {
+        "train_batch_size": GLOBAL_BATCH * MICRO_BATCHES,
+        "train_micro_batch_size_per_gpu": GLOBAL_BATCH // dp,
+        "gradient_accumulation_steps": MICRO_BATCHES,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": zero_stage},
+        "steps_per_print": 10 ** 9,
+    }
+    engine = PipelineEngine(
+        model=module, config=conf,
+        example_input=jnp.zeros((GLOBAL_BATCH, SEQ), jnp.int32),
+        rng=jax.random.PRNGKey(3))
+    rs = np.random.RandomState(0)
+    losses = []
+    for _ in range(steps):
+        micro = []
+        for _ in range(MICRO_BATCHES):
+            ids = rs.randint(0, 64, size=(GLOBAL_BATCH, SEQ)).astype(
+                np.int32)
+            micro.append((ids, ids))
+        losses.append(engine.train_batch(iter(micro)))
+    params = jax.tree.map(np.asarray, engine.params)
+    ds.reset_mesh_context()
+    return losses, params
+
+
+MOE_PIPE_BASELINE = {}
+
+
+def _moe_pipe_baseline():
+    if "v" not in MOE_PIPE_BASELINE:
+        MOE_PIPE_BASELINE["v"] = _train_moe_pipe(pipe=1, expert=1)
+    return MOE_PIPE_BASELINE["v"]
+
+
+@pytest.mark.parametrize("pipe,expert,zero", [
+    (2, 2, 0),   # pipe × expert (masked executor)
+    (2, 2, 1),   # pipe × expert × zero-1
+    (1, 4, 0),   # expert-only sanity on the same module
+    (2, 1, 0),   # MoE body under the GATED executor (expert=1: the aux
+                 # channel's cond-gated accumulation + loss_scale vjp seed
+                 # at S>1)
+])
+def test_pipe_expert_matches_baseline(pipe, expert, zero):
+    base_losses, base_params = _moe_pipe_baseline()
+    losses, params = _train_moe_pipe(pipe=pipe, expert=expert,
+                                     zero_stage=zero)
+    np.testing.assert_allclose(losses, base_losses, rtol=2e-5)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(base_params)):
+        if a.shape != b.shape:
+            a = a.reshape((-1,) + a.shape[2:])
+            b = b.reshape((-1,) + b.shape[2:])
+        np.testing.assert_allclose(a, b, rtol=5e-5, atol=1e-5)
 
 
 # ---------------------------------------------------------------------- #
