@@ -12,6 +12,10 @@ set -u
 cd "$(dirname "$0")/.."
 OUT=benchmarks/session_r5
 mkdir -p "$OUT"
+# a stale STOP from a previous cutoff would make every waitslot cede
+# immediately; launching this script IS the intent to run, so clear it
+# (the watcher re-touches it at its cutoff while we run)
+rm -f "$OUT/STOP"
 . benchmarks/slot_lib.sh
 
 echo "== round-5 probe session start $(stamp)" | tee -a "$OUT/session.log"

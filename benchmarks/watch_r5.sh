@@ -45,9 +45,10 @@ while true; do
   fi
   if ! chain_running && probe; then
     echo "== slot ok, launching probes $(date -u +%FT%TZ)" >> "$LOG"
+    # background the chain: the watcher loop must keep ticking so the
+    # cutoff branch can touch STOP while a chain is in flight
     bash benchmarks/run_round5_probes.sh \
-      >> benchmarks/session_r5_chain.log 2>&1
-    echo "== chain exited $(date -u +%FT%TZ)" >> "$LOG"
+      >> benchmarks/session_r5_chain.log 2>&1 &
   fi
   sleep 240
 done

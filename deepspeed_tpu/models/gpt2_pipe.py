@@ -79,6 +79,21 @@ class GPT2BlockPipe(PipeLayer):
         return self.layer(params, x, rng=rng, deterministic=rng is None,
                           tp_axis=tp_axis or MODEL_AXIS)
 
+    # -- combined manual modes (gated executor: TP and/or SP axes) ------ #
+    def supports_manual_sp(self, sp_size: int) -> bool:
+        """Sequence-parallel manual mode: dense attention only (sparse
+        layouts are built for the full sequence)."""
+        return self.layer.config.sparsity_config is None
+
+    def apply_manual(self, params, x, rng=None, tp_axis=None, seq_axis=None,
+                     sp_mode="auto"):
+        """General manual-mode apply: params are local TP shards when
+        tp_axis is set (tp_manual_views layout); x is the local sequence
+        chunk when seq_axis is set (ring/Ulysses attention inside)."""
+        return self.layer(params, x, rng=rng, deterministic=rng is None,
+                          tp_axis=tp_axis, seq_axis=seq_axis,
+                          sp_mode=sp_mode)
+
     def tp_manual_views(self, params):
         return type(self.layer).tp_manual_views(params, self.cfg.num_heads)
 
@@ -164,6 +179,7 @@ def gpt2_pipeline_module(cfg: GPT2Config,
         layers, num_stages=num_stages, loss_fn=gpt2_next_token_loss,
         activation_checkpoint_interval=activation_checkpoint_interval)
     _attach_vocab_parallel_aux(module, cfg)
+    _attach_seq_parallel_aux(module, cfg)
     return module
 
 
@@ -227,3 +243,71 @@ def _attach_vocab_parallel_aux(module, cfg: GPT2Config):
     module.tp_manual_pre_apply = pre_apply
     module.tp_manual_post_loss = post_loss
     module.tp_manual_aux_specs = aux_specs
+
+
+def _attach_seq_parallel_aux(module, cfg: GPT2Config):
+    """Sequence-DISTRIBUTED pre/post chains for the gated 1F1B executor on
+    pipe×seq meshes (round 5).  Unlike the replicated aux chains, every
+    seq peer embeds ONLY its sequence chunk (global positions from its
+    axis index) and computes the loss over ONLY its chunk's positions —
+    so every parameter gradient is a per-peer partial sum and the
+    executor finalizes ALL grads (and the loss) with one psum over the
+    seq axis (one_f_one_b.py seq_axis=).  The next-token label shift
+    crosses chunk boundaries, so the post chain receives the FULL label
+    ids (token ids are tiny next to activations) and slices the
+    shifted window itself; the final global position carries zero loss
+    weight, matching gpt2_next_token_loss's logits[:, :-1] vs
+    labels[:, 1:] on one device exactly."""
+    from jax import lax
+
+    tied_case = cfg.tie_word_embeddings
+
+    def supports(sp_size: int) -> bool:
+        return cfg.n_positions % sp_size == 0
+
+    def pre_apply(pre, tied, ids_full, rng, seq_axis):
+        p = tied["embed"] if tied_case else pre[0]
+        sp = lax.psum(1, seq_axis)  # static under shard_map
+        idx = lax.axis_index(seq_axis)
+        s = ids_full.shape[1]
+        s_local = s // sp
+        ids_loc = lax.dynamic_slice_in_dim(ids_full, idx * s_local,
+                                           s_local, 1)
+        pos = idx * s_local + jnp.arange(s_local)
+        h = (p["wte"].astype(cfg.dtype)[ids_loc] +
+             p["wpe"].astype(cfg.dtype)[pos])
+        r = None if rng is None else jax.random.fold_in(rng, idx)
+        return dropout(h, cfg.embd_dropout, r, deterministic=rng is None)
+
+    def post_loss(post, tied, h, y_full, rng, seq_axis):
+        import optax
+
+        lnp = post[0]
+        if tied_case:
+            w, b = lnp["w"], lnp["b"]
+            head = tied["embed"]["wte"].T           # [H, V]
+        else:
+            w, b = lnp["ln_f"]["w"], lnp["ln_f"]["b"]
+            head = lnp["lm_head"]
+        h = fused_layer_norm(h, w, b, cfg.layer_norm_eps)
+        logits = (h @ head.astype(h.dtype)).astype(jnp.float32)
+        sp = lax.psum(1, seq_axis)
+        idx = lax.axis_index(seq_axis)
+        bsz, s_local = h.shape[0], h.shape[1]
+        s = y_full.shape[1]
+        # global pre-shift then local slice: shifted[t] = y[t+1]; the
+        # garbage at the last global position gets zero weight below
+        shifted = jnp.concatenate(
+            [y_full[:, 1:], jnp.zeros_like(y_full[:, :1])], axis=1)
+        labels = lax.dynamic_slice_in_dim(shifted, idx * s_local,
+                                          s_local, 1).astype(jnp.int32)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        pos = idx * s_local + jnp.arange(s_local)
+        weight = (pos < s - 1).astype(jnp.float32)
+        # per-peer PARTIAL of the global mean over [B, S-1]; the executor
+        # psums partials over the seq axis
+        return (ce * weight[None, :]).sum() / (bsz * (s - 1))
+
+    module.sp_manual_supports = supports
+    module.sp_manual_pre_apply = pre_apply
+    module.sp_manual_post_loss = post_loss

@@ -232,7 +232,8 @@ class DeepSpeedTransformerLayer:
 
     # -- forward ------------------------------------------------------- #
     def __call__(self, params, x, attn_mask=None, rng=None,
-                 deterministic: bool = False, tp_axis: Optional[str] = None):
+                 deterministic: bool = False, tp_axis: Optional[str] = None,
+                 seq_axis: Optional[str] = None, sp_mode: str = "auto"):
         """x: [B, S, H] -> [B, S, H].  attn_mask: additive [B, 1, 1, S] or
         [B, 1, S, S] bias, like the reference's input_mask.
 
@@ -243,7 +244,20 @@ class DeepSpeedTransformerLayer:
         inside shard_map-manual regions where GSPMD-placed collectives
         would land in divergent control flow (the gated 1F1B executor's
         per-stage lax.cond branches — one_f_one_b.py).  x and the returned
-        activation are replicated over tp_axis."""
+        activation are replicated over tp_axis.
+
+        seq_axis: MANUAL sequence parallelism — x is the LOCAL sequence
+        chunk [B, S_local, H] (global order follows the axis index) and
+        attention runs ring or Ulysses over that axis
+        (parallel/sequence.py *_inner), with explicit collectives for the
+        same divergent-control-flow reason as tp_axis.  Composes with
+        tp_axis: local heads × local sequence, ring/all-to-all over seq,
+        psums over model.  Restrictions: no sparse attention, no additive
+        attn_mask, and the attention-probability ('kernel') dropout falls
+        back to output ('ctx') dropout — the ring accumulator has no PRNG
+        path.  sp_mode: 'ring' | 'ulysses' | 'allgather' | 'auto'
+        (Ulysses when the seq degree divides the local head count —
+        heads redistribute across seq peers)."""
         cfg = self.config
         eps = cfg.layer_norm_eps
         heads = cfg.heads
@@ -255,6 +269,15 @@ class DeepSpeedTransformerLayer:
             # blocked [q|k|v] layout would hold MISmatched q/k/v pieces)
             heads = params["attn_qkvw"].shape[-3]
         hw = heads * d  # local attention width (== h without tp_axis)
+        if seq_axis is not None:
+            if self._sparse_attn is not None:
+                raise ValueError(
+                    "manual sequence parallelism does not support sparse "
+                    "attention (layouts are built for the full sequence)")
+            if attn_mask is not None:
+                raise NotImplementedError(
+                    "manual sequence parallelism supports causal masking "
+                    "only (additive attn_mask has no ring form here)")
         has_dropout = (cfg.attn_dropout_ratio > 0.0 or
                        cfg.hidden_dropout_ratio > 0.0)
         if rng is None:
@@ -271,6 +294,14 @@ class DeepSpeedTransformerLayer:
             # dropouts run AFTER the psums on replicated values and must
             # keep the shared key
             r_attn = jax.random.fold_in(r_attn, lax.axis_index(tp_axis))
+        if seq_axis is not None:
+            # every dropout acts on chunk-LOCAL values: decorrelate all
+            # three keys across sequence peers (a shared key would repeat
+            # one mask pattern every S_local positions)
+            sidx = lax.axis_index(seq_axis)
+            r_attn = jax.random.fold_in(r_attn, sidx)
+            r_hid1 = jax.random.fold_in(r_hid1, sidx)
+            r_hid2 = jax.random.fold_in(r_hid2, sidx)
 
         x = x.astype(cfg.dtype)
         residual = x
@@ -307,7 +338,8 @@ class DeepSpeedTransformerLayer:
         #     choose it when dropout semantics need not match.
         # Sparse attention always uses ctx dropout (its kernel has no
         # PRNG path yet); r_attn is consumed exactly once on every path.
-        kernel_drop = cfg.attn_dropout_impl == "kernel"
+        kernel_drop = (cfg.attn_dropout_impl == "kernel"
+                       and seq_axis is None)
         attn_rate = (0.0 if deterministic or not kernel_drop
                      else cfg.attn_dropout_ratio)
 
@@ -316,7 +348,27 @@ class DeepSpeedTransformerLayer:
                 return None
             return jax.random.randint(r_attn, (), 0, 2 ** 31 - 1, jnp.int32)
 
-        if self._sparse_attn is not None:
+        if seq_axis is not None:
+            # ring / Ulysses attention over the manual seq axis on the
+            # local chunk (lazy import: parallel.sequence pulls in
+            # flash_attention at module load)
+            from ..parallel.sequence import sp_attention_inner
+
+            sp = lax.psum(1, seq_axis)  # static under shard_map
+            mode = sp_mode
+            if mode == "auto":
+                mode = "ulysses" if heads % sp == 0 else "ring"
+
+            def to_heads(t):
+                return t.reshape(b, s, heads, d).transpose(0, 2, 1, 3)
+
+            ctx = sp_attention_inner(to_heads(q), to_heads(k), to_heads(v),
+                                     mode=mode, axis_name=seq_axis,
+                                     causal=cfg.causal)
+            ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, hw)
+            # kernel-dropout fallback: output ('ctx') dropout on the chunk
+            ctx = dropout(ctx, cfg.attn_dropout_ratio, r_attn, deterministic)
+        elif self._sparse_attn is not None:
             # route the layer's additive mask into SparseSelfAttention's
             # mask features (added round 4): [B,1,1,S] (key padding) ->
             # key_padding_mask 'add'; [1,1,S,S] / [S,S] -> attn_mask

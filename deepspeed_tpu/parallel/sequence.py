@@ -149,6 +149,53 @@ def ulysses_attention_inner(q, k, v, axis_name: str = SEQ_AXIS,
                           tiled=True)
 
 
+def allgather_attention_inner(q, k, v, axis_name: str = SEQ_AXIS,
+                              causal: bool = False,
+                              sm_scale: Optional[float] = None):
+    """All-gather-KV attention; call inside shard_map — the DIVERGENT-
+    BRANCH-SAFE sequence-parallel variant.
+
+    q, k, v: [B, H, S_local, D].  K/V are all-gathered over ``axis_name``
+    via a zero-pad + ``lax.psum`` (psum is the one collective that
+    tolerates living inside ``lax.cond`` branches whose predicates differ
+    across OTHER mesh axes: groups whose members all skip the branch
+    simply never rendezvous, while ppermute/all_to_all wedge the whole
+    collective — measured on the 8-device sim, round 5).  Each device
+    then runs exact fp32-softmax attention for its LOCAL query rows
+    against the full K/V.  Used by the gated 1F1B pipeline executor,
+    whose per-stage branches are exactly that divergent context
+    (runtime/pipe/one_f_one_b.py); ring/Ulysses stay the better choice
+    everywhere collectives run unconditionally.  FLOPs match ring
+    (q_local × K_full); memory holds one full K/V per device instead of
+    ring's single remote block.
+    """
+    sp = _axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    orig_dtype = q.dtype
+    b, h, q_len, d = q.shape
+    k_len = k.shape[2]
+    s_full = k_len * sp
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+
+    def gather(x):
+        z = jnp.zeros((b, h, s_full, d), x.dtype)
+        z = lax.dynamic_update_slice_in_dim(z, x, idx * k_len, 2)
+        return lax.psum(z, axis_name)
+
+    k_full, v_full = gather(k), gather(v)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k_full,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = idx * q_len + lax.broadcasted_iota(
+            jnp.int32, (q_len, s_full), 0)
+        k_pos = lax.broadcasted_iota(jnp.int32, (q_len, s_full), 1)
+        s = jnp.where((k_pos <= q_pos)[None, None], s, DEFAULT_MASK_VALUE)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v_full.dtype), v_full,
+                     preferred_element_type=jnp.float32)
+    return out.astype(orig_dtype)
+
+
 def sp_attention_inner(q, k, v, mode: str = "ring", axis_name: str = SEQ_AXIS,
                        causal: bool = False, sm_scale: Optional[float] = None):
     """Mode-dispatched sequence-parallel attention for shard_map callers."""
@@ -156,6 +203,8 @@ def sp_attention_inner(q, k, v, mode: str = "ring", axis_name: str = SEQ_AXIS,
         return ring_attention_inner(q, k, v, axis_name, causal, sm_scale)
     if mode == "ulysses":
         return ulysses_attention_inner(q, k, v, axis_name, causal, sm_scale)
+    if mode == "allgather":
+        return allgather_attention_inner(q, k, v, axis_name, causal, sm_scale)
     raise ValueError(f"Unknown sequence-parallel mode {mode!r}")
 
 

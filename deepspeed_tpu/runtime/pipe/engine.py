@@ -156,7 +156,34 @@ class PipelineEngine(DeepSpeedEngine):
                 "the model axis)")
         else:
             tp_manual_why = None
-        seq_inbody = ctx.seq_parallel_world_size > 1
+        # Gated × sequence parallelism (round 5): the seq axis joins the
+        # manual axes — seq peers share their pipe row's predicate, so
+        # the body's ring ppermutes / Ulysses all-to-alls rendezvous
+        # within one branch (same argument as manual TP).  Needs the
+        # body's general manual mode AND the module's seq-distributed
+        # aux chains (gpt2_pipe _attach_seq_parallel_aux).
+        sp_world = ctx.seq_parallel_world_size > 1
+        sp_manual_why = None
+        if sp_world:
+            sp_size = ctx.seq_parallel_world_size
+            _sp_hooks = ("sp_manual_supports", "sp_manual_pre_apply",
+                         "sp_manual_post_loss")
+            if not hasattr(body, "apply_manual"):
+                sp_manual_why = ("this body has no general manual-mode "
+                                 "apply (apply_manual)")
+            elif (hasattr(body, "supports_manual_sp") and
+                  not body.supports_manual_sp(sp_size)):
+                sp_manual_why = (
+                    "the body declines manual SP for this config "
+                    "(sparse-attention layouts need the full sequence)")
+            elif not all(hasattr(model, m) for m in _sp_hooks):
+                sp_manual_why = (
+                    "the module lacks seq-distributed aux chains "
+                    "(sp_manual_pre_apply/sp_manual_post_loss)")
+            elif not model.sp_manual_supports(sp_size):
+                sp_manual_why = (
+                    "the module declines SP for this config (sequence "
+                    "length must divide the seq axis)")
         # PP × EP (round 5): an expert axis with an MoE body runs the
         # MASKED executor — GSPMD places the expert all-to-alls inside
         # the gated executor's divergent branches (the same mechanism
@@ -167,14 +194,15 @@ class PipelineEngine(DeepSpeedEngine):
         # reductions happen outside the gates — still gated.
         ep_moe_inbody = (ctx.expert_parallel_world_size > 1 and
                          hasattr(body, "apply_with_aux"))
-        gating_blocked = (seq_inbody or ep_moe_inbody or
-                          (tp_world and tp_manual_why is not None))
+        gating_blocked = ((sp_world and sp_manual_why is not None)
+                          or ep_moe_inbody
+                          or (tp_world and tp_manual_why is not None))
         if gated_cfg and gating_blocked:
             raise ValueError(
                 "pipeline.gated=true cannot run on this mesh: "
-                + ("sequence-parallel ring permutes inside the stage "
-                   "body do not compose with the divergent per-stage "
-                   "branches" if seq_inbody else
+                + ("a seq axis > 1 needs the body's manual SP mode — "
+                   + sp_manual_why
+                   if sp_world and sp_manual_why is not None else
                    "an expert axis with an MoE body needs the expert "
                    "all-to-alls out of the divergent branches"
                    if ep_moe_inbody else
@@ -185,12 +213,30 @@ class PipelineEngine(DeepSpeedEngine):
         self.schedule_gated = (bool(gated_cfg) if gated_cfg is not None
                                else not gating_blocked)
         self._tp_manual = (self.schedule_gated and tp_world)
+        self._sp_manual = (self.schedule_gated and sp_world)
+        # Inside the gated executor's divergent branches only psum-shaped
+        # collectives are safe (groups that skip a branch never
+        # rendezvous); ring's ppermutes and Ulysses' all_to_alls wedge
+        # when pipe rows diverge (measured round 5) — so the gated body
+        # always runs the psum-allgather-KV variant.  The configured
+        # ring/ulysses mode still governs non-pipeline SP
+        # (parallel/sequence.py sequence_parallel_attention).
+        self._sp_mode = "allgather" if self._sp_manual else \
+            cfg.sequence_parallel_config.mode
+        if (self._sp_manual and
+                cfg.sequence_parallel_config.mode != "allgather"):
+            log_dist(
+                "PipelineEngine: sequence-parallel mode "
+                f"'{cfg.sequence_parallel_config.mode}' -> 'allgather' "
+                "inside the gated executor (ppermute/all_to_all cannot "
+                "live in divergent per-stage branches)", ranks=[0])
         self._tp_aux_manual = False  # set by the gated-TP program build
         if gating_blocked and gated_cfg is None:
             log_dist(
                 "PipelineEngine: masked 1F1B executor (gated executor "
                 "does not compose with "
-                + ("seq axes" if seq_inbody else
+                + ("this body/config under SP: " + str(sp_manual_why)
+                   if sp_world and sp_manual_why is not None else
                    "expert all-to-alls inside an MoE body"
                    if ep_moe_inbody else
                    "this body/config under TP: " + str(tp_manual_why))
@@ -275,7 +321,10 @@ class PipelineEngine(DeepSpeedEngine):
                 x, NamedSharding(mesh, PartitionSpec(*spec)))
 
         tp_manual = getattr(self, "_tp_manual", False)
+        sp_manual = getattr(self, "_sp_manual", False)
+        sp_mode = getattr(self, "_sp_mode", "ring")
         has_aux = hasattr(body_layer, "apply_with_aux")
+        from ...parallel.mesh import MODEL_AXIS, SEQ_AXIS
 
         def stage_apply(stage_params, x, mb, stage_idx, rng_base):
             # dropout seeds keyed by (microbatch, global layer index) so the
@@ -289,10 +338,19 @@ class PipelineEngine(DeepSpeedEngine):
                 lp, j = lp_j
                 r = jax.random.fold_in(
                     rng_base, mb * n_layers + lo + stage_idx * k + j)
-                if tp_manual:
-                    # explicit-collective Megatron split (params arrive in
-                    # the head-major tp_manual_views layout)
-                    y = body_layer.apply_manual_tp(lp, x, rng=r)
+                if tp_manual or sp_manual:
+                    # explicit-collective manual modes: Megatron split over
+                    # the model axis (params in the head-major
+                    # tp_manual_views layout) and/or ring/Ulysses attention
+                    # over the seq axis on the local chunk
+                    if hasattr(body_layer, "apply_manual"):
+                        y = body_layer.apply_manual(
+                            lp, x, rng=r,
+                            tp_axis=MODEL_AXIS if tp_manual else None,
+                            seq_axis=SEQ_AXIS if sp_manual else None,
+                            sp_mode=sp_mode)
+                    else:
+                        y = body_layer.apply_manual_tp(lp, x, rng=r)
                     a = jnp.float32(0.0)
                 elif has_aux:
                     y, a = body_layer.apply_with_aux(lp, x, rng=r)
@@ -316,56 +374,74 @@ class PipelineEngine(DeepSpeedEngine):
                 rng=jax.random.fold_in(rng_post, mb))
             return loss_fn(o, y_mb)
 
-        if self.schedule_gated and tp_manual:
-            from ...parallel.mesh import MODEL_AXIS
+        if self.schedule_gated and (tp_manual or sp_manual):
             body = body_layer
-            # vocab-parallel aux chains (module opt-in): the embedding
-            # lookup and the head+CE run vocab-sharded inside the manual
-            # region instead of replicated per model peer — the Megatron
-            # VocabParallelEmbedding / parallel-CE role
-            # (models/gpt2_pipe.py _attach_vocab_parallel_aux)
-            aux_sup = getattr(module, "tp_manual_aux_supports", None)
-            aux_manual = (aux_sup is not None and
-                          aux_sup(ctx.model_parallel_world_size))
-            self._tp_aux_manual = aux_manual
-            pre_region = post_region = aux_spec_trees = None
-            if aux_manual:
-                mp_pre = module.tp_manual_pre_apply
-                mp_post = module.tp_manual_post_loss
-
+            gated_kw = {}
+            if tp_manual:
+                gated_kw["model_axis"] = MODEL_AXIS
+                gated_kw["block_specs"] = body.tp_manual_view_specs()
+            if sp_manual:
+                gated_kw["seq_axis"] = SEQ_AXIS
+            def make_regions(mp_pre, mp_post, axis):
                 def pre_region(pre, tied, x_mb, mb, rng_pre):
                     return mp_pre(pre, tied, x_mb,
-                                  jax.random.fold_in(rng_pre, mb),
-                                  MODEL_AXIS)
+                                  jax.random.fold_in(rng_pre, mb), axis)
 
                 def post_region(post, tied, h, y_mb, mb, rng_post):
                     return mp_post(post, tied, h, y_mb,
-                                   jax.random.fold_in(rng_post, mb),
-                                   MODEL_AXIS)
+                                   jax.random.fold_in(rng_post, mb), axis)
 
-                aux_spec_trees = module.tp_manual_aux_specs(
-                    pipeline_params["pre"], pipeline_params["post"],
-                    pipeline_params["tied"])
+                return pre_region, post_region
+
+            pre_region = post_region = aux_spec_trees = None
+            if sp_manual:
+                # seq-DISTRIBUTED aux chains: each seq peer embeds only
+                # its chunk and computes a partial loss; the executor
+                # psums grads+loss over the seq axis.  (The vocab-parallel
+                # TP aux chains assume the full sequence, so under
+                # seq×model the aux runs vocab-replicated per model peer
+                # — correct, and the head work is already 1/sp.)
+                pre_region, post_region = make_regions(
+                    module.sp_manual_pre_apply, module.sp_manual_post_loss,
+                    SEQ_AXIS)
+            elif tp_manual:
+                # vocab-parallel aux chains (module opt-in): the embedding
+                # lookup and the head+CE run vocab-sharded inside the
+                # manual region instead of replicated per model peer — the
+                # Megatron VocabParallelEmbedding / parallel-CE role
+                # (models/gpt2_pipe.py _attach_vocab_parallel_aux)
+                aux_sup = getattr(module, "tp_manual_aux_supports", None)
+                aux_manual = (aux_sup is not None and
+                              aux_sup(ctx.model_parallel_world_size))
+                self._tp_aux_manual = aux_manual
+                if aux_manual:
+                    pre_region, post_region = make_regions(
+                        module.tp_manual_pre_apply,
+                        module.tp_manual_post_loss, MODEL_AXIS)
+                    aux_spec_trees = module.tp_manual_aux_specs(
+                        pipeline_params["pre"], pipeline_params["post"],
+                        pipeline_params["tied"])
             inner = make_gated_1f1b_grad_fn(
                 mesh=mesh, stage_apply=stage_apply, pre_apply=pre_apply,
                 post_loss=post_loss, micro_batches=M, num_stages=S,
-                model_axis=MODEL_AXIS,
-                block_specs=body.tp_manual_view_specs(),
                 pre_apply_region=pre_region, post_loss_region=post_region,
-                aux_specs=aux_spec_trees)
+                aux_specs=aux_spec_trees, **gated_kw)
 
-            def grad_fn(params, loss_scale, rng, xm, ym):
-                # storage keeps the blocked [q|k|v] qkv layout (checkpoint
-                # and GSPMD-path parity); the head-major view is a free
-                # in-graph rearrange whose transpose AD applies to the
-                # grads — the resharding it implies happens once at the
-                # shard_map boundary
-                p2 = dict(params)
-                p2["blocks"] = body.tp_manual_views(params["blocks"])
-                loss, grads = inner(p2, loss_scale, rng, xm, ym)
-                g2 = dict(grads)
-                g2["blocks"] = body.tp_manual_unview(grads["blocks"])
-                return loss, g2
+            if tp_manual:
+                def grad_fn(params, loss_scale, rng, xm, ym):
+                    # storage keeps the blocked [q|k|v] qkv layout
+                    # (checkpoint and GSPMD-path parity); the head-major
+                    # view is a free in-graph rearrange whose transpose AD
+                    # applies to the grads — the resharding it implies
+                    # happens once at the shard_map boundary
+                    p2 = dict(params)
+                    p2["blocks"] = body.tp_manual_views(params["blocks"])
+                    loss, grads = inner(p2, loss_scale, rng, xm, ym)
+                    g2 = dict(grads)
+                    g2["blocks"] = body.tp_manual_unview(grads["blocks"])
+                    return loss, g2
+            else:
+                grad_fn = inner
         elif self.schedule_gated:
             grad_fn = make_gated_1f1b_grad_fn(
                 mesh=mesh, stage_apply=stage_apply, pre_apply=pre_apply,

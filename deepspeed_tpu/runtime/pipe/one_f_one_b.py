@@ -260,7 +260,8 @@ def make_gated_1f1b_grad_fn(*, mesh, stage_apply: Callable,
                             block_specs=None,
                             pre_apply_region: Callable = None,
                             post_loss_region: Callable = None,
-                            aux_specs=None) -> Callable:
+                            aux_specs=None,
+                            seq_axis: str = None) -> Callable:
     """The GATED 1F1B executor (VERDICT r3 #4): executed ≈ useful FLOPs.
 
     The branch-free executor above runs a full forward AND backward lane
@@ -327,6 +328,19 @@ def make_gated_1f1b_grad_fn(*, mesh, stage_apply: Callable,
     expert axis > 1 is routed to the MASKED executor by the engine —
     GSPMD would place the expert all-to-alls inside these divergent
     branches; see pipe/engine.py ep_moe_inbody.)
+
+    SEQUENCE PARALLELISM (round 5): `seq_axis` makes that mesh axis
+    manual too — seq peers share their pipe row's predicate (predicates
+    depend only on (tick, stage)), so the stage body's ring ppermutes /
+    Ulysses all-to-alls always rendezvous within one branch, the same
+    argument as manual TP.  Protocol: the boundary activation's dim 1
+    is the sequence dim, sharded 1/sp per peer (transport buffers and
+    ppermute bytes shrink by sp); xm/ym stay REPLICATED over seq
+    (token ids are tiny) and the seq-distributed aux chains
+    (`pre_apply_region`/`post_loss_region`, e.g. gpt2_pipe
+    _attach_seq_parallel_aux) slice their chunk by axis index; every
+    param grad and the loss are per-peer PARTIAL sums, finalized with
+    one psum over seq_axis at region end.
     """
     tables = simulate_global_clock(micro_batches, num_stages)
     S, M, C = tables.num_stages, tables.micro_batches, tables.max_slots
@@ -351,6 +365,16 @@ def make_gated_1f1b_grad_fn(*, mesh, stage_apply: Callable,
         h_shape = jax.eval_shape(
             pre_apply, pre, tied, jax.tree.map(lambda a: a[0], xm),
             jnp.int32(0), rng_pre)
+        if seq_axis is not None:
+            # per-peer boundary activation: the sequence dim (axis 1 by
+            # protocol) is sharded 1/sp; the replicated pre_apply above
+            # only provides the GLOBAL shape
+            sp = mesh.shape[seq_axis]
+            shp = list(h_shape.shape)
+            assert shp[1] % sp == 0, (
+                f"sequence dim {shp[1]} must divide the seq axis ({sp})")
+            shp[1] //= sp
+            h_shape = jax.ShapeDtypeStruct(tuple(shp), h_shape.dtype)
 
         def pick_mb(tree, mb):
             return jax.tree.map(
@@ -508,13 +532,26 @@ def make_gated_1f1b_grad_fn(*, mesh, stage_apply: Callable,
             g_post = jax.tree.map(lambda g: lax.psum(g, PIPE_AXIS), g_post)
             g_tied = jax.tree.map(lambda g: lax.psum(g, PIPE_AXIS), g_tied)
             loss_sum = lax.psum(loss_sum, PIPE_AXIS)
+            if seq_axis is not None:
+                # every grad and the loss are per-seq-peer PARTIAL sums
+                # (each peer saw only its sequence chunk) — finalize
+                g_pre = jax.tree.map(
+                    lambda g: lax.psum(g, seq_axis), g_pre)
+                g_post = jax.tree.map(
+                    lambda g: lax.psum(g, seq_axis), g_post)
+                g_tied = jax.tree.map(
+                    lambda g: lax.psum(g, seq_axis), g_tied)
+                g_blocks = jax.tree.map(
+                    lambda g: lax.psum(g, seq_axis), g_blocks)
+                loss_sum = lax.psum(loss_sum, seq_axis)
             g_blocks = jax.tree.map(lambda g: g[None], g_blocks)
             return loss_sum, {"pre": g_pre, "blocks": g_blocks,
                               "post": g_post, "tied": g_tied}
 
         axis_names = frozenset(
-            {PIPE_AXIS} | ({model_axis} if model_axis is not None
-                           else set()))
+            {PIPE_AXIS}
+            | ({model_axis} if model_axis is not None else set())
+            | ({seq_axis} if seq_axis is not None else set()))
         if block_specs is None:
             blocks_spec = P(PIPE_AXIS)
         else:

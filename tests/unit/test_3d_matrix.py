@@ -30,9 +30,10 @@ def _cfg():
                       hidden_dropout=0.0)
 
 
-def _train_pipe(pipe, tp, zero_stage, steps=3, expert=1):
+def _train_pipe(pipe, tp, zero_stage, steps=3, expert=1, seq=1):
     ds.reset_mesh_context()
-    mesh = ds.initialize_mesh(pipe=pipe, model=tp, expert=expert, data=-1)
+    mesh = ds.initialize_mesh(pipe=pipe, model=tp, expert=expert, seq=seq,
+                              data=-1)
     dp = mesh.data_parallel_world_size
     module = gpt2_pipeline_module(_cfg(), num_stages=pipe)
     conf = {
@@ -41,6 +42,7 @@ def _train_pipe(pipe, tp, zero_stage, steps=3, expert=1):
         "gradient_accumulation_steps": MICRO_BATCHES,
         "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
         "zero_optimization": {"stage": zero_stage},
+        "sequence_parallel": {"mode": "ring"},
         "steps_per_print": 10 ** 9,
     }
     engine = PipelineEngine(
@@ -90,6 +92,27 @@ def test_composition_matches_baseline(pipe, tp, zero):
         # blocks are stacked [num_stages, layers_per_stage, ...] — flatten
         # the stage/layer dims (stage-major == global layer order) so cells
         # with different stage counts compare directly
+        if a.shape != b.shape:
+            a = a.reshape((-1,) + a.shape[2:])
+            b = b.reshape((-1,) + b.shape[2:])
+        np.testing.assert_allclose(a, b, rtol=5e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("pipe,tp,seq,zero", [
+    (2, 1, 2, 0),   # pipe × seq (gated, allgather-KV attention)
+    (2, 2, 2, 1),   # pipe × seq × tp × zero-1 — 4-axis composition
+    (1, 1, 2, 0),   # seq-only through the same gated executor
+])
+def test_pipe_seq_matches_baseline(pipe, tp, seq, zero):
+    """Gated sequence parallelism (round 5): the seq axis joins the
+    manual region — seq peers share their pipe row's predicate; the body
+    runs psum-allgather-KV attention (the divergent-branch-safe variant)
+    and the seq-distributed aux chains slice their own chunk.  Must be
+    trajectory-exact vs the pipe=1/seq=1 baseline."""
+    base_losses, base_params = _baseline()
+    losses, params = _train_pipe(pipe=pipe, tp=tp, zero_stage=zero, seq=seq)
+    np.testing.assert_allclose(losses, base_losses, rtol=2e-5)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(base_params)):
         if a.shape != b.shape:
             a = a.reshape((-1,) + a.shape[2:])
             b = b.reshape((-1,) + b.shape[2:])
