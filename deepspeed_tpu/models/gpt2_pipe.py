@@ -62,7 +62,7 @@ class GPT2BlockPipe(PipeLayer):
         return self.layer(params, x, rng=rng, deterministic=rng is None)
 
     def param_partition_specs(self):
-        return type(self.layer).param_partition_specs()
+        return type(self.layer).param_partition_specs(self.layer.config.ffn)
 
     # -- explicit-collective TP (the gated 1F1B executor's manual mode;
     #    ops/transformer.py tp_axis= / tp_manual_* docstrings) ---------- #
@@ -101,7 +101,9 @@ class GPT2BlockPipe(PipeLayer):
         return type(self.layer).tp_manual_unview(params)
 
     def tp_manual_view_specs(self):
-        return type(self.layer).tp_manual_view_specs()
+        # ffn derived from the layer's own config (ADVICE r4: a future
+        # non-dense body reusing this path must not get a dense spec tree)
+        return type(self.layer).tp_manual_view_specs(self.layer.config.ffn)
 
 
 class GPT2HeadPipe(PipeLayer):

@@ -86,24 +86,64 @@ class GPTMoEGroupPipe(PipeLayer):
 
     def apply_with_aux(self, params, x, rng=None):
         """x: [B, S, H] -> (y, aux) with aux = moe_aux_loss_coef * l_aux
-        (pre-scaled: the executors sum aux terms directly into the loss)."""
+        (pre-scaled: the executors sum aux terms directly into the loss).
+        One body shared with the manual modes (apply_manual with no axes
+        is the replicated computation)."""
+        return self.apply_manual(params, x, rng=rng)
+
+    def apply(self, params, x, rng=None):
+        y, _ = self.apply_with_aux(params, x, rng=rng)
+        return y
+
+    # -- manual tensor parallelism (gated 1F1B executor, round 5) ------- #
+    # The expert FFNs Megatron-split over the model axis with explicit
+    # psums (ExpertMLP.apply_tp); the gate stays replicated so every
+    # model peer routes identically; dense/attention layers run the
+    # layer's tp_axis mode.  Reference slot: the expert FFN position of
+    # moe/sharded_moe.py:312 under Megatron mp.
+    def supports_manual_tp(self, tp_size: int) -> bool:
+        cfg = self.cfg
+        d_ff = self.moe.deepspeed_moe.expert.d_ff
+        return (self.dense_layer.config.sparsity_config is None
+                and cfg.num_heads % tp_size == 0
+                and cfg.intermediate_size % tp_size == 0
+                and d_ff % tp_size == 0)
+
+    def apply_manual(self, params, x, rng=None, tp_axis=None, seq_axis=None,
+                     sp_mode="auto"):
+        """Manual-mode apply; returns (y, aux) — the executors detect the
+        aux channel via apply_with_aux and unpack accordingly."""
+        if seq_axis is not None:
+            raise NotImplementedError(
+                "MoE pipeline body does not compose with manual sequence "
+                "parallelism yet (token routing would need chunk-global "
+                "capacity)")
         cfg = self.cfg
         deterministic = rng is None
         b, s, hid = x.shape
         for j, dp in enumerate(params["dense"]):
             r = None if deterministic else jax.random.fold_in(rng, j)
-            x = self.dense_layer(dp, x, rng=r, deterministic=deterministic)
+            x = self.dense_layer(dp, x, rng=r, deterministic=deterministic,
+                                 tp_axis=tp_axis)
         r_attn = (None if deterministic
                   else jax.random.fold_in(rng, cfg.moe_every + 1))
         x = self.attn_layer(params["attn"], x, rng=r_attn,
-                            deterministic=deterministic)
+                            deterministic=deterministic, tp_axis=tp_axis)
         moe_in = fused_layer_norm(x, params["moe_nw"], params["moe_nb"],
                                   cfg.layer_norm_eps)
+        # NOTE: the "f" operator (identity fwd / psum bwd) sits INSIDE the
+        # MoE layer on the expert-dispatch input only — placing it here
+        # would also route the gate's REPLICATED cotangent through the
+        # psum and overcount it by tp (measured: LN/upstream grads off by
+        # the gate path's weight).  See MOELayer._apply_scatter tp_axis.
+        # gate noise / dropout keys SHARED across model peers: routing and
+        # the post-psum values are replicated over the model axis
         r_moe = (None if deterministic
                  else jax.random.fold_in(rng, cfg.moe_every + 2))
         out, l_aux, _ = self.moe.apply(params["moe"],
                                        moe_in.reshape(b * s, hid),
-                                       rng=r_moe, train=not deterministic)
+                                       rng=r_moe, train=not deterministic,
+                                       tp_axis=tp_axis)
         out = out.reshape(b, s, hid).astype(x.dtype)
         r_drop = (jax.random.fold_in(rng, cfg.moe_every + 3)
                   if not deterministic else None)
@@ -112,9 +152,47 @@ class GPTMoEGroupPipe(PipeLayer):
         aux = cfg.moe_aux_loss_coef * l_aux.astype(jnp.float32)
         return x + out, aux
 
-    def apply(self, params, x, rng=None):
-        y, _ = self.apply_with_aux(params, x, rng=rng)
-        return y
+    def apply_manual_tp(self, params, x, rng=None, tp_axis=None):
+        from ..parallel.mesh import MODEL_AXIS
+        return self.apply_manual(params, x, rng=rng,
+                                 tp_axis=tp_axis or MODEL_AXIS)
+
+    def tp_manual_views(self, params):
+        heads = self.cfg.num_heads
+        p = dict(params)
+        p["dense"] = tuple(
+            DeepSpeedTransformerLayer.tp_manual_views(dp, heads)
+            for dp in params["dense"])
+        p["attn"] = DeepSpeedTransformerLayer.tp_manual_views(
+            params["attn"], heads)
+        return p
+
+    def tp_manual_unview(self, params):
+        p = dict(params)
+        p["dense"] = tuple(DeepSpeedTransformerLayer.tp_manual_unview(dp)
+                           for dp in params["dense"])
+        p["attn"] = DeepSpeedTransformerLayer.tp_manual_unview(
+            params["attn"])
+        return p
+
+    def tp_manual_view_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        from ..moe.experts import ExpertMLP
+        from ..parallel.mesh import MODEL_AXIS
+        cfg = self.cfg
+        expert_specs = jax.tree.map(
+            lambda sp: P(None, *sp),  # leading expert-stack dim
+            ExpertMLP.tp_partition_specs(MODEL_AXIS),
+            is_leaf=lambda v: isinstance(v, P))
+        return {
+            "dense": tuple(
+                DeepSpeedTransformerLayer.tp_manual_view_specs("dense")
+                for _ in range(cfg.moe_every - 1)),
+            "attn": DeepSpeedTransformerLayer.tp_manual_view_specs("none"),
+            "moe_nw": P(), "moe_nb": P(),
+            "moe": {"gate": {"wg": P()}, "experts": expert_specs},
+        }
 
 
 def gpt_moe_pipeline_module(cfg: GPTMoEConfig,

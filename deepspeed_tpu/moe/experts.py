@@ -40,3 +40,25 @@ class ExpertMLP:
         h = jax.nn.gelu(x @ params["wi"].astype(x.dtype) +
                         params["bi"].astype(x.dtype))
         return h @ params["wo"].astype(x.dtype) + params["bo"].astype(x.dtype)
+
+    def apply_tp(self, params, x, tp_axis: str):
+        """Megatron-split expert for MANUAL tensor parallelism: params are
+        LOCAL shards (wi/bi column-split, wo row-split on the d_ff dim —
+        tp_partition_specs) and the output partials are psum'd explicitly
+        (tp_psum is branch-safe inside the gated executor's lax.cond,
+        unlike GSPMD-placed collectives).  The replicated output bias is
+        added AFTER the psum, once."""
+        from ..ops.tp_collectives import tp_psum
+
+        h = jax.nn.gelu(x @ params["wi"].astype(x.dtype) +
+                        params["bi"].astype(x.dtype))
+        out = tp_psum(h @ params["wo"].astype(x.dtype), tp_axis)
+        return out + params["bo"].astype(x.dtype)
+
+    @staticmethod
+    def tp_partition_specs(model_axis: str):
+        """Per-leaf specs over the model axis for the manual-TP shards
+        (leading dims — expert stack — handled by the caller)."""
+        from jax.sharding import PartitionSpec as P
+        return {"wi": P(None, model_axis), "bi": P(model_axis),
+                "wo": P(model_axis, None), "bo": P()}

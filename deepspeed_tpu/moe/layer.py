@@ -79,8 +79,11 @@ class MoE:
         self._check_mesh()
         return self.deepspeed_moe.param_partition_specs(params)
 
-    def apply(self, params, x, rng=None, train=True):
+    def apply(self, params, x, rng=None, train=True, tp_axis=None):
         """Returns (output, l_aux, exp_counts) like the reference forward
-        (moe/layer.py:42)."""
+        (moe/layer.py:42).  tp_axis: manual tensor parallelism — expert
+        params are local Megatron shards and expert outputs are psum'd
+        explicitly (ExpertMLP.apply_tp); gating stays replicated."""
         self._check_mesh()
-        return self.deepspeed_moe.apply(params, x, rng=rng, train=train)
+        return self.deepspeed_moe.apply(params, x, rng=rng, train=train,
+                                        tp_axis=tp_axis)

@@ -293,7 +293,7 @@ class MOELayer:
                                     params["experts"]),
         }
 
-    def apply(self, params, x, rng=None, train=True):
+    def apply(self, params, x, rng=None, train=True, tp_axis=None):
         """x: [..., d] → (y [..., d], l_aux, exp_counts).
 
         Two dispatch implementations (both lower the token→slot resharding
@@ -304,12 +304,31 @@ class MOELayer:
           working set, the TPU-idiomatic form at scale;
         - "einsum": the GShard-paper [S, E, C] mask einsums — O(S·E·C)
           memory, kept as the parity reference.
+
+        tp_axis: MANUAL tensor parallelism over the expert FFNs — the
+        gate runs replicated (wg replicated → identical logits → every
+        model peer routes identically), dispatch/combine stay local, and
+        each expert computes with local Megatron shards + explicit psum
+        (ExpertMLP.apply_tp).  This is how MoE composes with the gated
+        pipeline executor's manual model axis (reference: the expert FFN
+        position of sharded_moe.py:312 under Megatron mp).
         """
         if self.dispatch_impl == "scatter":
-            return self._apply_scatter(params, x, rng=rng, train=train)
-        return self._apply_einsum(params, x, rng=rng, train=train)
+            return self._apply_scatter(params, x, rng=rng, train=train,
+                                       tp_axis=tp_axis)
+        return self._apply_einsum(params, x, rng=rng, train=train,
+                                  tp_axis=tp_axis)
 
-    def _apply_scatter(self, params, x, rng=None, train=True):
+    def _expert_apply(self, params, dispatched, tp_axis):
+        if tp_axis is not None:
+            return jax.vmap(
+                lambda p, slot: self.expert.apply_tp(p, slot, tp_axis))(
+                    params, dispatched)
+        return jax.vmap(
+            lambda p, slot: self.expert.apply(p, slot, rng=None))(
+                params, dispatched)
+
+    def _apply_scatter(self, params, x, rng=None, train=True, tp_axis=None):
         orig_shape = x.shape
         d_model = x.shape[-1]
         tokens = x.reshape(-1, d_model)
@@ -325,20 +344,30 @@ class MOELayer:
         flat_slot = jnp.where(valid, experts * capacity + slots,
                               e_total * capacity)
 
+        # manual TP: the "f" operator on the EXPERT-dispatch input only
+        # (identity fwd / psum bwd) — each peer's expert shard produces a
+        # PARTIAL token cotangent that the psum restores to full for the
+        # replicated upstream.  The gate above reads the raw tokens: its
+        # computation is replicated per peer and its cotangent is already
+        # full — routing it through the psum would overcount it by tp.
+        tokens_e = tokens
+        if tp_axis is not None:
+            from ..ops.tp_collectives import tp_fcast
+            tokens_e = tp_fcast(tokens, tp_axis)
+
         # dispatch (all-to-all #1): scatter-add — valid (expert, slot)
         # pairs are unique by construction, so add == set for them
         flat = jnp.zeros((e_total * capacity + 1, d_model), x.dtype)
         contrib = jnp.where(valid[..., None],
-                            jnp.broadcast_to(tokens[:, None, :],
+                            jnp.broadcast_to(tokens_e[:, None, :],
                                              (s, k, d_model)), 0)
         flat = flat.at[flat_slot.reshape(-1)].add(
             contrib.reshape(-1, d_model).astype(x.dtype))
         dispatched = _constrain_expert(
             flat[:e_total * capacity].reshape(e_total, capacity, d_model))
 
-        expert_out = jax.vmap(
-            lambda p, slot: self.expert.apply(p, slot, rng=None))(
-                params["experts"], dispatched)
+        expert_out = self._expert_apply(params["experts"], dispatched,
+                                        tp_axis)
         expert_out = _constrain_expert(expert_out)
 
         # combine (all-to-all #2): gather each token's k slot outputs and
@@ -351,7 +380,7 @@ class MOELayer:
             axis=1)
         return out.astype(x.dtype).reshape(orig_shape), l_aux, exp_counts
 
-    def _apply_einsum(self, params, x, rng=None, train=True):
+    def _apply_einsum(self, params, x, rng=None, train=True, tp_axis=None):
         orig_shape = x.shape
         d_model = x.shape[-1]
         tokens = x.reshape(-1, d_model)
@@ -359,14 +388,18 @@ class MOELayer:
         l_aux, combine, dispatch, exp_counts = self.gate.apply(
             params["gate"], tokens, rng=rng, train=train)
 
+        tokens_e = tokens
+        if tp_axis is not None:  # see _apply_scatter: expert input only
+            from ..ops.tp_collectives import tp_fcast
+            tokens_e = tp_fcast(tokens, tp_axis)
+
         # dispatch: [S, E, C] × [S, d] → [E, C, d]   (all-to-all #1)
         dispatched = jnp.einsum("sec,sd->ecd",
-                                dispatch.astype(x.dtype), tokens)
+                                dispatch.astype(x.dtype), tokens_e)
         dispatched = _constrain_expert(dispatched)
 
-        expert_out = jax.vmap(
-            lambda p, slot: self.expert.apply(p, slot, rng=None))(
-                params["experts"], dispatched)
+        expert_out = self._expert_apply(params["experts"], dispatched,
+                                        tp_axis)
         expert_out = _constrain_expert(expert_out)
 
         # combine: [S, E, C] × [E, C, d] → [S, d]    (all-to-all #2)

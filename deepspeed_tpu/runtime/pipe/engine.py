@@ -341,17 +341,21 @@ class PipelineEngine(DeepSpeedEngine):
                 if tp_manual or sp_manual:
                     # explicit-collective manual modes: Megatron split over
                     # the model axis (params in the head-major
-                    # tp_manual_views layout) and/or ring/Ulysses attention
-                    # over the seq axis on the local chunk
+                    # tp_manual_views layout) and/or sequence-parallel
+                    # attention over the seq axis on the local chunk.
+                    # Aux-channel bodies (MoE) return (y, aux) here too.
                     if hasattr(body_layer, "apply_manual"):
-                        y = body_layer.apply_manual(
+                        out = body_layer.apply_manual(
                             lp, x, rng=r,
                             tp_axis=MODEL_AXIS if tp_manual else None,
                             seq_axis=SEQ_AXIS if sp_manual else None,
                             sp_mode=sp_mode)
                     else:
-                        y = body_layer.apply_manual_tp(lp, x, rng=r)
-                    a = jnp.float32(0.0)
+                        out = body_layer.apply_manual_tp(lp, x, rng=r)
+                    if has_aux:
+                        y, a = out
+                    else:
+                        y, a = out, jnp.float32(0.0)
                 elif has_aux:
                     y, a = body_layer.apply_with_aux(lp, x, rng=r)
                 else:

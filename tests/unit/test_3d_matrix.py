@@ -139,12 +139,12 @@ def test_plain_body_pipe_expert_matches_baseline():
 # the composition the reference gets from running MoE under any engine
 # (deepspeed/runtime/engine.py:1714-1727 per-group expert-grad reduction).
 # ---------------------------------------------------------------------- #
-def _train_moe_pipe(pipe, expert, zero_stage=0, steps=3):
+def _train_moe_pipe(pipe, expert, zero_stage=0, steps=3, tp=1):
     from deepspeed_tpu.models import GPTMoEConfig
     from deepspeed_tpu.models.gpt_moe_pipe import gpt_moe_pipeline_module
 
     ds.reset_mesh_context()
-    mesh = ds.initialize_mesh(pipe=pipe, expert=expert, data=-1)
+    mesh = ds.initialize_mesh(pipe=pipe, expert=expert, model=tp, data=-1)
     dp = mesh.data_parallel_world_size
     cfg = GPTMoEConfig(
         vocab_size=64, n_positions=SEQ, hidden_size=32, num_layers=4,
@@ -187,18 +187,20 @@ def _moe_pipe_baseline():
     return MOE_PIPE_BASELINE["v"]
 
 
-@pytest.mark.parametrize("pipe,expert,zero", [
-    (2, 2, 0),   # pipe × expert (masked executor)
-    (2, 2, 1),   # pipe × expert × zero-1
-    (1, 4, 0),   # expert-only sanity on the same module
-    (2, 1, 0),   # MoE body under the GATED executor (expert=1: the aux
-                 # channel's cond-gated accumulation + loss_scale vjp seed
-                 # at S>1)
+@pytest.mark.parametrize("pipe,expert,zero,tp", [
+    (2, 2, 0, 1),   # pipe × expert (masked executor)
+    (2, 2, 1, 1),   # pipe × expert × zero-1
+    (1, 4, 0, 1),   # expert-only sanity on the same module
+    (2, 1, 0, 1),   # MoE body under the GATED executor (expert=1: the aux
+                    # channel's cond-gated accumulation + loss_scale vjp
+                    # seed at S>1)
+    (2, 1, 0, 2),   # gated MoE × manual TP: Megatron-split expert FFNs
+                    # with explicit psums + replicated gate (round 5)
 ])
-def test_pipe_expert_matches_baseline(pipe, expert, zero):
+def test_pipe_expert_matches_baseline(pipe, expert, zero, tp):
     base_losses, base_params = _moe_pipe_baseline()
     losses, params = _train_moe_pipe(pipe=pipe, expert=expert,
-                                     zero_stage=zero)
+                                     zero_stage=zero, tp=tp)
     np.testing.assert_allclose(losses, base_losses, rtol=2e-5)
     for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(base_params)):
         if a.shape != b.shape:
