@@ -207,8 +207,10 @@ class GPT2Model:
 
     def head_logits(self, params, h):
         """Final LN + (tied) LM head, fp32 logits."""
-        h = self._final_hidden(params, h)
-        return (h @ self._head_matrix(params, h.dtype)).astype(jnp.float32)
+        with jax.named_scope("head"):
+            h = self._final_hidden(params, h)
+            return (h @ self._head_matrix(params, h.dtype)).astype(
+                jnp.float32)
 
     def hidden_states(self, params, input_ids, rng=None,
                       deterministic: bool = False, pld_theta=None):
@@ -226,8 +228,9 @@ class GPT2Model:
             rng = jax.random.PRNGKey(0)
         r_embd, r_layers, r_pld = jax.random.split(rng, 3)
 
-        h = self.embed(params, input_ids)
-        h = dropout(h, cfg.embd_dropout, r_embd, deterministic)
+        with jax.named_scope("embed"):
+            h = self.embed(params, input_ids)
+            h = dropout(h, cfg.embd_dropout, r_embd, deterministic)
 
         layer_fn = self.layer
         use_pld = pld_theta is not None and not deterministic
@@ -255,8 +258,9 @@ class GPT2Model:
                 # layer rng; fold in the shard index so dropout masks stay
                 # independent across the batch shards.
                 layer_rng = stream.fold_shard_index(layer_rng)
-            out = layer_fn(layer_params, carry, rng=layer_rng,
-                           deterministic=deterministic)
+            with jax.named_scope("layer"):
+                out = layer_fn(layer_params, carry, rng=layer_rng,
+                               deterministic=deterministic)
             if use_pld:
                 keep = jax.random.bernoulli(pld_key, keep_p)
                 out = jnp.where(keep, out, carry)
@@ -411,19 +415,23 @@ class GPT2Model:
             h = self.hidden_states(params, input_ids, rng,
                                    deterministic=rng is None,
                                    pld_theta=pld_theta)
-            h = self._final_hidden(params, h)
-            h, labels2 = self._shift_for_next_token(h, input_ids, labels)
-            return fused_linear_cross_entropy(
-                h.reshape(-1, cfg.hidden_size),
-                self._head_matrix(params, h.dtype),
-                labels2.reshape(-1).astype(jnp.int32),
-                cfg.fused_loss_chunk)
+            with jax.named_scope("head"):
+                h = self._final_hidden(params, h)
+                h, labels2 = self._shift_for_next_token(h, input_ids,
+                                                        labels)
+                return fused_linear_cross_entropy(
+                    h.reshape(-1, cfg.hidden_size),
+                    self._head_matrix(params, h.dtype),
+                    labels2.reshape(-1).astype(jnp.int32),
+                    cfg.fused_loss_chunk)
         logits = self.logits(params, input_ids, rng,
                              deterministic=rng is None,
                              pld_theta=pld_theta).astype(jnp.float32)
-        logits, labels = self._shift_for_next_token(logits, input_ids, labels)
-        return optax.softmax_cross_entropy_with_integer_labels(
-            logits, labels).mean()
+        with jax.named_scope("head"):
+            logits, labels = self._shift_for_next_token(logits, input_ids,
+                                                        labels)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels).mean()
 
     # engine entry point: model(params, rng, batch...) -> loss
     def __call__(self, params, rng, input_ids, labels=None, pld_theta=None):

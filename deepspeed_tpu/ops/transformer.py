@@ -313,19 +313,20 @@ class DeepSpeedTransformerLayer:
         if tp_axis is not None:
             attn_in = tp_fcast(attn_in, tp_axis)
 
-        if tp_axis is None:
-            qkv = matmul_maybe_int8(attn_in, params["attn_qkvw"]) + \
-                params["attn_qkvb"].astype(attn_in.dtype)
-            q, k, v = jnp.split(qkv, 3, axis=-1)
-        else:
-            # head-major local view: w [H, hl, 3, d], b [hl, 3, d]
-            qkv = jnp.einsum(
-                "bsh,hjcd->bsjcd", attn_in,
-                params["attn_qkvw"].astype(attn_in.dtype)) + \
-                params["attn_qkvb"].astype(attn_in.dtype)
-            q, k, v = (qkv[..., 0, :].reshape(b, s, hw),
-                       qkv[..., 1, :].reshape(b, s, hw),
-                       qkv[..., 2, :].reshape(b, s, hw))
+        with jax.named_scope("attn"):
+            if tp_axis is None:
+                qkv = matmul_maybe_int8(attn_in, params["attn_qkvw"]) + \
+                    params["attn_qkvb"].astype(attn_in.dtype)
+                q, k, v = jnp.split(qkv, 3, axis=-1)
+            else:
+                # head-major local view: w [H, hl, 3, d], b [hl, 3, d]
+                qkv = jnp.einsum(
+                    "bsh,hjcd->bsjcd", attn_in,
+                    params["attn_qkvw"].astype(attn_in.dtype)) + \
+                    params["attn_qkvb"].astype(attn_in.dtype)
+                q, k, v = (qkv[..., 0, :].reshape(b, s, hw),
+                           qkv[..., 1, :].reshape(b, s, hw),
+                           qkv[..., 2, :].reshape(b, s, hw))
 
         # attention dropout placement (attn_dropout_impl):
         #   "kernel" (default) — probability dropout INSIDE the flash
@@ -362,9 +363,11 @@ class DeepSpeedTransformerLayer:
             def to_heads(t):
                 return t.reshape(b, s, heads, d).transpose(0, 2, 1, 3)
 
-            ctx = sp_attention_inner(to_heads(q), to_heads(k), to_heads(v),
-                                     mode=mode, axis_name=seq_axis,
-                                     causal=cfg.causal)
+            with jax.named_scope("attn"):
+                ctx = sp_attention_inner(to_heads(q), to_heads(k),
+                                         to_heads(v), mode=mode,
+                                         axis_name=seq_axis,
+                                         causal=cfg.causal)
             ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, hw)
             # kernel-dropout fallback: output ('ctx') dropout on the chunk
             ctx = dropout(ctx, cfg.attn_dropout_ratio, r_attn, deterministic)
@@ -393,10 +396,11 @@ class DeepSpeedTransformerLayer:
             def to_heads(t):
                 return t.reshape(b, s, heads, d).transpose(0, 2, 1, 3)
 
-            ctx = self._sparse_attn(to_heads(q), to_heads(k), to_heads(v),
-                                    causal=cfg.causal,
-                                    key_padding_mask=sparse_kp,
-                                    attn_mask=sparse_am)
+            with jax.named_scope("attn"):
+                ctx = self._sparse_attn(to_heads(q), to_heads(k),
+                                        to_heads(v), causal=cfg.causal,
+                                        key_padding_mask=sparse_kp,
+                                        attn_mask=sparse_am)
             ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, hw)
             ctx = dropout(ctx, cfg.attn_dropout_ratio, r_attn, deterministic)
         elif cfg.attn_layout == "bshd":
@@ -407,12 +411,13 @@ class DeepSpeedTransformerLayer:
             def split_heads(t):
                 return t.reshape(b, s, heads, d)
 
-            ctx = flash_attention_bsh(
-                split_heads(q), split_heads(k), split_heads(v),
-                causal=cfg.causal, bias=attn_mask,
-                block_q=cfg.block_q, block_k=cfg.block_k,
-                impl=cfg.attn_impl, dropout_rate=attn_rate,
-                dropout_seed=attn_seed())
+            with jax.named_scope("attn"):
+                ctx = flash_attention_bsh(
+                    split_heads(q), split_heads(k), split_heads(v),
+                    causal=cfg.causal, bias=attn_mask,
+                    block_q=cfg.block_q, block_k=cfg.block_k,
+                    impl=cfg.attn_impl, dropout_rate=attn_rate,
+                    dropout_seed=attn_seed())
             ctx = ctx.reshape(b, s, hw)
             if not kernel_drop:
                 ctx = dropout(ctx, cfg.attn_dropout_ratio, r_attn,
@@ -421,24 +426,31 @@ class DeepSpeedTransformerLayer:
             def to_heads(t):
                 return t.reshape(b, s, heads, d).transpose(0, 2, 1, 3)
 
-            ctx = flash_attention(
-                to_heads(q), to_heads(k), to_heads(v), causal=cfg.causal,
-                bias=attn_mask, block_q=cfg.block_q, block_k=cfg.block_k,
-                impl=cfg.attn_impl, dropout_rate=attn_rate,
-                dropout_seed=attn_seed())
+            with jax.named_scope("attn"):
+                ctx = flash_attention(
+                    to_heads(q), to_heads(k), to_heads(v),
+                    causal=cfg.causal, bias=attn_mask,
+                    block_q=cfg.block_q, block_k=cfg.block_k,
+                    impl=cfg.attn_impl, dropout_rate=attn_rate,
+                    dropout_seed=attn_seed())
             ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, hw)
             if not kernel_drop:
                 ctx = dropout(ctx, cfg.attn_dropout_ratio, r_attn,
                               deterministic)
 
-        attn_out = matmul_maybe_int8(ctx, params["attn_ow"])
-        if tp_axis is not None:
-            # row-parallel output projection: merge the per-peer partials
-            # BEFORE bias/dropout/residual (replicated from here on)
-            attn_out = tp_psum(attn_out, tp_axis)
-        attn_out = bias_dropout_residual(
-            attn_out, params["attn_ob"].astype(attn_out.dtype), residual,
-            cfg.hidden_dropout_ratio, r_hid1, deterministic)
+        # NOTE: "attn" opens as several blocks (the dispatch branches
+        # prevent one contiguous region); the scope KEY is identical so
+        # module_tree merges them — only free reshapes/transposes between
+        # blocks fall to the parent "layer" scope.
+        with jax.named_scope("attn"):
+            attn_out = matmul_maybe_int8(ctx, params["attn_ow"])
+            if tp_axis is not None:
+                # row-parallel output projection: merge the per-peer
+                # partials BEFORE bias/dropout/residual (replicated on)
+                attn_out = tp_psum(attn_out, tp_axis)
+            attn_out = bias_dropout_residual(
+                attn_out, params["attn_ob"].astype(attn_out.dtype),
+                residual, cfg.hidden_dropout_ratio, r_hid1, deterministic)
 
         if cfg.ffn == "none":
             # attention sublayer only — the caller owns the FFN position
@@ -459,15 +471,16 @@ class DeepSpeedTransformerLayer:
         if tp_axis is not None:
             mlp_in = tp_fcast(mlp_in, tp_axis)
 
-        inter = bias_gelu(matmul_maybe_int8(mlp_in, params["inter_w"]),
-                          params["inter_b"].astype(mlp_in.dtype),
-                          approximate=cfg.gelu_approximate)
-        out = matmul_maybe_int8(inter, params["output_w"])
-        if tp_axis is not None:
-            out = tp_psum(out, tp_axis)
-        out = bias_dropout_residual(
-            out, params["output_b"].astype(out.dtype), mlp_residual,
-            cfg.hidden_dropout_ratio, r_hid2, deterministic)
+        with jax.named_scope("mlp"):
+            inter = bias_gelu(matmul_maybe_int8(mlp_in, params["inter_w"]),
+                              params["inter_b"].astype(mlp_in.dtype),
+                              approximate=cfg.gelu_approximate)
+            out = matmul_maybe_int8(inter, params["output_w"])
+            if tp_axis is not None:
+                out = tp_psum(out, tp_axis)
+            out = bias_dropout_residual(
+                out, params["output_b"].astype(out.dtype), mlp_residual,
+                cfg.hidden_dropout_ratio, r_hid2, deterministic)
 
         if not cfg.pre_layer_norm:
             out = fused_layer_norm(out, params["norm_w"], params["norm_b"],

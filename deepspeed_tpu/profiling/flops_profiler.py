@@ -48,57 +48,93 @@ def _conv_flops(eqn) -> int:
     return out_elems * per_out
 
 
-def count_jaxpr_flops(jaxpr, breakdown: Optional[Dict[str, int]] = None
-                      ) -> int:
+def _eqn_scope(eqn, prefix: str) -> str:
+    """name-scope path of an equation: the enclosing prefix (outer
+    scan/pjit scopes) joined with the eqn's own traced name stack."""
+    stack = str(eqn.source_info.name_stack)
+    if prefix and stack:
+        return f"{prefix}/{stack}"
+    return prefix or stack
+
+
+def count_jaxpr_flops(jaxpr, breakdown: Optional[Dict[str, int]] = None,
+                      scopes: Optional[Dict[str, int]] = None,
+                      _prefix: str = "", _mult: int = 1) -> int:
     """Walk a (closed) jaxpr counting matmul/conv MAC-flops plus elementwise
     ops; recurses through pjit/scan/cond/while/remat sub-jaxprs (scan
-    multiplies by trip count)."""
+    multiplies by trip count).
+
+    `scopes` (optional) accumulates flops per `jax.named_scope` path —
+    the per-module attribution the reference profiler gets from
+    nn.Module hooks (profiler.py:11); models tag embed/attn/mlp/head
+    regions (models/gpt2.py, ops/transformer.py) and the tree printer
+    renders the hierarchy.  Sub-jaxpr equations carry name stacks
+    relative to their enclosing scan/pjit, so recursion threads the
+    parent scope as a prefix and scan trip counts as a multiplier."""
     if hasattr(jaxpr, "jaxpr"):
         jaxpr = jaxpr.jaxpr
     total = 0
     breakdown = breakdown if breakdown is not None else {}
+
+    def credit(key: str, eqn, f: int) -> None:
+        breakdown[key] = breakdown.get(key, 0) + f * _mult
+        if scopes is not None:
+            sc = _eqn_scope(eqn, _prefix)
+            scopes[sc] = scopes.get(sc, 0) + f * _mult
+
     for eqn in jaxpr.eqns:
         name = eqn.primitive.name
         if name == "dot_general":
             f = _dot_flops(eqn)
             total += f
-            breakdown["dot_general"] = breakdown.get("dot_general", 0) + f
+            credit("dot_general", eqn, f)
         elif name == "conv_general_dilated":
             f = _conv_flops(eqn)
             total += f
-            breakdown["conv"] = breakdown.get("conv", 0) + f
+            credit("conv", eqn, f)
         elif name == "scan":
-            sub_bd: Dict[str, int] = {}
-            inner = count_jaxpr_flops(eqn.params["jaxpr"], sub_bd)
             length = eqn.params["length"]
+            inner = count_jaxpr_flops(
+                eqn.params["jaxpr"], breakdown, scopes,
+                _prefix=_eqn_scope(eqn, _prefix), _mult=_mult * length)
             total += inner * length
-            for k, v in sub_bd.items():
-                breakdown[k] = breakdown.get(k, 0) + v * length
         elif name in ("pjit", "closed_call", "core_call", "remat",
                       "checkpoint", "custom_vjp_call", "custom_jvp_call",
                       "custom_vjp_call_jaxpr"):
             sub = (eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
                    or eqn.params.get("fun_jaxpr"))
             if sub is not None:
-                total += count_jaxpr_flops(sub, breakdown)
+                total += count_jaxpr_flops(
+                    sub, breakdown, scopes,
+                    _prefix=_eqn_scope(eqn, _prefix), _mult=_mult)
         elif name in ("cond",):
             branches = eqn.params.get("branches", ())
             if branches:
-                # count the most expensive branch (what actually runs)
-                costs = []
-                bds = []
+                # count the most expensive branch (what actually runs):
+                # ONE walk per branch into fresh dicts, merge the winner
+                # (a probe-then-credit double walk would compound 2^d on
+                # d-nested conds — the gated 1F1B executor's shape)
+                probes = []
                 for b in branches:
                     bd: Dict[str, int] = {}
-                    costs.append(count_jaxpr_flops(b, bd))
-                    bds.append(bd)
-                best = max(range(len(costs)), key=lambda i: costs[i])
-                total += costs[best]
-                for k, v in bds[best].items():
+                    sc: Optional[Dict[str, int]] = (
+                        {} if scopes is not None else None)
+                    probes.append((count_jaxpr_flops(
+                        b, bd, sc, _prefix=_eqn_scope(eqn, _prefix),
+                        _mult=_mult), bd, sc))
+                cost, bd, sc = max(probes, key=lambda p: p[0])
+                total += cost
+                for k, v in bd.items():
                     breakdown[k] = breakdown.get(k, 0) + v
+                if scopes is not None and sc is not None:
+                    for k, v in sc.items():
+                        scopes[k] = scopes.get(k, 0) + v
         elif name == "while":
             body = eqn.params.get("body_jaxpr")
             if body is not None:
-                total += count_jaxpr_flops(body, breakdown)
+                total += count_jaxpr_flops(
+                    body, breakdown, scopes,
+                    _prefix=_eqn_scope(eqn, _prefix), _mult=_mult)
         else:
             # elementwise / reduction: one flop per output element
             for ov in eqn.outvars:
@@ -106,8 +142,7 @@ def count_jaxpr_flops(jaxpr, breakdown: Optional[Dict[str, int]] = None
                 if aval is not None and hasattr(aval, "shape"):
                     f = int(np.prod(aval.shape, initial=1))
                     total += f
-                    breakdown["elementwise"] = breakdown.get(
-                        "elementwise", 0) + f
+                    credit("elementwise", eqn, f)
     return total
 
 
@@ -150,6 +185,7 @@ class FlopsProfiler:
         self.macs = 0
         self.params = 0
         self.breakdown: Dict[str, int] = {}
+        self.scopes: Dict[str, int] = {}
         self._t0 = 0.0
         self.latency = 0.0
 
@@ -157,12 +193,14 @@ class FlopsProfiler:
         self.started = True
         self.flops = self.macs = 0
         self.breakdown = {}
+        self.scopes = {}
         self._t0 = time.time()
 
     def profile_fn(self, fn: Callable, *args, **kwargs) -> None:
         closed = jax.make_jaxpr(fn)(*args, **kwargs)
         self.breakdown = {}
-        self.flops = count_jaxpr_flops(closed, self.breakdown)
+        self.scopes = {}
+        self.flops = count_jaxpr_flops(closed, self.breakdown, self.scopes)
         self.macs = (self.breakdown.get("dot_general", 0) +
                      self.breakdown.get("conv", 0)) // 2
 
@@ -187,9 +225,32 @@ class FlopsProfiler:
     def get_total_duration(self, as_string: bool = False):
         return self.latency
 
+    def module_tree(self, module_depth: int = -1) -> Dict[str, int]:
+        """Aggregate the per-name-scope flops into a module tree: every
+        scope path also credits its ancestors, so 'layer' holds the sum
+        of 'layer/attn' + 'layer/mlp' + its own untagged ops (the
+        reference's module-hierarchy semantics, profiler.py:11)."""
+        tree: Dict[str, int] = {}
+        for path, f in self.scopes.items():
+            parts = [p for p in path.split("/") if p]
+            if not parts:
+                parts = ["(untagged)"]
+            if module_depth > 0:
+                parts = parts[:module_depth]
+            for depth in range(1, len(parts) + 1):
+                key = "/".join(parts[:depth])
+                tree[key] = tree.get(key, 0) + f
+        return tree
+
     def print_model_profile(self, profile_step: int = 1,
                             module_depth: int = -1, top_modules: int = 1,
                             detailed: bool = True, output_file=None) -> None:
+        """Reference-style profile dump (profiler.py print_model_profile):
+        totals, the per-module tree with top-k modules per depth, and the
+        per-primitive breakdown.  Per-module latency is ESTIMATED as the
+        module's flops share of the measured step latency — one compiled
+        XLA program has no per-module clocks; the flops share is the
+        attribution a fused program supports honestly."""
         lines = [
             "----------- flops profiler (jaxpr cost analysis) -----------",
             f"profile step:            {profile_step}",
@@ -198,6 +259,24 @@ class FlopsProfiler:
             f"fwd(+bwd) MACs:          {self.get_total_macs(True)}",
             f"step latency:            {self.latency * 1e3:.2f} ms",
         ]
+        if detailed and self.scopes:
+            tree = self.module_tree(module_depth)
+            by_depth: Dict[int, list] = {}
+            for key, f in tree.items():
+                by_depth.setdefault(key.count("/"), []).append((key, f))
+            lines.append(
+                "per-module tree (named scopes; latency = flops share "
+                "x step):")
+            total = max(self.flops, 1)
+            for depth in sorted(by_depth):
+                rows = sorted(by_depth[depth], key=lambda kv: -kv[1])
+                lines.append(f"  depth {depth} (top {top_modules}):")
+                for key, f in rows[:max(1, top_modules)]:
+                    share = f / total
+                    lines.append(
+                        f"    {key:<40} {_fmt(f, 'FLOPS'):>14} "
+                        f"{share * 100:5.1f}%  "
+                        f"~{share * self.latency * 1e3:7.2f} ms")
         if detailed and self.breakdown:
             lines.append("breakdown by primitive:")
             for k, v in sorted(self.breakdown.items(),

@@ -64,6 +64,51 @@ def test_profiler_on_gpt2_matches_analytic():
     assert 0.5 * rough < macs < 6 * rough
 
 
+def test_module_tree_attention_matches_analytic():
+    """Per-module tree (round 5 — the reference's module-hierarchy dump,
+    profiler.py:11): the layer/attn scope must carry the analytic
+    attention FLOPs (qkv + scores + ctx + out-proj) within the
+    elementwise slack, and the printed profile must show the hierarchy."""
+    from deepspeed_tpu.models import GPT2Config, GPT2Model
+    from deepspeed_tpu.profiling import FlopsProfiler
+
+    B, S, H, L = 2, 128, 64, 3
+    cfg = GPT2Config(vocab_size=512, n_positions=S, hidden_size=H,
+                     num_layers=L, num_heads=4, bf16=False,
+                     embd_dropout=0.0, attn_dropout=0.0, hidden_dropout=0.0)
+    model = GPT2Model(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    ids = jnp.zeros((B, S), jnp.int32)
+
+    prof = FlopsProfiler()
+    prof.set_params(params)
+    prof.start_profile()
+    prof.profile_fn(lambda p: model.loss(p, None, ids), params)
+    prof.stop_profile()
+
+    tree = prof.module_tree()
+    # embed / layer / head all present, layer split into attn + mlp
+    for key in ("embed", "layer", "head", "layer/attn", "layer/mlp"):
+        assert key in tree and tree[key] > 0, (key, sorted(tree))
+    # attention: qkv (6BSH^2) + scores/ctx (4BS^2H) + out-proj (2BSH^2)
+    analytic_attn = L * (8 * B * S * H * H + 4 * B * S * S * H)
+    assert abs(tree["layer/attn"] - analytic_attn) / analytic_attn < 0.10
+    # mlp: 2 matmuls of [S,H]x[H,4H] per layer = 16BSH^2
+    analytic_mlp = L * 16 * B * S * H * H
+    assert abs(tree["layer/mlp"] - analytic_mlp) / analytic_mlp < 0.10
+    # hierarchy: the layer scope contains its children
+    assert tree["layer"] >= tree["layer/attn"] + tree["layer/mlp"]
+
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("r", suffix=".txt") as f:
+        prof.print_model_profile(detailed=True, top_modules=4,
+                                 output_file=f.name)
+        out = open(f.name).read()
+    assert "per-module tree" in out
+    assert "layer/attn" in out and "layer/mlp" in out
+
+
 def test_engine_flops_profiler_integration(capsys):
     ds.reset_mesh_context()
     mesh = ds.initialize_mesh(data=-1)
