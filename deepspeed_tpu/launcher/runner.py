@@ -39,6 +39,17 @@ def parse_args(args=None):
     parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
                         help="hostfile: one 'hostname slots=N' per line "
                              "(reference runner.py:120)")
+    parser.add_argument("--tpu", type=str, default="",
+                        help="TPU-pod discovery instead of a hostfile: "
+                             "the reserved names 'metadata' and 'local' "
+                             "both read this TPU VM's own pod topology "
+                             "from the GCE metadata server; any other "
+                             "value is a TPU name resolved via 'gcloud "
+                             "compute tpus tpu-vm describe' "
+                             "(launcher/tpu_discovery.py — the "
+                             "multinode_runner.py:35 family's TPU form)")
+    parser.add_argument("--tpu_zone", type=str, default=None)
+    parser.add_argument("--tpu_project", type=str, default=None)
     parser.add_argument("-i", "--include", type=str, default="",
                         help='e.g. "host1@host2" or "host1:0@host2:0,1"')
     parser.add_argument("-e", "--exclude", type=str, default="",
@@ -161,7 +172,16 @@ def build_host_commands(resources: "OrderedDict[str, List[int]]",
 
 def main(argv=None) -> int:
     args = parse_args(argv)
-    resources = fetch_hostfile(args.hostfile)
+    if args.tpu:
+        from .tpu_discovery import discover
+        pod = discover(args.tpu, args.tpu_zone, args.tpu_project)
+        resources = pod.resources()
+        logger.info(
+            f"dslaunch --tpu {args.tpu}: {len(pod.workers)} worker(s)"
+            + (f" [{pod.accelerator_type}]" if pod.accelerator_type
+               else ""))
+    else:
+        resources = fetch_hostfile(args.hostfile)
     if not resources:
         if args.num_nodes > 1:
             raise ValueError("multi-node launch needs a hostfile")
