@@ -259,3 +259,59 @@ def test_moe_zero_matches_zero0(zero):
     base = _train_moe(0)
     cell = _train_moe(zero)
     np.testing.assert_allclose(cell, base, rtol=2e-5)
+
+
+def test_moe_pipe_checkpoint_roundtrip(tmp_path):
+    """PP x EP checkpoint/resume: the MoE pipeline's stacked
+    [stage, layer, expert, ...] leaves must survive save -> fresh-engine
+    load -> continue, matching an uninterrupted run's trajectory."""
+    from deepspeed_tpu.models import GPTMoEConfig
+    from deepspeed_tpu.models.gpt_moe_pipe import gpt_moe_pipeline_module
+
+    cfg_kw = dict(vocab_size=64, n_positions=SEQ, hidden_size=32,
+                  num_layers=4, num_heads=4, bf16=False, num_experts=4,
+                  top_k=2, capacity_factor=2.0, min_capacity=4, moe_every=2,
+                  embd_dropout=0.0, attn_dropout=0.0, hidden_dropout=0.0)
+
+    def build():
+        mesh = ds.initialize_mesh(pipe=2, expert=2, data=-1)
+        dp = mesh.data_parallel_world_size
+        module = gpt_moe_pipeline_module(GPTMoEConfig(**cfg_kw),
+                                         num_stages=2)
+        return PipelineEngine(
+            model=module,
+            config={"train_batch_size": GLOBAL_BATCH * MICRO_BATCHES,
+                    "train_micro_batch_size_per_gpu": GLOBAL_BATCH // dp,
+                    "gradient_accumulation_steps": MICRO_BATCHES,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 1},
+                    "steps_per_print": 10 ** 9},
+            example_input=jnp.zeros((GLOBAL_BATCH, SEQ), jnp.int32),
+            rng=jax.random.PRNGKey(3))
+
+    def batches(rs):
+        return iter([(ids, ids) for ids in
+                     (rs.randint(0, 64, (GLOBAL_BATCH, SEQ)).astype(np.int32)
+                      for _ in range(MICRO_BATCHES))])
+
+    # uninterrupted 3-step run
+    ds.reset_mesh_context()
+    ref = build()
+    rs = np.random.RandomState(7)
+    ref_losses = [ref.train_batch(batches(rs)) for _ in range(3)]
+
+    # 2 steps -> save -> fresh engine -> load -> 1 more step
+    ds.reset_mesh_context()
+    eng = build()
+    rs = np.random.RandomState(7)
+    for _ in range(2):
+        eng.train_batch(batches(rs))
+    eng.save_checkpoint(str(tmp_path), tag="moe_pipe")
+
+    ds.reset_mesh_context()
+    eng2 = build()
+    eng2.load_checkpoint(str(tmp_path), tag="moe_pipe")
+    assert eng2.global_steps == 2
+    loss3 = eng2.train_batch(batches(rs))
+    np.testing.assert_allclose(loss3, ref_losses[2], rtol=2e-5)
+    ds.reset_mesh_context()
