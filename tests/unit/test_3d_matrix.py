@@ -139,19 +139,26 @@ def test_plain_body_pipe_expert_matches_baseline():
 # the composition the reference gets from running MoE under any engine
 # (deepspeed/runtime/engine.py:1714-1727 per-group expert-grad reduction).
 # ---------------------------------------------------------------------- #
-def _train_moe_pipe(pipe, expert, zero_stage=0, steps=3, tp=1):
+# one config for every MoE-pipeline test in this file (the parity matrix
+# and the checkpoint roundtrip must exercise the SAME model)
+MOE_PIPE_CFG_KW = dict(
+    vocab_size=64, n_positions=SEQ, hidden_size=32, num_layers=4,
+    num_heads=4, bf16=False, num_experts=4, top_k=2,
+    capacity_factor=2.0, min_capacity=4, moe_every=2,
+    embd_dropout=0.0, attn_dropout=0.0, hidden_dropout=0.0)
+
+
+def _build_moe_pipe_engine(pipe, expert, zero_stage, tp=1):
+    """Mesh + module + engine for the shared MoE-pipeline config
+    (resets the mesh context; caller resets again when done)."""
     from deepspeed_tpu.models import GPTMoEConfig
     from deepspeed_tpu.models.gpt_moe_pipe import gpt_moe_pipeline_module
 
     ds.reset_mesh_context()
     mesh = ds.initialize_mesh(pipe=pipe, expert=expert, model=tp, data=-1)
     dp = mesh.data_parallel_world_size
-    cfg = GPTMoEConfig(
-        vocab_size=64, n_positions=SEQ, hidden_size=32, num_layers=4,
-        num_heads=4, bf16=False, num_experts=4, top_k=2,
-        capacity_factor=2.0, min_capacity=4, moe_every=2,
-        embd_dropout=0.0, attn_dropout=0.0, hidden_dropout=0.0)
-    module = gpt_moe_pipeline_module(cfg, num_stages=pipe)
+    module = gpt_moe_pipeline_module(GPTMoEConfig(**MOE_PIPE_CFG_KW),
+                                     num_stages=pipe)
     conf = {
         "train_batch_size": GLOBAL_BATCH * MICRO_BATCHES,
         "train_micro_batch_size_per_gpu": GLOBAL_BATCH // dp,
@@ -160,10 +167,14 @@ def _train_moe_pipe(pipe, expert, zero_stage=0, steps=3, tp=1):
         "zero_optimization": {"stage": zero_stage},
         "steps_per_print": 10 ** 9,
     }
-    engine = PipelineEngine(
+    return PipelineEngine(
         model=module, config=conf,
         example_input=jnp.zeros((GLOBAL_BATCH, SEQ), jnp.int32),
         rng=jax.random.PRNGKey(3))
+
+
+def _train_moe_pipe(pipe, expert, zero_stage=0, steps=3, tp=1):
+    engine = _build_moe_pipe_engine(pipe, expert, zero_stage, tp)
     rs = np.random.RandomState(0)
     losses = []
     for _ in range(steps):
@@ -264,30 +275,11 @@ def test_moe_zero_matches_zero0(zero):
 def test_moe_pipe_checkpoint_roundtrip(tmp_path):
     """PP x EP checkpoint/resume: the MoE pipeline's stacked
     [stage, layer, expert, ...] leaves must survive save -> fresh-engine
-    load -> continue, matching an uninterrupted run's trajectory."""
-    from deepspeed_tpu.models import GPTMoEConfig
-    from deepspeed_tpu.models.gpt_moe_pipe import gpt_moe_pipeline_module
-
-    cfg_kw = dict(vocab_size=64, n_positions=SEQ, hidden_size=32,
-                  num_layers=4, num_heads=4, bf16=False, num_experts=4,
-                  top_k=2, capacity_factor=2.0, min_capacity=4, moe_every=2,
-                  embd_dropout=0.0, attn_dropout=0.0, hidden_dropout=0.0)
+    load -> continue, matching an uninterrupted run's trajectory.
+    Same model as the parity matrix (_build_moe_pipe_engine)."""
 
     def build():
-        mesh = ds.initialize_mesh(pipe=2, expert=2, data=-1)
-        dp = mesh.data_parallel_world_size
-        module = gpt_moe_pipeline_module(GPTMoEConfig(**cfg_kw),
-                                         num_stages=2)
-        return PipelineEngine(
-            model=module,
-            config={"train_batch_size": GLOBAL_BATCH * MICRO_BATCHES,
-                    "train_micro_batch_size_per_gpu": GLOBAL_BATCH // dp,
-                    "gradient_accumulation_steps": MICRO_BATCHES,
-                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
-                    "zero_optimization": {"stage": 1},
-                    "steps_per_print": 10 ** 9},
-            example_input=jnp.zeros((GLOBAL_BATCH, SEQ), jnp.int32),
-            rng=jax.random.PRNGKey(3))
+        return _build_moe_pipe_engine(pipe=2, expert=2, zero_stage=1)
 
     def batches(rs):
         return iter([(ids, ids) for ids in
