@@ -263,11 +263,29 @@ def _time_steps(step, warmup=3, iters=30, align=1, final_sync=None):
 
 
 def bench_gpt2(batch=8, metric="gpt2_124m_train_tokens_per_sec_1chip",
-               hidden=768, layers=12, heads=12, remat=False):
+               hidden=768, layers=12, heads=12, remat=False,
+               grads_half=False):
     import jax
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import GPT2Config, GPT2Model
 
+    # ad-hoc probe overrides (memory-fit experiments without editing the
+    # committed row configs); every active override is echoed into the
+    # result row so a leftover env var can never silently pollute the
+    # canonical ladder
+    def _env_flag(name):
+        return os.environ[name] not in ("", "0", "false", "False", "no")
+
+    overrides = {}
+    if "DS_BENCH_BATCH" in os.environ:
+        batch = int(os.environ["DS_BENCH_BATCH"])
+        overrides["DS_BENCH_BATCH"] = batch
+    if "DS_BENCH_REMAT" in os.environ:
+        remat = _env_flag("DS_BENCH_REMAT")
+        overrides["DS_BENCH_REMAT"] = remat
+    if "DS_BENCH_GRADS_BF16" in os.environ:
+        grads_half = _env_flag("DS_BENCH_GRADS_BF16")
+        overrides["DS_BENCH_GRADS_BF16"] = grads_half
     seq = 1024
     # DS_BENCH_ATTN_LAYOUT=bshd A/Bs the transpose-free kernel layout
     # without a code change (default stays the Mosaic-proven bhsd)
@@ -284,7 +302,7 @@ def bench_gpt2(batch=8, metric="gpt2_124m_train_tokens_per_sec_1chip",
         "gradient_accumulation_steps": 1,
         "optimizer": {"type": "AdamW",
                       "params": {"lr": 6e-4, "weight_decay": 0.1}},
-        "bf16": {"enabled": True},
+        "bf16": {"enabled": True, "grads_in_compute_dtype": grads_half},
         "zero_optimization": {"stage": 2},
         "steps_per_print": 10 ** 9,
     }
@@ -314,6 +332,8 @@ def bench_gpt2(batch=8, metric="gpt2_124m_train_tokens_per_sec_1chip",
         "tflops_per_chip": round(tflops, 2),
         "mfu": round(tflops / peak, 4),
         "final_loss": round(final_loss, 4),
+        "batch": batch,
+        **({"probe_overrides": overrides} if overrides else {}),
     }
 
 

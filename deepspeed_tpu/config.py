@@ -57,12 +57,22 @@ class BF16Config:
     """TPU-native: bf16 is the preferred training dtype on TPU (MXU-native,
     no loss scaling required)."""
     enabled: bool = C.BF16_ENABLED_DEFAULT
+    # bf16 gradient buffers (reference analog: fp16 grads under ZeRO
+    # stage 1/2 — deepspeed/runtime/zero/stage2.py keeps fp16 grad
+    # buffers and the fp32 upcast happens in the optimizer).  Halves
+    # grad HBM + stage-2 reduce-scatter width; micro-batch accumulation
+    # rounds through bf16 like the reference's fp16 accumulation.
+    grads_in_compute_dtype: bool = C.BF16_GRADS_IN_COMPUTE_DTYPE_DEFAULT
 
     @staticmethod
     def from_dict(d: Optional[Dict[str, Any]]) -> "BF16Config":
         d = d or {}
-        return BF16Config(enabled=get_scalar_param(d, C.BF16_ENABLED,
-                                                   C.BF16_ENABLED_DEFAULT))
+        return BF16Config(
+            enabled=get_scalar_param(d, C.BF16_ENABLED,
+                                     C.BF16_ENABLED_DEFAULT),
+            grads_in_compute_dtype=get_scalar_param(
+                d, C.BF16_GRADS_IN_COMPUTE_DTYPE,
+                C.BF16_GRADS_IN_COMPUTE_DTYPE_DEFAULT))
 
 
 @dataclass

@@ -69,6 +69,14 @@ FP16_MASTER_WEIGHTS_AND_GRADS_DEFAULT = False
 BF16 = "bf16"
 BF16_ENABLED = "enabled"
 BF16_ENABLED_DEFAULT = False
+# Keep gradient buffers in the compute dtype (bf16) instead of fp32 —
+# the analog of the reference's fp16 gradient buffers under ZeRO stage
+# 1/2 (grads live at half width between backward and the optimizer,
+# which upcasts to fp32 at apply).  Halves grad HBM and the stage-2
+# reduce-scatter wire width; opt-in because accumulation then rounds
+# through bf16 like the reference's fp16 path.
+BF16_GRADS_IN_COMPUTE_DTYPE = "grads_in_compute_dtype"
+BF16_GRADS_IN_COMPUTE_DTYPE_DEFAULT = False
 
 AMP = "amp"
 AMP_ENABLED = "enabled"

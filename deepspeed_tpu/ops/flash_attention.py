@@ -220,11 +220,95 @@ def _effective_dropout_bits(block_k: int) -> int:
     return _dropout_bits if _dropout_bits == 32 or block_k % 4 == 0 else 32
 
 
+# --------------------------------------------------------------------------- #
+# Dropout mask reuse (store-in-forward / read-in-backward)
+# --------------------------------------------------------------------------- #
+# The regen scheme above pays the PRNG three times per step (fwd, dq,
+# dkv) — measured ~2.6% of the flagship step per kernel at 8-bit
+# (docs/ROUND5_NOTES.md).  Mask REUSE stores the keep decisions once in
+# the forward and the backward kernels read them: the PRNG runs once,
+# and the stored mask costs only 1-bit-per-position of HBM traffic.
+#
+# Packing rides the SUBLANE axis: 32 q-rows fold into one uint32 word
+# row, so the packed tile is [block_q/32, block_k] — the lane dim stays
+# the full lane-aligned block_k and the sublane dim is block_q/32 (16 at
+# the default 512 block), satisfying Mosaic's (8, 128) int32 tiling
+# without any padding.  (Lane-axis packing would shrink the minor dim to
+# block_k/32 < 128, which is only legal as a full-extent dim — i.e. a
+# single k block — while sublane packing is legal whenever
+# block_q % 256 == 0.)  Pack/unpack are 32 aligned sublane slices with
+# shift+or — no cross-lane movement, pure VPU work.
+#
+# The reference's analog is checkpointing the dropout mask with the
+# activation (dropout_kernels.cu stores the uint8 mask tensor the
+# backward kernels consume); here the mask lives bit-packed in the
+# custom-VJP residuals instead.
+_MASK_PACK = 32  # q rows per packed uint32 word
+
+
+def _pack_keep32(keep):
+    """[rows, cols] bool -> [rows//32, cols] uint32.  Bit j of word row
+    r holds keep[j*(rows//32) + r] (group layout: 32 aligned sublane
+    slices, no interleave)."""
+    gr = keep.shape[0] // _MASK_PACK
+    ku = keep.astype(jnp.uint32)
+    packed = ku[0:gr]
+    for j in range(1, _MASK_PACK):
+        packed = packed | (ku[j * gr:(j + 1) * gr] << np.uint32(j))
+    return packed
+
+
+def _unpack_keep32(packed):
+    """Inverse of _pack_keep32: [gr, cols] uint32 -> [gr*32, cols] bool."""
+    one = np.uint32(1)
+    return jnp.concatenate(
+        [(packed >> np.uint32(j)) & one for j in range(_MASK_PACK)],
+        axis=0) > 0
+
+
+def _parse_dropout_reuse(raw: str) -> bool:
+    return raw not in ("", "0", "false", "False", "no")
+
+
+_DEFAULT_DROPOUT_REUSE = False
+_dropout_reuse = _parse_dropout_reuse(
+    os.environ.get("DS_DROPOUT_REUSE",
+                   "1" if _DEFAULT_DROPOUT_REUSE else "0"))
+
+
+def set_dropout_mask_reuse(on: bool) -> None:
+    """Store the forward keep mask (bit-packed) and reuse it in the
+    backward kernels instead of regenerating it from the PRNG.  Grads
+    are BIT-IDENTICAL either way (the stored mask equals the regenerated
+    one); the modes differ only in where the step spends time — regen
+    pays the PRNG 3x, reuse pays S^2/8 bytes of residual traffic.  Read
+    at TRACE time like set_dropout_bits; falls back to regen when the
+    resolved q block is not a multiple of 256 (packed-tile sublane
+    alignment)."""
+    global _dropout_reuse
+    _dropout_reuse = bool(on)
+
+
+def dropout_mask_reuse() -> bool:
+    return _dropout_reuse
+
+
+def _mask_reuse_usable(block_q: int) -> bool:
+    """Packed tile legality: sublane dim block_q/32 must be a multiple
+    of 8 -> block_q % 256 == 0 (512-default and 256 blocks qualify;
+    smaller resolved blocks regen)."""
+    return block_q % 256 == 0
+
+
 def _fa_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-               m_scr, l_scr, acc_scr, *,
+               *rest,
                causal: bool, sm_scale: float, block_q: int, block_k: int,
                num_k_blocks: int, dropout_rate: float,
-               dropout_pbits: int = 32):
+               dropout_pbits: int = 32, save_mask: bool = False):
+    if save_mask:
+        mask_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        m_scr, l_scr, acc_scr = rest
     b = pl.program_id(0)
     h = pl.program_id(1)
     qi = pl.program_id(2)
@@ -278,6 +362,11 @@ def _fa_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                                  bits=dropout_pbits)
             inv = _keep_scale(dropout_rate, dropout_pbits)
             p = jnp.where(keep, p * inv, 0.0)
+            if save_mask:
+                # bit-packed keep decisions for the backward kernels.
+                # Causally-skipped tiles never write (and the backward
+                # skips the same tiles, so their garbage is never read).
+                mask_ref[0, 0] = _pack_keep32(keep)
 
         v_blk = _ld(v_ref)                           # [bk, d]
         pv = jax.lax.dot_general(
@@ -369,7 +458,8 @@ def flash_attention_pallas(q, k, v, causal: bool = False,
                            block_q: int = 512, block_k: int = 1024,
                            interpret: bool = False, return_lse: bool = False,
                            layout: str = "bhsd", dropout_rate: float = 0.0,
-                           dropout_seed=None):
+                           dropout_seed=None,
+                           save_dropout_mask: bool = False):
     """Pallas flash attention.
 
     layout="bhsd" (default): q,k,v [B, H, S, D] -> [B, H, S, D].
@@ -379,7 +469,13 @@ def flash_attention_pallas(q, k, v, causal: bool = False,
     and fails Pallas lowering on real TPUs, measured round 3; the
     transposes here are cheap relative to the attention itself and XLA
     fuses them into neighbors where it can.)
-    logsumexp (when return_lse) is [B, H, S] in BOTH layouts."""
+    logsumexp (when return_lse) is [B, H, S] in BOTH layouts.
+
+    save_dropout_mask (requires return_lse and dropout_rate > 0, and a
+    resolved q block that is a multiple of 256): additionally returns
+    the bit-packed keep mask [B, H, S_q/32, S_k] uint32 — ALWAYS in the
+    internal bhsd-derived index space regardless of layout — for
+    flash_attention_bwd_pallas(dropout_mask=...)."""
     if pltpu is None:
         raise RuntimeError(
             "pallas TPU support unavailable in this jax install — use "
@@ -406,22 +502,50 @@ def flash_attention_pallas(q, k, v, causal: bool = False,
             "CPU lowering) — interpret-mode callers must use rate 0")
     seed = _seed_arg(dropout_seed)
 
+    if save_dropout_mask:
+        if not (return_lse and dropout_rate > 0.0):
+            raise ValueError(
+                "save_dropout_mask requires return_lse and dropout_rate > 0")
+        if not _mask_reuse_usable(block_q):
+            raise ValueError(
+                f"save_dropout_mask: resolved q block {block_q} is not a "
+                "multiple of 256 (packed-tile sublane alignment) — use the "
+                "regen path")
     kernel = functools.partial(
         _fa_kernel, causal=causal, sm_scale=float(sm_scale),
         block_q=block_q, block_k=block_k, num_k_blocks=nk,
-        dropout_rate=float(dropout_rate), dropout_pbits=_effective_dropout_bits(block_k))
+        dropout_rate=float(dropout_rate),
+        dropout_pbits=_effective_dropout_bits(block_k),
+        save_mask=save_dropout_mask)
 
     scratch = [
         pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
         pltpu.VMEM((block_q, _LANES), jnp.float32),  # running sum
         pltpu.VMEM((block_q, d), jnp.float32),       # output accumulator
     ]
+    out_specs = [
+        _tile_spec(block_q, d, "i"),
+        pl.BlockSpec((1, 1, block_q, _STATS_LANES),
+                     lambda b, h, i, j, *_: (b, h, i, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct(q.shape, q.dtype),
+        jax.ShapeDtypeStruct((batch, heads, q_len, _STATS_LANES),
+                             jnp.float32),
+    ]
+    if save_dropout_mask:
+        out_specs.append(
+            pl.BlockSpec((1, 1, block_q // _MASK_PACK, block_k),
+                         lambda b, h, i, j, *_: (b, h, i, j)))
+        out_shape.append(
+            jax.ShapeDtypeStruct(
+                (batch, heads, q_len // _MASK_PACK, k_len), jnp.uint32))
     params = {}
     if not interpret:
         params["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary"))
-    out, lse = pl.pallas_call(
+    res = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
@@ -431,22 +555,17 @@ def flash_attention_pallas(q, k, v, causal: bool = False,
                 _tile_spec(block_k, d, "j"),
                 _tile_spec(block_k, d, "j"),
             ],
-            out_specs=[
-                _tile_spec(block_q, d, "i"),
-                pl.BlockSpec((1, 1, block_q, _STATS_LANES),
-                             lambda b, h, i, j, *_: (b, h, i, 0)),
-            ],
+            out_specs=out_specs,
             scratch_shapes=scratch),
-        out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((batch, heads, q_len, _STATS_LANES),
-                                 jnp.float32),
-        ],
+        out_shape=out_shape,
         interpret=interpret,
         **params,
     )(seed, q, k, v)
+    out, lse = res[0], res[1]
     if layout == "bshd":
         out = _t_bhsd(out)
+    if save_dropout_mask:
+        return out, lse[..., 0], res[2]
     return (out, lse[..., 0]) if return_lse else out
 
 
@@ -454,9 +573,13 @@ def flash_attention_pallas(q, k, v, causal: bool = False,
 # Pallas backward kernels (FlashAttention-2 style)
 # --------------------------------------------------------------------------- #
 def _fa_bwd_dkdv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                        delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
-                        causal, sm_scale, block_q, block_k, num_q_blocks,
-                        num_k_blocks, dropout_rate, dropout_pbits=32):
+                        delta_ref, *rest, causal, sm_scale, block_q,
+                        block_k, num_q_blocks, num_k_blocks, dropout_rate,
+                        dropout_pbits=32, reuse_mask: bool = False):
+    if reuse_mask:
+        mask_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    else:
+        dk_ref, dv_ref, dk_scr, dv_scr = rest
     b = pl.program_id(0)
     h = pl.program_id(1)
     ki = pl.program_id(2)
@@ -492,11 +615,17 @@ def _fa_bwd_dkdv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         if dropout_rate > 0.0:
-            # same (qi, ki) seeding as the forward — identical mask.
-            # dV sees the DROPPED probabilities; dS = P*(D.dp - delta)
-            keep = _dropout_keep(seed_ref, b, h, qi, ki, dropout_rate,
-                                 block_q, block_k, num_k_blocks,
-                                 bits=dropout_pbits)
+            # same mask as the forward — regenerated from the tile
+            # coordinates, or read back bit-packed (reuse mode; both
+            # give the IDENTICAL mask, so grads don't depend on the
+            # mode).  dV sees the DROPPED probabilities; dS =
+            # P*(D.dp - delta)
+            if reuse_mask:
+                keep = _unpack_keep32(mask_ref[0, 0])
+            else:
+                keep = _dropout_keep(seed_ref, b, h, qi, ki, dropout_rate,
+                                     block_q, block_k, num_k_blocks,
+                                     bits=dropout_pbits)
             inv = _keep_scale(dropout_rate, dropout_pbits)
             p_drop = jnp.where(keep, p * inv, 0.0)
             dp = jnp.where(keep, dp * inv, 0.0)
@@ -518,9 +647,13 @@ def _fa_bwd_dkdv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 
 def _fa_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                      delta_ref, dq_ref, dq_scr, *,
-                      causal, sm_scale, block_q, block_k, num_k_blocks,
-                      dropout_rate, dropout_pbits=32):
+                      delta_ref, *rest, causal, sm_scale, block_q,
+                      block_k, num_k_blocks, dropout_rate,
+                      dropout_pbits=32, reuse_mask: bool = False):
+    if reuse_mask:
+        mask_ref, dq_ref, dq_scr = rest
+    else:
+        dq_ref, dq_scr = rest
     b = pl.program_id(0)
     h = pl.program_id(1)
     qi = pl.program_id(2)
@@ -554,9 +687,12 @@ def _fa_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         if dropout_rate > 0.0:
-            keep = _dropout_keep(seed_ref, b, h, qi, ki, dropout_rate,
-                                 block_q, block_k, num_k_blocks,
-                                 bits=dropout_pbits)
+            if reuse_mask:
+                keep = _unpack_keep32(mask_ref[0, 0])
+            else:
+                keep = _dropout_keep(seed_ref, b, h, qi, ki, dropout_rate,
+                                     block_q, block_k, num_k_blocks,
+                                     bits=dropout_pbits)
             inv = _keep_scale(dropout_rate, dropout_pbits)
             dp = jnp.where(keep, dp * inv, 0.0)
         ds = p * (dp - delta) * sm_scale
@@ -575,11 +711,21 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
                                interpret: bool = False,
                                layout: str = "bhsd",
                                dropout_rate: float = 0.0,
-                               dropout_seed=None):
+                               dropout_seed=None, dropout_mask=None,
+                               dropout_mask_block_q=None):
     """Block-wise dq, dk, dv — no [S, S] materialization in HBM.  Inputs
     and grads follow `layout` (lse is always [B, H, S]); "bshd" converts
     to the kernel's [B, H, S, D] at this boundary (see
-    flash_attention_pallas)."""
+    flash_attention_pallas).
+
+    dropout_mask: the bit-packed [B, H, S_q/32, S_k] uint32 keep mask a
+    save_dropout_mask forward stored (always internal-layout).  When
+    given, the kernels READ it instead of regenerating from the PRNG —
+    identical grads, one PRNG pass per step instead of three.
+    dropout_mask_block_q (REQUIRED with dropout_mask): the RESOLVED q
+    block the forward packed with — the bit-group layout is a function
+    of it, so a fwd/bwd block mismatch would silently permute mask rows;
+    this check turns that into a loud error."""
     batch, heads, q_len, d = _dims(q, layout)
     k_len = _dims(k, layout)[2]
     if layout == "bshd":
@@ -597,7 +743,10 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
             f"seq lengths ({q_len},{k_len}) only tile into 1-wide blocks "
             f"— use the flash_attention dispatcher (XLA fallback)")
     nq, nk = q_len // block_q, k_len // block_k
-    if dropout_rate > 0.0 and interpret:
+    if dropout_rate > 0.0 and interpret and dropout_mask is None:
+        # reuse-mode (dropout_mask given) backward never touches the PRNG
+        # — the unpack is plain vector ops, so interpret mode is legal
+        # there (and is how the CPU lane tests the reuse numerics)
         raise ValueError(
             "in-kernel dropout needs the TPU PRNG (pltpu.prng_seed has no "
             "CPU lowering) — interpret-mode callers must use rate 0")
@@ -619,28 +768,49 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary"))
 
+    reuse = dropout_mask is not None
+    if reuse:
+        if not (dropout_rate > 0.0 and _mask_reuse_usable(block_q)):
+            raise ValueError(
+                "dropout_mask given but dropout_rate == 0 or resolved q "
+                f"block {block_q} is not reuse-capable — fwd/bwd mode "
+                "mismatch")
+        if dropout_mask_block_q != block_q:
+            raise ValueError(
+                f"dropout_mask was packed with resolved block_q="
+                f"{dropout_mask_block_q}, but this backward resolved "
+                f"block_q={block_q} — the packed bit layout depends on the "
+                "forward's q block, so the grads would be silently wrong")
+    mask_in = (dropout_mask,) if reuse else ()
+
     # dk/dv: grid over k blocks (grid dim 2), inner loop over q blocks
     # (grid dim 3) — _tile_spec's "i"/"j" name grid dims 2/3, so q/do tiles
     # use "j" here
     dkdv_kernel = functools.partial(
         _fa_bwd_dkdv_kernel, causal=causal, sm_scale=float(sm_scale),
         block_q=block_q, block_k=block_k, num_q_blocks=nq, num_k_blocks=nk,
-        dropout_rate=float(dropout_rate), dropout_pbits=_effective_dropout_bits(block_k))
+        dropout_rate=float(dropout_rate),
+        dropout_pbits=_effective_dropout_bits(block_k), reuse_mask=reuse)
+    dkdv_in_specs = [
+        _tile_spec(block_q, d, "j"),
+        _tile_spec(block_k, d, "i"),
+        _tile_spec(block_k, d, "i"),
+        _tile_spec(block_q, d, "j"),
+        pl.BlockSpec((1, 1, block_q, _STATS_LANES),
+                     lambda b, h, j, i, *_: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, block_q, _STATS_LANES),
+                     lambda b, h, j, i, *_: (b, h, i, 0)),
+    ]
+    if reuse:  # mask tile (q_block, k_block) = (grid dim 3, grid dim 2)
+        dkdv_in_specs.append(
+            pl.BlockSpec((1, 1, block_q // _MASK_PACK, block_k),
+                         lambda b, h, i, j, *_: (b, h, j, i)))
     dk, dv = pl.pallas_call(
         dkdv_kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(batch, heads, nk, nq),
-            in_specs=[
-                _tile_spec(block_q, d, "j"),
-                _tile_spec(block_k, d, "i"),
-                _tile_spec(block_k, d, "i"),
-                _tile_spec(block_q, d, "j"),
-                pl.BlockSpec((1, 1, block_q, _STATS_LANES),
-                             lambda b, h, j, i, *_: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, block_q, _STATS_LANES),
-                             lambda b, h, j, i, *_: (b, h, i, 0)),
-            ],
+            in_specs=dkdv_in_specs,
             out_specs=[
                 _tile_spec(block_k, d, "i"),
                 _tile_spec(block_k, d, "i"),
@@ -655,7 +825,7 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
         ],
         interpret=interpret,
         **params,
-    )(seed, q, k, v, do, lse, delta)
+    )(seed, q, k, v, do, lse, delta, *mask_in)
 
     # dq: grid over q blocks, inner loop over k blocks
     r_spec = pl.BlockSpec((1, 1, block_q, _STATS_LANES),
@@ -663,25 +833,31 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
     dq_kernel = functools.partial(
         _fa_bwd_dq_kernel, causal=causal, sm_scale=float(sm_scale),
         block_q=block_q, block_k=block_k, num_k_blocks=nk,
-        dropout_rate=float(dropout_rate), dropout_pbits=_effective_dropout_bits(block_k))
+        dropout_rate=float(dropout_rate),
+        dropout_pbits=_effective_dropout_bits(block_k), reuse_mask=reuse)
+    dq_in_specs = [
+        _tile_spec(block_q, d, "i"),
+        _tile_spec(block_k, d, "j"),
+        _tile_spec(block_k, d, "j"),
+        _tile_spec(block_q, d, "i"),
+        r_spec, r_spec,
+    ]
+    if reuse:
+        dq_in_specs.append(
+            pl.BlockSpec((1, 1, block_q // _MASK_PACK, block_k),
+                         lambda b, h, i, j, *_: (b, h, i, j)))
     dq = pl.pallas_call(
         dq_kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(batch, heads, nq, nk),
-            in_specs=[
-                _tile_spec(block_q, d, "i"),
-                _tile_spec(block_k, d, "j"),
-                _tile_spec(block_k, d, "j"),
-                _tile_spec(block_q, d, "i"),
-                r_spec, r_spec,
-            ],
+            in_specs=dq_in_specs,
             out_specs=_tile_spec(block_q, d, "i"),
             scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)]),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=interpret,
         **params,
-    )(seed, q, k, v, do, lse, delta)
+    )(seed, q, k, v, do, lse, delta, *mask_in)
 
     if layout == "bshd":
         dq, dk, dv = _t_bhsd(dq), _t_bhsd(dk), _t_bhsd(dv)
@@ -746,26 +922,37 @@ def _flash_fwd(q, k, v, seed, causal, sm_scale, block_q, block_k,
     q_len, k_len = _dims(q, layout)[2], _dims(k, layout)[2]
     if _use_pallas(q_len, k_len, q.shape[3], block_q, block_k):
         _, bq, bk = _resolve_blocks(q_len, k_len, block_q, block_k)
+        # mask-reuse mode (trace-time, like the PRNG width): store the
+        # bit-packed keep mask in the residuals so the backward kernels
+        # skip the PRNG — grads identical either way
+        if dropout_rate > 0.0 and _dropout_reuse and _mask_reuse_usable(bq):
+            out, lse, mask = flash_attention_pallas(
+                q, k, v, causal=causal, sm_scale=sm_scale,
+                block_q=bq, block_k=bk, return_lse=True, layout=layout,
+                dropout_rate=dropout_rate, dropout_seed=seed,
+                save_dropout_mask=True)
+            return out, (q, k, v, seed, out, lse, mask)
         out, lse = flash_attention_pallas(
             q, k, v, causal=causal, sm_scale=sm_scale,
             block_q=bq, block_k=bk, return_lse=True, layout=layout,
             dropout_rate=dropout_rate, dropout_seed=seed)
-        return out, (q, k, v, seed, out, lse)
+        return out, (q, k, v, seed, out, lse, None)
     out = _ref_in_layout(q, k, v, causal, sm_scale, layout, dropout_rate,
                          seed[0])
-    return out, (q, k, v, seed, None, None)
+    return out, (q, k, v, seed, None, None, None)
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, layout, dropout_rate,
                res, g):
-    q, k, v, seed, out, lse = res
+    q, k, v, seed, out, lse, mask = res
     if lse is not None:
         q_len, k_len = _dims(q, layout)[2], _dims(k, layout)[2]
         _, bq, bk = _resolve_blocks(q_len, k_len, block_q, block_k)
         dq, dk, dv = flash_attention_bwd_pallas(
             q, k, v, out, lse, g, causal=causal, sm_scale=sm_scale,
             block_q=bq, block_k=bk, layout=layout,
-            dropout_rate=dropout_rate, dropout_seed=seed)
+            dropout_rate=dropout_rate, dropout_seed=seed,
+            dropout_mask=mask, dropout_mask_block_q=bq)
         return dq, dk, dv, None
     _, vjp = jax.vjp(
         lambda q_, k_, v_: _ref_in_layout(q_, k_, v_, causal, sm_scale,

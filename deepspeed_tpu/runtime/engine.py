@@ -512,6 +512,19 @@ class DeepSpeedEngine:
         prescale = self.config.prescale_gradients
         predivide = self.config.gradient_predivide_factor
 
+        # bf16 gradient buffers (reference: fp16 grad buffers under ZeRO
+        # stage 1/2): cast grads to the compute dtype at the grad-program
+        # boundary — accumulation then runs at half width and the apply
+        # program's existing fp32 upcast (see apply_step) recovers fp32
+        # optimizer math, exactly the reference's fp16 -> fp32 shape.
+        grads_half = (self.config.bf16.enabled
+                      and self.config.bf16.grads_in_compute_dtype)
+
+        def _grads_out(grads):
+            if grads_half:
+                return _tree_cast(grads, compute_dtype)
+            return grads
+
         custom_grad_program = getattr(self, "_custom_grad_program", None)
         sparse_paths = ()
         if self.config.sparse_gradients_enabled:
@@ -550,7 +563,7 @@ class DeepSpeedEngine:
                     cp, scaler_state.loss_scale, rng, *args, **kwargs)
                 if prescale and predivide:
                     grads = jax.tree.map(lambda g: g / predivide, grads)
-                return loss, grads
+                return loss, _grads_out(grads)
 
             def loss_fn(p):
                 cp = _tree_cast(p, compute_dtype)
@@ -566,7 +579,7 @@ class DeepSpeedEngine:
                 loss_fn, has_aux=True)(params)
             if prescale and predivide:
                 grads = jax.tree.map(lambda g: g / predivide, grads)
-            return loss, grads
+            return loss, _grads_out(grads)
 
         from ..parallel.mesh import ZERO_AXES
         manual = tuple(a for a in ZERO_AXES
@@ -635,7 +648,7 @@ class DeepSpeedEngine:
                             red = lax.pmean(g, manual)
                         reduced.append(red)
                     grads = jax.tree_util.tree_unflatten(treedef, reduced)
-                    return lax.pmean(loss, manual), grads
+                    return lax.pmean(loss, manual), _grads_out(grads)
 
                 # check_vma off: the scatter-add of all-gathered rows IS
                 # replicated (every shard adds the same gathered pairs) but

@@ -344,3 +344,40 @@ def test_fused_dequant_matmul_parity_tpu():
         ref = x.astype(jnp.float32) @ dequant(w, jnp.float32)
         np.testing.assert_allclose(np.asarray(out, np.float32),
                                    np.asarray(ref), rtol=2e-2, atol=2.0)
+
+
+def test_flash_dropout_mask_reuse_tpu(monkeypatch):
+    """Mask-reuse mode (store bit-packed keep mask in fwd, read it in
+    both bwd kernels) must be BIT-IDENTICAL to the regen default: the
+    stored mask IS the regenerated mask, so outputs and grads cannot
+    differ.  Also pins that reuse engages (residual mask present) rather
+    than silently falling back to regen."""
+    import importlib
+    fa_mod = importlib.import_module("deepspeed_tpu.ops.flash_attention")
+    from deepspeed_tpu.ops.flash_attention import flash_attention
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    shape = (2, 4, 1024, 64)
+    q, k, v = (jax.random.normal(kk, shape, jnp.float32) for kk in ks)
+    rate = 0.2
+
+    def loss(q_, k_, v_):
+        o = flash_attention(q_, k_, v_, causal=True, impl="pallas",
+                            dropout_rate=rate, dropout_seed=11)
+        return jnp.sum(o.astype(jnp.float32) ** 2), o
+
+    (_, o_regen), g_regen = jax.jit(jax.value_and_grad(
+        loss, argnums=(0, 1, 2), has_aux=True))(q, k, v)
+
+    monkeypatch.setattr(fa_mod, "_dropout_reuse", True)
+    # reuse path engages: the fwd residuals carry a packed mask
+    _, res = fa_mod._flash_fwd(q, k, v, jnp.array([11], jnp.int32), True,
+                               None, fa_mod.DEFAULT_BLOCK_Q,
+                               fa_mod.DEFAULT_BLOCK_K, "bhsd", rate)
+    assert res[-1] is not None and res[-1].dtype == jnp.uint32
+    assert res[-1].shape == (2, 4, 1024 // 32, 1024)
+
+    (_, o_reuse), g_reuse = jax.jit(jax.value_and_grad(
+        loss, argnums=(0, 1, 2), has_aux=True))(q, k, v)
+    np.testing.assert_array_equal(np.asarray(o_regen), np.asarray(o_reuse))
+    for a, b in zip(g_regen, g_reuse):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

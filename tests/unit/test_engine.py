@@ -140,6 +140,26 @@ def test_bf16_training():
     assert np.mean(losses[-4:]) < np.mean(losses[:4]), losses
 
 
+def test_bf16_grads_in_compute_dtype():
+    """bf16 gradient buffers (the reference's fp16-grad-buffer analog):
+    grads leave the grad program in bf16, training still converges, and
+    the fp32 upcast lives in the apply program."""
+    engine = make_engine(
+        stage=2, dtype_cfg={"bf16": {"enabled": True,
+                                     "grads_in_compute_dtype": True}})
+    rng = np.random.RandomState(0)
+    x = rng.standard_normal((8, HIDDEN)).astype(np.float32)
+    y = rng.standard_normal((8,)).astype(np.float32)
+    engine.backward(engine.forward(x, y))
+    leaves = jax.tree.leaves(engine._grad_acc)
+    assert leaves, "no accumulated grads cached"
+    for g in leaves:
+        assert g.dtype == jnp.bfloat16, g.dtype
+    engine.step()
+    losses = train_steps(engine, n=20)
+    assert np.mean(losses[-4:]) < np.mean(losses[:4]), losses
+
+
 def test_static_loss_scale():
     cfg = {"fp16": {"enabled": True, "loss_scale": 128.0}}
     engine = make_engine(stage=0, dtype_cfg=cfg)

@@ -659,3 +659,117 @@ def test_tp_psum_native_width_knob(monkeypatch):
     # f32 inputs are untouched either way
     monkeypatch.delenv("DS_TP_PSUM_NATIVE", raising=False)
     assert "bf16" not in jaxpr_of(jnp.ones((8,), jnp.float32))
+
+
+# --------------------------------------------------------------------------- #
+# Dropout mask reuse: packing layout (CPU-checkable; the kernel-level
+# reuse-vs-regen grad identity is chip-only — tests/tpu)
+# --------------------------------------------------------------------------- #
+def test_dropout_mask_pack_roundtrip():
+    from deepspeed_tpu.ops.flash_attention import (_pack_keep32,
+                                                   _unpack_keep32)
+    rng = np.random.RandomState(3)
+    for rows, cols in [(512, 1024), (256, 128), (1024, 256)]:
+        keep = jnp.asarray(rng.rand(rows, cols) < 0.8)
+        packed = _pack_keep32(keep)
+        assert packed.shape == (rows // 32, cols)
+        assert packed.dtype == jnp.uint32
+        np.testing.assert_array_equal(np.asarray(_unpack_keep32(packed)),
+                                      np.asarray(keep))
+
+
+def test_dropout_mask_pack_bit_layout():
+    """Bit j of word row r must hold keep[j*gr + r] — the fwd kernel
+    packs and BOTH bwd kernels unpack through this exact layout, so pin
+    it (a silent layout change would corrupt grads, not fail loudly)."""
+    from deepspeed_tpu.ops.flash_attention import _pack_keep32
+    rows, cols = 64, 128
+    gr = rows // 32
+    keep = np.zeros((rows, cols), bool)
+    keep[5 * gr + 1, 7] = True  # -> word row 1, bit 5, col 7
+    packed = np.asarray(_pack_keep32(jnp.asarray(keep)))
+    assert packed[1, 7] == np.uint32(1 << 5)
+    assert packed.sum() == np.uint32(1 << 5)
+
+
+def test_dropout_mask_reuse_mode_guards():
+    """save_dropout_mask demands return_lse + dropout; bwd rejects a
+    mask when the fwd/bwd modes disagree."""
+    import importlib
+    fa = importlib.import_module("deepspeed_tpu.ops.flash_attention")
+    q = k = v = jnp.zeros((1, 1, 512, 64), jnp.float32)
+    with pytest.raises(ValueError, match="save_dropout_mask"):
+        fa.flash_attention_pallas(q, k, v, save_dropout_mask=True,
+                                  interpret=True)
+    lse = jnp.zeros((1, 1, 512), jnp.float32)
+    mask = jnp.zeros((1, 1, 16, 512), jnp.uint32)
+    with pytest.raises(ValueError, match="mode mismatch"):
+        fa.flash_attention_bwd_pallas(q, k, v, q, lse, q, dropout_mask=mask,
+                                      interpret=True)
+    # block_q mismatch: the packed bit layout depends on the forward's
+    # resolved q block — a mismatched direct call must error, not corrupt
+    with pytest.raises(ValueError, match="packed bit layout|packed with"):
+        fa.flash_attention_bwd_pallas(
+            q, k, v, q, lse, q, dropout_rate=0.1, dropout_mask=mask,
+            dropout_mask_block_q=256, block_q=512, interpret=True)
+
+
+def test_dropout_mask_reuse_setter():
+    import importlib
+    fa = importlib.import_module("deepspeed_tpu.ops.flash_attention")
+    prev = fa.dropout_mask_reuse()
+    try:
+        fa.set_dropout_mask_reuse(True)
+        assert fa.dropout_mask_reuse() is True
+        fa.set_dropout_mask_reuse(False)
+        assert fa.dropout_mask_reuse() is False
+    finally:
+        fa.set_dropout_mask_reuse(prev)
+    assert fa._mask_reuse_usable(512)
+    assert fa._mask_reuse_usable(256)
+    assert not fa._mask_reuse_usable(128)
+    assert not fa._mask_reuse_usable(384)
+
+
+def test_dropout_mask_reuse_bwd_interpret_matches_reference():
+    """Reuse-mode backward in interpret mode (legal: it never touches
+    the TPU PRNG): pack a KNOWN keep mask the way the fwd kernel does
+    (per-q-block tiles), run both bwd kernels with it, and compare
+    against autodiff of a reference that applies exactly that mask with
+    the kernel's quantized inverse scale.  Covers the unpack bit layout
+    AND the dropout grad math on the CPU lane."""
+    import importlib
+    fa = importlib.import_module("deepspeed_tpu.ops.flash_attention")
+    rng = np.random.RandomState(7)
+    B, H, S, D = 1, 2, 512, 32
+    bq, bk = 256, 128
+    rate = 0.2
+    q, k, v, do = (jnp.asarray(rng.standard_normal((B, H, S, D)),
+                               jnp.float32) for _ in range(4))
+    keep = rng.rand(B, H, S, S) < (1.0 - rate)
+    inv = fa._keep_scale(rate, fa._effective_dropout_bits(bk))
+    sm = 1.0 / np.sqrt(D)
+
+    def ref(q_, k_, v_):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q_, k_) * sm
+        p = jax.nn.softmax(s, axis=-1)
+        a = jnp.where(jnp.asarray(keep), p * inv, 0.0)
+        return jnp.einsum("bhqk,bhkd->bhqd", a, v_)
+
+    out = ref(q, k, v)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sm
+    lse = jax.nn.logsumexp(s, axis=-1)
+    # pack exactly as the fwd kernel does: per q-block tile, local rows
+    packed = jnp.concatenate(
+        [fa._pack_keep32(jnp.asarray(keep[b, h, i * bq:(i + 1) * bq]))
+         for b in range(B) for h in range(H) for i in range(S // bq)],
+        axis=0).reshape(B, H, S // 32, S)
+    dq, dk, dv = fa.flash_attention_bwd_pallas(
+        q, k, v, out, lse, do, block_q=bq, block_k=bk, interpret=True,
+        dropout_rate=rate, dropout_mask=packed, dropout_mask_block_q=bq)
+    gq, gk, gv = jax.grad(
+        lambda q_, k_, v_: jnp.vdot(ref(q_, k_, v_), do),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip((dq, dk, dv), (gq, gk, gv)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
