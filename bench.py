@@ -92,7 +92,7 @@ def _probe_tpu(timeout):
 
 
 def _await_tpu_slot(budget, probe_timeout=180.0, retry_delay=30.0,
-                    max_hung=None):
+                    max_hung=None, confirm_timeout=60.0):
     """Loop a bounded probe until the tunnel's single claim slot is usable,
     waiting for the relay to reap any stale claim — consuming up to
     `budget` seconds before giving up.  Round-2 lesson: the relay DOES
@@ -102,34 +102,56 @@ def _await_tpu_slot(budget, probe_timeout=180.0, retry_delay=30.0,
     window before the stale fallback spoke): a probe that HANGS to its
     timeout is the wedged-transport signature, and a wedged transport
     never recovers inside a bench window — only the driver side restarts
-    it.  So hung probes are capped at `max_hung` (default 2, env
-    DS_BENCH_MAX_HUNG_PROBES); fast failures (rc != 0: backend races,
-    claim-release blips) keep retrying within `budget` as before.
-    Returns (ok, info, waited_seconds)."""
+    it.  So the stale claim is detected ONCE at full `probe_timeout`;
+    every later probe is a short CONFIRMATION at `confirm_timeout` (env
+    DS_BENCH_CONFIRM_PROBE_TIMEOUT — distinguishing a transient from a
+    wedge doesn't need another full window), and hung probes are capped
+    at `max_hung` (default 2, env DS_BENCH_MAX_HUNG_PROBES) before the
+    stale fallback speaks: worst case ~probe_timeout + confirm_timeout,
+    not 8 x 180 s.  Each reaped probe child is TERMed first so a claim
+    it acquired is released cleanly.  Fast failures (rc != 0: backend
+    races, claim-release blips) keep retrying within `budget` as before.
+    Returns (ok, info, waited_seconds, wedged)."""
     if max_hung is None:
         try:
             max_hung = int(os.environ.get("DS_BENCH_MAX_HUNG_PROBES", 2))
         except ValueError:  # junk env must not breach the one-line contract
             max_hung = 2
+    try:
+        confirm_timeout = float(os.environ.get(
+            "DS_BENCH_CONFIRM_PROBE_TIMEOUT", confirm_timeout))
+    except ValueError:
+        pass
     t0 = time.time()
     attempt = hung = 0
     while True:
         attempt += 1
         remaining = budget - (time.time() - t0)
+        limit = confirm_timeout if hung else probe_timeout
         ok, hung_probe, info = _probe_tpu(
-            min(probe_timeout, max(30.0, remaining)))
+            min(limit, max(30.0, remaining)))
         waited = time.time() - t0
         if ok:
-            return True, info, waited
+            return True, info, waited, False
         print(f"[bench] probe {attempt} failed after {waited:.0f}s: {info}",
               file=sys.stderr, flush=True)
         if hung_probe:
             hung += 1
             if hung >= max_hung:
                 return False, (f"{info}; {hung} hung probes — wedged "
-                               "transport, falling back early"), waited
+                               "transport, falling back early"), waited, True
+        else:
+            # a fast failure means the transport ANSWERED — only
+            # CONSECUTIVE hangs are the wedge signature (BENCH_r04 was 8
+            # in a row), so the count and the shortened confirm window
+            # both reset: a later slow-backend probe gets the full
+            # window again instead of being miscounted as hang #2
+            hung = 0
         if waited + retry_delay >= budget:
-            return False, info, waited
+            # budget exhaustion is NOT a wedge verdict: a hang followed by
+            # fast failures means the transport answered again — only the
+            # hung-probe cap above may stamp the structured marker
+            return False, info, waited, False
         time.sleep(retry_delay)
 
 
@@ -839,17 +861,29 @@ def bench_gpt2_medium():
     """GPT-2 medium (355M): the MFU-scaling showcase — the 124M flagship
     is overhead-bound (small matmuls); at 355M the same engine should
     clear 50% MFU.  No reference-baseline row (vs_baseline keys on the
-    same 64-TFLOPS anchor for cross-size comparability)."""
+    same 64-TFLOPS anchor for cross-size comparability).
+
+    remat=True since the round-5 OOM (ResourceExhausted in the optimizer
+    apply, session_r5/row_gpt2_medium): fp32 master+moments ~4.3 GB +
+    bf16 params/grads ~1.4 GB leave no room for 24 layers of un-rematted
+    B8 S1024 activations next to the apply working set on a 16 GB chip."""
     return bench_gpt2(metric="gpt2_355m_train_tokens_per_sec_1chip",
-                      hidden=1024, layers=24, heads=16)
+                      hidden=1024, layers=24, heads=16, remat=True)
 
 
 def bench_gpt2_large():
-    """GPT-2 large (774M) with remat: fp32 master+moments ~9.3 GB +
-    bf16 params/grads ~3.1 GB under ZeRO-2 on one 16 GB chip — the
-    single-chip memory-discipline showcase."""
+    """GPT-2 large (774M) with remat: fp32 master+moments ~9.3 GB under
+    ZeRO-2 on one 16 GB chip — the single-chip memory-discipline
+    showcase.
+
+    batch=4 + grads_in_compute_dtype since the round-5 OOM at B=8
+    (ResourceExhausted in the optimizer apply, session_r5/
+    row_gpt2_large): bf16 grad buffers halve the ~3.1 GB bf16
+    params+grads tier and the smaller batch halves the rematted
+    activation floor, fitting the apply working set."""
     return bench_gpt2(metric="gpt2_774m_train_tokens_per_sec_1chip",
-                      hidden=1280, layers=36, heads=20, remat=True)
+                      hidden=1280, layers=36, heads=20, remat=True,
+                      batch=4, grads_half=True)
 
 
 BENCHES = {"gpt2": bench_gpt2, "smoke": bench_smoke,
@@ -907,29 +941,34 @@ def main():
     # interleaving path can produce two (or zero) lines.
     emit_lock = threading.RLock()
 
-    def _diag(reason):
+    def _diag(reason, wedged=False):
         with emit_lock:
             if finished.is_set():
                 return
             finished.set()
             metric, unit = METRIC_NAMES[args.config]
-            _emit(_failure_payload(metric, unit, reason))
+            _emit(_failure_payload(metric, unit, reason, wedged))
 
-    def _failure_payload(metric, unit, reason):
+    def _failure_payload(metric, unit, reason, wedged=False):
         # Degrade to the last on-chip measurement (labeled stale), never
         # to an information-free 0.0.
         stale = _last_measured(metric)
         if stale is None:
-            return {"metric": metric, "value": 0.0, "unit": unit,
-                    "vs_baseline": 0.0, "error": reason}
-        payload = dict(stale)
-        payload["stale"] = True
-        payload["stale_source"] = payload.pop("_source")
-        # provenance comes from the ROW; a row without a commit stamp
-        # stays unknown — stamping the current HEAD would claim this
-        # commit achieves a number measured under an older one
-        payload["stale_commit"] = payload.pop("commit", None)
-        payload["error"] = reason
+            payload = {"metric": metric, "value": 0.0, "unit": unit,
+                       "vs_baseline": 0.0, "error": reason}
+        else:
+            payload = dict(stale)
+            payload["stale"] = True
+            payload["stale_source"] = payload.pop("_source")
+            # provenance comes from the ROW; a row without a commit stamp
+            # stays unknown — stamping the current HEAD would claim this
+            # commit achieves a number measured under an older one
+            payload["stale_commit"] = payload.pop("commit", None)
+            payload["error"] = reason
+        if wedged:
+            # structured wedge marker: consumers (watchers, VERDICT
+            # tooling) key on this instead of grepping the error text
+            payload["wedge_reason"] = "stale TPU claim / wedged transport"
         return payload
 
     def _kill_probe():
@@ -970,11 +1009,11 @@ def main():
     margin = float(os.environ.get("DS_BENCH_RUN_MARGIN", 600))
     slot_wait = 0.0
     if not os.environ.get("DS_BENCH_SKIP_PROBE"):
-        ok, info, slot_wait = _await_tpu_slot(
+        ok, info, slot_wait, wedged = _await_tpu_slot(
             budget=max(60.0, watchdog_s - margin))
         if not ok:
             _diag(f"TPU slot never became usable after {slot_wait:.0f}s of "
-                  f"probing (last: {info})")
+                  f"probing (last: {info})", wedged=wedged)
             sys.exit(0)
         print(f"[bench] slot ok after {slot_wait:.0f}s: {info}",
               file=sys.stderr, flush=True)

@@ -1,0 +1,69 @@
+"""JAX version compatibility shims.
+
+The codebase targets the current stable JAX API; older installs (0.4.x)
+still ship some of it under experimental names.  Everything here is
+additive — an attribute is only installed when the running JAX lacks it,
+so on a current JAX this module is a no-op.
+
+``jax.shard_map``: promoted from ``jax.experimental.shard_map`` with two
+keyword renames — ``check_vma`` (new) == ``check_rep`` (old), and the
+new ``axis_names={...manual...}`` selects the manual subset where the
+old API took the complement ``auto={...}``.
+"""
+
+import jax
+
+
+def _install_shard_map() -> None:
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  axis_names=None, check_vma=None, check_rep=None,
+                  auto=None):
+        if auto is None:
+            auto = (frozenset(mesh.axis_names) - frozenset(axis_names)
+                    if axis_names is not None else frozenset())
+        if check_rep is None:
+            check_rep = True if check_vma is None else bool(check_vma)
+        return _legacy(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_rep=check_rep,
+                       auto=auto)
+
+    jax.shard_map = shard_map
+
+
+def _install_name_replication_rule() -> None:
+    """0.4.x shard_map ships no replication rule for ad_checkpoint's
+    ``name`` primitive (checkpoint_name), so a check_rep=True region that
+    tags residuals dies with ``No replication rule for name``.  ``name``
+    is identity on its operand, so the standard pass-through check and
+    rewrite are exact."""
+    try:
+        from jax.experimental import shard_map as smod
+        from jax._src.ad_checkpoint import name_p
+    except Exception:  # noqa: BLE001 — layout moved; newer jax needs no fix
+        return
+    rules = getattr(smod, "_check_rules", None)
+    if rules is None or name_p in rules:
+        return
+    smod.register_standard_check(name_p)
+    smod.register_standard_rewrite(name_p)
+
+
+def _install_axis_size() -> None:
+    if hasattr(jax.lax, "axis_size"):
+        return
+
+    def axis_size(axis_name):
+        """Static size of a named manual axis: a psum of the python
+        literal 1 constant-folds to a concrete int under shard_map."""
+        return jax.lax.psum(1, axis_name)
+
+    jax.lax.axis_size = axis_size
+
+
+_install_shard_map()
+_install_name_replication_rule()
+_install_axis_size()

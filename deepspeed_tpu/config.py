@@ -144,6 +144,56 @@ class OffloadOptimizerConfig:
 
 
 @dataclass
+class ZeroLowBandwidthConfig:
+    """ZeRO++-style low-bandwidth collectives (arXiv:2306.10209).
+
+    qwz_bits: blockwise-quantized weight all-gather width (0=off, 4, 8).
+    qgz_bits: quantized gradient reduce-scatter width (0=off, 4, 8) —
+        int4 rides the wire packed two-per-byte.
+    hpz_group_size: size of the sub-mesh holding the secondary weight
+        partition (0/1 = off); must equal the product of a suffix of the
+        ZeRO mesh axes (partition.resolve_hpz_axes).
+    block_size: elements per quantization block (scale granularity).
+    """
+    qwz_bits: int = C.LOW_BANDWIDTH_QWZ_BITS_DEFAULT
+    qgz_bits: int = C.LOW_BANDWIDTH_QGZ_BITS_DEFAULT
+    hpz_group_size: int = C.LOW_BANDWIDTH_HPZ_GROUP_SIZE_DEFAULT
+    block_size: int = C.LOW_BANDWIDTH_BLOCK_SIZE_DEFAULT
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.qwz_bits or self.qgz_bits or
+                    self.hpz_group_size > 1)
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "ZeroLowBandwidthConfig":
+        d = d or {}
+        cfg = ZeroLowBandwidthConfig(
+            qwz_bits=int(get_scalar_param(d, C.LOW_BANDWIDTH_QWZ_BITS,
+                                          C.LOW_BANDWIDTH_QWZ_BITS_DEFAULT)),
+            qgz_bits=int(get_scalar_param(d, C.LOW_BANDWIDTH_QGZ_BITS,
+                                          C.LOW_BANDWIDTH_QGZ_BITS_DEFAULT)),
+            hpz_group_size=int(get_scalar_param(
+                d, C.LOW_BANDWIDTH_HPZ_GROUP_SIZE,
+                C.LOW_BANDWIDTH_HPZ_GROUP_SIZE_DEFAULT)),
+            block_size=int(get_scalar_param(
+                d, C.LOW_BANDWIDTH_BLOCK_SIZE,
+                C.LOW_BANDWIDTH_BLOCK_SIZE_DEFAULT)),
+        )
+        for name, bits in ((C.LOW_BANDWIDTH_QWZ_BITS, cfg.qwz_bits),
+                           (C.LOW_BANDWIDTH_QGZ_BITS, cfg.qgz_bits)):
+            if bits not in (0, 4, 8):
+                raise DeepSpeedConfigError(
+                    f"zero_optimization.low_bandwidth.{name}={bits} — "
+                    "supported widths are 0 (off), 4, and 8")
+        if cfg.block_size < 1:
+            raise DeepSpeedConfigError(
+                "zero_optimization.low_bandwidth.block_size must be >= 1, "
+                f"got {cfg.block_size}")
+        return cfg
+
+
+@dataclass
 class ZeroConfig:
     """Reference: deepspeed/runtime/zero/config.py:18 (DeepSpeedZeroConfig)."""
     stage: int = C.ZERO_OPTIMIZATION_STAGE_DEFAULT
@@ -169,6 +219,8 @@ class ZeroConfig:
     elastic_checkpoint: bool = C.ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT
     cpu_offload: bool = C.ZERO_OPTIMIZATION_CPU_OFFLOAD_DEFAULT
     cpu_offload_params: bool = C.ZERO_OPTIMIZATION_CPU_OFFLOAD_PARAMS_DEFAULT
+    low_bandwidth: ZeroLowBandwidthConfig = field(
+        default_factory=ZeroLowBandwidthConfig)
 
     @staticmethod
     def from_dict(d: Optional[Dict[str, Any]]) -> "ZeroConfig":
@@ -249,6 +301,8 @@ class ZeroConfig:
                 C.ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT),
             cpu_offload=cpu_offload,
             cpu_offload_params=cpu_offload_params,
+            low_bandwidth=ZeroLowBandwidthConfig.from_dict(
+                d.get(C.ZERO_OPTIMIZATION_LOW_BANDWIDTH)),
         )
 
 

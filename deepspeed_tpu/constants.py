@@ -215,6 +215,19 @@ ZERO_OPTIMIZATION_LEGACY_STAGE1_DEFAULT = False
 ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT = "elastic_checkpoint"
 ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT = True
 
+# ZeRO++-style low-bandwidth collectives (arXiv:2306.10209;
+# runtime/comm/low_bandwidth.py).  Each knob is independently off by
+# default; bits are 0 (off), 4, or 8.
+ZERO_OPTIMIZATION_LOW_BANDWIDTH = "low_bandwidth"
+LOW_BANDWIDTH_QWZ_BITS = "qwz_bits"            # quantized weight all-gather
+LOW_BANDWIDTH_QWZ_BITS_DEFAULT = 0
+LOW_BANDWIDTH_QGZ_BITS = "qgz_bits"            # quantized grad reduce-scatter
+LOW_BANDWIDTH_QGZ_BITS_DEFAULT = 0
+LOW_BANDWIDTH_HPZ_GROUP_SIZE = "hpz_group_size"  # secondary-partition size
+LOW_BANDWIDTH_HPZ_GROUP_SIZE_DEFAULT = 0
+LOW_BANDWIDTH_BLOCK_SIZE = "block_size"        # quantization block elements
+LOW_BANDWIDTH_BLOCK_SIZE_DEFAULT = 256
+
 #############################################
 # Offload (reference: runtime/zero/offload_constants.py)
 #############################################

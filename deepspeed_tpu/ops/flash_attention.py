@@ -508,9 +508,13 @@ def flash_attention_pallas(q, k, v, causal: bool = False,
                 "save_dropout_mask requires return_lse and dropout_rate > 0")
         if not _mask_reuse_usable(block_q):
             raise ValueError(
-                f"save_dropout_mask: resolved q block {block_q} is not a "
-                "multiple of 256 (packed-tile sublane alignment) — use the "
-                "regen path")
+                f"save_dropout_mask: q_len={q_len} resolved a q block of "
+                f"{block_q}, which is not a multiple of 256 (the packed "
+                "mask tile needs sublane dim block_q/32 % 8 == 0).  Fix: "
+                "pick a block_q whose resolved divisor of q_len is a "
+                "multiple of 256 (TransformerConfig.block_q / the block_q "
+                "argument), or stay on the regen path by disabling reuse "
+                "(set_dropout_mask_reuse(False) / DS_DROPOUT_REUSE=0)")
     kernel = functools.partial(
         _fa_kernel, causal=causal, sm_scale=float(sm_scale),
         block_q=block_q, block_k=block_k, num_k_blocks=nk,
@@ -770,17 +774,30 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
 
     reuse = dropout_mask is not None
     if reuse:
-        if not (dropout_rate > 0.0 and _mask_reuse_usable(block_q)):
+        if not dropout_rate > 0.0:
             raise ValueError(
-                "dropout_mask given but dropout_rate == 0 or resolved q "
-                f"block {block_q} is not reuse-capable — fwd/bwd mode "
-                "mismatch")
+                f"dropout_mask given but dropout_rate={dropout_rate} — a "
+                "mask only applies to a dropout backward.  Fix: pass the "
+                "forward's dropout_rate, or drop the dropout_mask argument")
+        if not _mask_reuse_usable(block_q):
+            raise ValueError(
+                f"dropout_mask given but this backward resolved q block "
+                f"{block_q} (from q_len={q_len}, requested block_q), which "
+                "is not a multiple of 256 — the forward could not have "
+                "packed a mask at this block.  Fix: use the same block_q "
+                "in forward and backward (TransformerConfig.block_q), or "
+                "disable reuse (set_dropout_mask_reuse(False) / "
+                "DS_DROPOUT_REUSE=0) so both sides regen from the PRNG")
         if dropout_mask_block_q != block_q:
             raise ValueError(
                 f"dropout_mask was packed with resolved block_q="
                 f"{dropout_mask_block_q}, but this backward resolved "
                 f"block_q={block_q} — the packed bit layout depends on the "
-                "forward's q block, so the grads would be silently wrong")
+                "forward's q block, so the grads would be silently wrong.  "
+                "Fix: pass dropout_mask_block_q=<the forward's resolved "
+                "block> and call with the forward's block_q (the "
+                "flash_attention custom_vjp does this automatically; "
+                "manual callers must thread it through)")
     mask_in = (dropout_mask,) if reuse else ()
 
     # dk/dv: grid over k blocks (grid dim 2), inner loop over q blocks

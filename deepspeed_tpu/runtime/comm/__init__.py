@@ -1,0 +1,7 @@
+from .compressed import compressed_allreduce, compressed_allreduce_inner
+from .low_bandwidth import (as_quantized_weight, blockwise_dequantize,
+                            blockwise_quantize, init_error_feedback,
+                            low_bandwidth_all_gather, qgz_reduce_scatter,
+                            qgz_reduce_scatter_inner,
+                            quantized_gather_saves_bytes,
+                            quantized_psum_scatter)

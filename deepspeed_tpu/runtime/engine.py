@@ -170,14 +170,30 @@ class DeepSpeedEngine:
         # consumed for real (reference: stage3.py:294
         # PartitionedParameterCoordinator; see zero/stage3_streaming.py).
         self._zero3_stream = None
+        lbc = self.config.zero_config.low_bandwidth
+        if lbc.enabled and stage < 3:
+            logger.warning(
+                "zero_optimization.low_bandwidth is configured but ZeRO "
+                f"stage is {stage} — qwZ/qgZ/hpZ only apply to the stage-3 "
+                "explicit streaming path and will be ignored")
         if stage >= 3 and hasattr(model, "install_zero3_streaming"):
             from .zero.stage3_streaming import Zero3StreamContext
+            # Validation happens in the context: an hpz_group_size that
+            # does not align with the mesh's ZeRO axes raises here, at
+            # engine build, with the valid sizes listed.
             self._zero3_stream = Zero3StreamContext(
                 self.mesh_ctx,
                 self.config.zero_config.max_live_parameters,
                 self.config.zero_config.prefetch_bucket_size,
-                self.config.zero_config.param_persistence_threshold)
+                self.config.zero_config.param_persistence_threshold,
+                low_bandwidth=lbc if lbc.enabled else None)
             model.install_zero3_streaming(self._zero3_stream)
+        elif lbc.enabled and stage >= 3:
+            logger.warning(
+                "zero_optimization.low_bandwidth is configured but the "
+                "model does not expose install_zero3_streaming — qwZ/qgZ/"
+                "hpZ only apply to the explicit streaming path and will "
+                "be ignored")
 
         # ZeRO-Offload: optimizer states (and the fp32 master) live in host
         # DRAM, stepped by the native host Adam; the device holds only

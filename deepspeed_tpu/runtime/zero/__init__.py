@@ -1,4 +1,5 @@
-from .partition import ZeroPartitioner, zero_partition_spec
+from .partition import (ZeroPartitioner, resolve_hpz_axes,
+                        zero_partition_spec)
 from .api import GatheredParameters, Init
 from .offload import HostOffloadOptimizer
 from .tiling import TiledLinear

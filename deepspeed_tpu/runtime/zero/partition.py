@@ -74,6 +74,51 @@ def zero_partition_spec(shape: Tuple[int, ...], axis_sizes: dict,
     return PartitionSpec(*existing_parts)
 
 
+def filter_spec_axes(spec: PartitionSpec, keep) -> PartitionSpec:
+    """Keep only the axis names of ``spec`` for which ``keep(axis)`` is
+    true, collapsing emptied entries to None and singleton tuples to
+    scalars.  Shared by stage3_streaming's manual-axes restriction and
+    the hpZ secondary-partition outer-axis strip below."""
+    parts = []
+    for entry in spec:
+        if entry is None:
+            parts.append(None)
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        kept = tuple(a for a in axes if keep(a))
+        parts.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+    return PartitionSpec(*parts)
+
+
+def resolve_hpz_axes(axis_sizes: dict, group_size: int) -> Tuple[str, ...]:
+    """hpZ (ZeRO++ hierarchical partitioning): resolve the sub-mesh that
+    holds the secondary weight copy.
+
+    The secondary partition must be a contiguous INNER slice of the ZeRO
+    axes (innermost axes ride the fastest links — mesh.py's ICI-aware
+    ordering), so ``group_size`` has to equal the product of a suffix of
+    ``ZERO_AXES`` sizes.  Returns that suffix; raises with the valid
+    sizes otherwise.  The reference knob is ``zero_hpz_partition_size``
+    (ZeRO++ §hpZ); here the group is expressed in mesh axes rather than
+    a rank count so the sharding layer stays declarative.
+    """
+    group_size = int(group_size)
+    sizes = [int(axis_sizes.get(a, 1)) for a in ZERO_AXES]
+    valid = {1: ()}  # group 1 == fully replicated secondary (empty suffix)
+    prod = 1
+    for i in range(len(ZERO_AXES) - 1, -1, -1):
+        prod *= sizes[i]
+        valid[prod] = tuple(ZERO_AXES[i:])
+    if group_size in valid:
+        return tuple(a for a in valid[group_size]
+                     if axis_sizes.get(a, 1) > 1)
+    raise ValueError(
+        f"hpz_group_size={group_size} does not match a suffix of the "
+        f"ZeRO axes {dict(zip(ZERO_AXES, sizes))} — valid sizes here: "
+        f"{sorted(valid)} (the secondary partition must align with whole "
+        "inner mesh axes)")
+
+
 def _leaf_shape(leaf) -> Tuple[int, ...]:
     return tuple(getattr(leaf, "shape", ()) or ())
 
@@ -167,6 +212,39 @@ class ZeroPartitioner:
                 return NamedSharding(self.ctx.mesh, spec_by_shape[shp])
             return NamedSharding(self.ctx.mesh, PartitionSpec())
         return jax.tree.map(one, opt_state)
+
+    # -- hpZ secondary partition -------------------------------------- #
+    def secondary_shardings(self, params: Any, hpz_group_size: int,
+                            base_specs: Any = None):
+        """NamedSharding tree for the hpZ SECONDARY weight copy: sharded
+        only within the ``hpz_group_size`` sub-mesh (a suffix of the ZeRO
+        axes, resolve_hpz_axes), replicated across the slow outer axes.
+
+        Hot-loop weight all-gathers against this copy never cross the
+        slow mesh dimension (ZeRO++ hpZ; Frontier low-bandwidth
+        partitioning).  Gradients and optimizer state keep the PRIMARY
+        partition — only forward/backward weight gathers read the
+        secondary copy."""
+        hpz_axes = resolve_hpz_axes(self.axis_sizes, hpz_group_size)
+        sub_sizes = {a: (self.axis_sizes.get(a, 1) if a in hpz_axes else 1)
+                     for a in ZERO_AXES}
+        # zero_partition_spec names EVERY unused ZeRO axis in the spec it
+        # builds (harmless when an axis is truly size 1) — but here the
+        # outer axes are live mesh axes the secondary copy must NOT shard
+        # over, so strip them from the produced specs.
+        drop = frozenset(ZERO_AXES) - frozenset(hpz_axes)
+
+        def _strip(spec: PartitionSpec) -> PartitionSpec:
+            return filter_spec_axes(spec, lambda a: a not in drop)
+
+        base_list = iter(self._aligned_base_list(params, base_specs))
+
+        def one(leaf):
+            base = next(base_list)
+            spec = zero_partition_spec(_leaf_shape(leaf), sub_sizes,
+                                       self.persistence_threshold, base)
+            return NamedSharding(self.ctx.mesh, _strip(spec))
+        return jax.tree.map(one, params)
 
     def _zspec_force(self, shape, existing=None) -> PartitionSpec:
         """Optimizer-state sharding ignores the stage-3 persistence threshold:

@@ -49,7 +49,11 @@ from jax.sharding import PartitionSpec
 
 from ...parallel.mesh import MeshContext, ZERO_AXES
 from ...utils.logging import log_dist
-from .partition import zero_partition_spec
+from ..comm.low_bandwidth import (f32_psum_scatter, largest_divisor_at_most,
+                                  low_bandwidth_all_gather,
+                                  quantized_gather_saves_bytes)
+from .partition import (filter_spec_axes, resolve_hpz_axes,
+                        zero_partition_spec)
 
 
 @dataclass(frozen=True)
@@ -65,14 +69,6 @@ class StreamPlan:
         """Worst-case simultaneously-gathered parameter count."""
         mult = 2 if self.prefetch else 1
         return mult * self.layers_per_step * self.params_per_layer
-
-
-def _largest_divisor_at_most(n: int, bound: int) -> int:
-    bound = max(1, min(n, bound))
-    for g in range(bound, 0, -1):
-        if n % g == 0:
-            return g
-    return 1
 
 
 def plan_layer_streaming(num_layers: int, params_per_layer: int,
@@ -101,7 +97,7 @@ def plan_layer_streaming(num_layers: int, params_per_layer: int,
             return StreamPlan(layers_per_step=max(candidates), prefetch=True,
                               num_layers=num_layers,
                               params_per_layer=params_per_layer)
-    g = _largest_divisor_at_most(num_layers, base_budget)
+    g = largest_divisor_at_most(num_layers, base_budget)
     return StreamPlan(layers_per_step=g, prefetch=False,
                       num_layers=num_layers, params_per_layer=params_per_layer)
 
@@ -147,15 +143,7 @@ def _restrict_to_manual(spec: PartitionSpec, manual: frozenset
                         ) -> PartitionSpec:
     """Strip non-manual axes from a spec (shard_map in_specs may only name
     manual axes; auto axes ride along on the array sharding)."""
-    parts = []
-    for entry in spec:
-        if entry is None:
-            parts.append(None)
-            continue
-        axes = entry if isinstance(entry, tuple) else (entry,)
-        kept = tuple(a for a in axes if a in manual)
-        parts.append(kept if len(kept) > 1 else (kept[0] if kept else None))
-    return PartitionSpec(*parts)
+    return filter_spec_axes(spec, manual.__contains__)
 
 
 def _gather_dims(spec: PartitionSpec, manual: frozenset):
@@ -193,13 +181,7 @@ def _ag_fwd(x, axes, dim):
 
 
 def _ag_bwd(axes, dim, _, g):
-    half = (jnp.issubdtype(g.dtype, jnp.floating) and
-            jnp.dtype(g.dtype).itemsize < 4)
-    if half:
-        shard = lax.psum_scatter(g.astype(jnp.float32), axes,
-                                 scatter_dimension=dim, tiled=True)
-        return (shard.astype(g.dtype),)
-    return (lax.psum_scatter(g, axes, scatter_dimension=dim, tiled=True),)
+    return (f32_psum_scatter(g, axes, dim),)
 
 
 _all_gather_f32grad.defvjp(_ag_fwd, _ag_bwd)
@@ -216,7 +198,8 @@ class Zero3StreamContext:
 
     def __init__(self, mesh_ctx: MeshContext, max_live_parameters: int,
                  prefetch_bucket_size: int,
-                 persistence_threshold: int = 0):
+                 persistence_threshold: int = 0,
+                 low_bandwidth=None):
         self.ctx = mesh_ctx
         self.max_live_parameters = int(max_live_parameters)
         self.prefetch_bucket_size = int(prefetch_bucket_size)
@@ -225,6 +208,21 @@ class Zero3StreamContext:
         self.manual = frozenset(
             a for a in ZERO_AXES if mesh_ctx.axis_size(a) > 1)
         self._plan_logged = False
+        # ZeRO++-style low-bandwidth collectives (config.py
+        # ZeroLowBandwidthConfig; comm/low_bandwidth.py): qwZ quantizes
+        # the weight gathers, qgZ the grad reduce-scatters, hpZ confines
+        # the hot-loop gathers to a sub-mesh via a secondary partition.
+        self.lbc = (low_bandwidth if low_bandwidth is not None and
+                    getattr(low_bandwidth, "enabled", False) else None)
+        self.param_manual = self.manual
+        self.param_axis_sizes = dict(self.axis_sizes)
+        if self.lbc is not None and self.lbc.hpz_group_size > 1:
+            hpz = resolve_hpz_axes(self.axis_sizes,
+                                   self.lbc.hpz_group_size)
+            self.param_manual = frozenset(hpz) & self.manual
+            self.param_axis_sizes = {
+                a: (self.axis_sizes[a] if a in self.param_manual else 1)
+                for a in ZERO_AXES}
 
     @property
     def active(self) -> bool:
@@ -280,11 +278,50 @@ class Zero3StreamContext:
         per-layer so the stream always shards within a layer and never
         across the layer axis (a layer-axis shard could not be gathered
         one group at a time).  When the engine's stacked-tree placement
-        picked a different dim, shard_map simply reshards at entry."""
+        picked a different dim, shard_map simply reshards at entry.
+
+        With hpZ on, ``param_axis_sizes`` confines the spec to the
+        sub-mesh axes: the region entry reshard materializes the
+        SECONDARY weight copy (one gather over the slow axes for the
+        whole grouped stack, amortized across the scan — ZeRO++ hpZ's
+        secondary allocation), and every hot-loop gather below stays
+        within the fast sub-mesh."""
         tp_inner = (PartitionSpec(*list(tp_spec)[1:])
                     if tp_spec is not None else None)
-        return zero_partition_spec(tuple(leaf.shape[1:]), self.axis_sizes,
+        return zero_partition_spec(tuple(leaf.shape[1:]),
+                                   self.param_axis_sizes,
                                    self.persistence_threshold, tp_inner)
+
+    def _leaf_wire_bits(self, leaf, dim):
+        """Per-leaf, per-direction quantization decision ``(qwz, qgz)``:
+        a direction keeps its configured bits only when the narrowed
+        payload actually beats the wire it replaces — a skinny leaf
+        (bias gathered one layer at a time) would pay more in fp32
+        block scales than it saves, so it degrades to 0 (dense) per
+        direction.  The forward compares against the leaf's native
+        width; the backward against fp32, because that is what the
+        dense fallback's reduce-scatter moves for every float dtype
+        (f32_psum_scatter promotes half grads)."""
+        lbc = self.lbc
+        if lbc is None or not jnp.issubdtype(leaf.dtype, jnp.floating):
+            return 0, 0
+        qwz = lbc.qwz_bits if (lbc.qwz_bits and quantized_gather_saves_bytes(
+            leaf.shape, dim, leaf.dtype, lbc.qwz_bits, lbc.block_size)
+        ) else 0
+        qgz = lbc.qgz_bits if (lbc.qgz_bits and quantized_gather_saves_bytes(
+            leaf.shape, dim, jnp.float32, lbc.qgz_bits, lbc.block_size)
+        ) else 0
+        return qwz, qgz
+
+    def _gather_leaf(self, leaf, axes, dim):
+        """One tiled all-gather: quantized wire per direction when it
+        pays (``_leaf_wire_bits``), the fp32-transpose gather
+        otherwise."""
+        qwz, qgz = self._leaf_wire_bits(leaf, dim)
+        if qwz or qgz:
+            return low_bandwidth_all_gather(leaf, axes, dim, qwz, qgz,
+                                            self.lbc.block_size)
+        return _all_gather_f32grad(leaf, axes, dim)
 
     def plan_for(self, stacked_params: Any) -> StreamPlan:
         leaves = jax.tree.leaves(stacked_params)
@@ -318,11 +355,20 @@ class Zero3StreamContext:
 
         plan = self.plan_for(stacked_params)
         if not self._plan_logged:
+            lb = ""
+            if self.lbc is not None:
+                # key off the CONFIG, not param_manual == manual: a
+                # group size equal to the full ZeRO world is a
+                # configured (degenerate) hpZ, not "off"
+                hpz = (sorted(self.param_manual)
+                       if self.lbc.hpz_group_size > 1 else "off")
+                lb = (f", low_bandwidth: qwz={self.lbc.qwz_bits}b "
+                      f"qgz={self.lbc.qgz_bits}b hpz={hpz}")
             log_dist(
                 f"ZeRO-3 streaming: {plan.num_layers} layers in groups of "
                 f"{plan.layers_per_step}, prefetch={plan.prefetch}, "
                 f"live<= {plan.live_parameters:,} params "
-                f"(max_live={self.max_live_parameters:,})", ranks=[0])
+                f"(max_live={self.max_live_parameters:,}){lb}", ranks=[0])
             self._plan_logged = True
 
         mesh = self.ctx.mesh
@@ -339,22 +385,29 @@ class Zero3StreamContext:
         p_leaves, p_tree = jax.tree_util.tree_flatten(stacked_params)
         if len(tp_list) != len(p_leaves):
             raise ValueError("param_tp_specs must mirror stacked_params")
+        p_manual = self.param_manual  # == manual unless hpZ restricts it
         inner_specs = [self._per_layer_zero_spec(l, s)
                        for l, s in zip(p_leaves, tp_list)]
         in_param_specs = [
-            PartitionSpec(None, *list(_restrict_to_manual(s, manual)))
+            PartitionSpec(None, *list(_restrict_to_manual(s, p_manual)))
             for s in inner_specs]
-        gathers = [_gather_dims(s, manual) for s in inner_specs]
-        # A leaf not sharded over EVERY manual axis enters the region
+        gathers = [_gather_dims(s, p_manual) for s in inner_specs]
+        # A leaf not gathered over EVERY manual axis enters the region
         # replicated along the uncovered axes, so its gradient is a psum
         # over those axes at the shard_map transpose boundary.  Such
         # half-precision leaves are widened to fp32 at entry (cast back to
         # their dtype at use) so that psum accumulates in fp32 — matching
         # _all_gather_f32grad's fp32 reduce-scatter for the gathered dims,
         # and keeping every reduction collective the region emits out of
-        # XLA-CPU's half-precision AllReducePromotion abort.  Leaves with
-        # uncovered axes are the ones too small to shard further, so the
-        # widened transfer is noise.
+        # XLA-CPU's half-precision AllReducePromotion abort.  Without hpZ
+        # the uncovered leaves are the ones too small to shard further, so
+        # the widened transfer is noise.  With hpZ EVERY leaf is uncovered
+        # by design (gathers stop at param_manual; the slow outer axes
+        # reduce grads once at the boundary) — the fp32 widening then
+        # doubles the once-per-step entry reshard, a deliberate trade: the
+        # hot-loop per-layer gathers, which hpZ is buying back, stay at
+        # the quantized/native width, and the boundary grad psum must be
+        # fp32 anyway (accumulation + the XLA-CPU abort above).
         leaf_dtypes = [l.dtype for l in p_leaves]
 
         def _covered_axes(dims):
@@ -400,7 +453,7 @@ class Zero3StreamContext:
             for leaf, dims, dt, w in zip(shards, gathers, leaf_dtypes,
                                          widen):
                 for dim, axes in dims:
-                    leaf = _all_gather_f32grad(leaf, axes, dim + 1)
+                    leaf = self._gather_leaf(leaf, axes, dim + 1)
                 if w:
                     leaf = leaf.astype(dt)
                 full.append(checkpoint_name(leaf, "zero3_gathered"))

@@ -159,6 +159,8 @@ _SLOW_PREFIXES = (
     "test_inference.py::test_hf_gpt2_injection_parity",
     "test_inference.py::test_megatron_layer_policy_parity",
     "test_infinity.py::test_host_param_streaming_matches_resident",
+    "test_low_bandwidth.py::test_e2e_hpz_bf16_trains_on_cpu",
+    "test_low_bandwidth.py::test_e2e_hpz_exact_parity_on_two_axis_mesh",
     "test_infinity.py::test_nvme_param_streaming_matches_resident",
     "test_models.py::test_bert_attention_mask_changes_output",
     "test_models.py::test_bert_mlm_loss_ignores_unmasked_positions",

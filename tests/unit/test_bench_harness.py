@@ -54,8 +54,10 @@ def test_await_slot_retries_until_reaped(monkeypatch):
         return True, False, "cpu"
 
     monkeypatch.setattr(bench, "_probe_tpu", fake_probe)
-    ok, info, waited = bench._await_tpu_slot(budget=60, retry_delay=0.05)
+    ok, info, waited, wedged = bench._await_tpu_slot(budget=60,
+                                                     retry_delay=0.05)
     assert ok and calls["n"] == 3
+    assert not wedged
 
 
 def test_await_slot_caps_hung_probes(monkeypatch):
@@ -65,16 +67,25 @@ def test_await_slot_caps_hung_probes(monkeypatch):
     bench window — the loop must give up after max_hung (2) hung probes
     even with budget to spare, while fast failures keep retrying."""
     monkeypatch.delenv("DS_BENCH_MAX_HUNG_PROBES", raising=False)
+    monkeypatch.delenv("DS_BENCH_CONFIRM_PROBE_TIMEOUT", raising=False)
     calls = {"n": 0}
+    timeouts = []
 
     def hung_probe(timeout):
         calls["n"] += 1
+        timeouts.append(timeout)
         return False, True, f"probe hung >{timeout:.0f}s (stale TPU claim?)"
 
     monkeypatch.setattr(bench, "_probe_tpu", hung_probe)
-    ok, info, waited = bench._await_tpu_slot(budget=3600, retry_delay=0.05)
+    ok, info, waited, wedged = bench._await_tpu_slot(budget=3600,
+                                                     retry_delay=0.05)
     assert not ok and calls["n"] == 2
     assert "wedged" in info
+    assert wedged  # structured flag, not stderr sniffing
+    # the stale claim is DETECTED once at the full probe window; the
+    # confirmation probe runs at the short confirm_timeout (fail fast:
+    # ~probe_timeout + confirm_timeout worst case, not 2 full windows)
+    assert timeouts[0] == 180.0 and timeouts[1] == 60.0
     # fast failures (no hang) are NOT capped at 2 — they ride the budget,
     # even when the error text happens to contain the word "hung"
     calls["n"] = 0
@@ -83,23 +94,87 @@ def test_await_slot_caps_hung_probes(monkeypatch):
         lambda timeout: (calls.__setitem__("n", calls["n"] + 1),
                          (False, False,
                           "probe rc=1: remote end hung up unexpectedly"))[1])
-    ok, info, waited = bench._await_tpu_slot(budget=0.5, retry_delay=0.1)
+    ok, info, waited, wedged = bench._await_tpu_slot(budget=0.5,
+                                                     retry_delay=0.1)
     assert not ok and calls["n"] >= 2
+    assert not wedged
     # env override widens the cap
     calls["n"] = 0
     monkeypatch.setenv("DS_BENCH_MAX_HUNG_PROBES", "4")
     monkeypatch.setattr(bench, "_probe_tpu", hung_probe)
-    ok, info, waited = bench._await_tpu_slot(budget=3600, retry_delay=0.05)
-    assert not ok and calls["n"] == 4
+    ok, info, waited, wedged = bench._await_tpu_slot(budget=3600,
+                                                     retry_delay=0.05)
+    assert not ok and calls["n"] == 4 and wedged
 
 
 def test_await_slot_gives_up_at_budget(monkeypatch):
     monkeypatch.setattr(bench, "_probe_tpu",
                         lambda timeout: (False, False, "stale claim"))
     t0 = time.time()
-    ok, info, waited = bench._await_tpu_slot(budget=1.0, retry_delay=0.2)
-    assert not ok
+    ok, info, waited, wedged = bench._await_tpu_slot(budget=1.0,
+                                                     retry_delay=0.2)
+    assert not ok and not wedged
     assert time.time() - t0 < 30
+    # a single early hang followed by fast failures until the budget runs
+    # out is a transport that ANSWERED again — budget exhaustion must not
+    # stamp the wedge verdict (only the hung-probe cap may)
+    monkeypatch.delenv("DS_BENCH_MAX_HUNG_PROBES", raising=False)
+    calls = {"n": 0}
+
+    def hang_then_fast(timeout):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return False, True, "probe hung (transient stall)"
+        return False, False, "probe rc=1: backend busy"
+
+    monkeypatch.setattr(bench, "_probe_tpu", hang_then_fast)
+    ok, info, waited, wedged = bench._await_tpu_slot(budget=0.5,
+                                                     retry_delay=0.1)
+    assert not ok and calls["n"] >= 2
+    assert not wedged
+
+
+def test_await_slot_hang_count_resets_on_fast_failure(monkeypatch):
+    """Only CONSECUTIVE hangs are the wedge signature (BENCH_r04 was 8 in
+    a row): a fast failure between two hangs proves the transport
+    answered, so the hang count AND the shortened confirm window both
+    reset — two unrelated transient stalls across a long budget must not
+    stamp the wedge verdict."""
+    monkeypatch.delenv("DS_BENCH_MAX_HUNG_PROBES", raising=False)
+    monkeypatch.delenv("DS_BENCH_CONFIRM_PROBE_TIMEOUT", raising=False)
+    calls = {"n": 0}
+    timeouts = []
+
+    def alternating(timeout):
+        calls["n"] += 1
+        timeouts.append(timeout)
+        if calls["n"] % 2 == 1:
+            return False, True, "probe hung (transient stall)"
+        return False, False, "probe rc=1: backend busy"
+
+    class FakeTime:
+        # fake clock: keeps `remaining` above the probe window so the
+        # min(limit, max(30, remaining)) clamp doesn't mask which window
+        # the loop picked, without sleeping for real
+        def __init__(self):
+            self.t = 0.0
+
+        def time(self):
+            return self.t
+
+        def sleep(self, s):
+            self.t += s
+
+    monkeypatch.setattr(bench, "_probe_tpu", alternating)
+    monkeypatch.setattr(bench, "time", FakeTime())
+    ok, info, waited, wedged = bench._await_tpu_slot(budget=1000.0,
+                                                     retry_delay=30.0)
+    assert not ok and not wedged
+    assert calls["n"] >= 4  # two non-consecutive hangs rode the budget
+    # after the fast failure resets the count, the window is FULL again
+    # (a slow-but-alive backend probe is not miscounted as hang #2)
+    assert timeouts[0] == 180.0 and timeouts[1] == 60.0
+    assert timeouts[2] == 180.0 and timeouts[3] == 60.0
 
 
 def test_sigterm_emits_one_diagnostic_json_line():
@@ -144,6 +219,37 @@ def test_sigterm_emits_one_diagnostic_json_line():
     assert payload["stale_commit"] == "abc1234"
     assert payload["stale_source"] == ladder.name  # the file actually read
     assert "signal" in payload["error"]
+
+
+def test_wedged_slot_marks_payload(tmp_path):
+    """A wedged-transport slot failure (hung probes exhausted) stamps the
+    structured `wedge_reason` marker on the one emitted JSON line, so
+    watchers key on a field instead of grepping the error text."""
+    script = (
+        "import sys\n"
+        "import bench\n"
+        "bench._probe_tpu = lambda timeout: (False, True, 'probe hung')\n"
+        # skip only the short retry_delay sleeps; the watchdog thread's
+        # giant sleep must stay real or it wins the emission race
+        "_sleep = bench.time.sleep\n"
+        "bench.time.sleep = lambda s: None if s < 600 else _sleep(s)\n"
+        "sys.argv = ['bench.py', '--config', 'gpt2']\n"
+        "bench.main()\n"
+    )
+    env = dict(os.environ)
+    env.pop("DS_BENCH_MAX_HUNG_PROBES", None)
+    env.pop("DS_BENCH_SKIP_PROBE", None)
+    env["DS_BENCH_WATCHDOG"] = str(10 ** 9)
+    env["DS_BENCH_LADDER"] = str(tmp_path / "missing.jsonl")
+    out = subprocess.run([sys.executable, "-c", script], cwd=str(REPO),
+                         capture_output=True, text=True, timeout=120,
+                         env=env)
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, out.stdout + out.stderr
+    payload = json.loads(lines[0])
+    assert payload["wedge_reason"] == "stale TPU claim / wedged transport"
+    assert "hung probes" in payload["error"]
+    assert payload["value"] == 0.0  # no ladder file -> diagnostic row
 
 
 def test_last_measured_picks_latest_tpu_row(tmp_path, monkeypatch):

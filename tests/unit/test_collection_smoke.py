@@ -1,0 +1,38 @@
+"""Collect-only smoke check: the tier-1 command runs with
+``--continue-on-collection-errors``, so an ImportError in one test module
+silently shrinks the suite instead of failing it.  This test makes any
+collection error loud: it re-collects the unit suite WITHOUT that flag
+(collection errors -> nonzero rc) and sanity-checks the collected count
+so a mass-deselection regression can't hide either.  ~5 s, fast lane."""
+
+import os
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+
+# floor well under the current count (458 at introduction) but high
+# enough that losing a whole module to an import error trips it
+MIN_COLLECTED = 400
+
+
+def test_unit_suite_collects_cleanly():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/unit", "--collect-only",
+         "-q", "-p", "no:cacheprovider"],
+        cwd=str(REPO), capture_output=True, text=True, timeout=300,
+        env=env)
+    tail = "\n".join(out.stdout.splitlines()[-25:])
+    assert out.returncode == 0, (
+        f"unit-suite collection failed (rc={out.returncode}) — a test "
+        f"module no longer imports:\n{tail}\n{out.stderr[-2000:]}")
+    m = re.search(r"(\d+) tests? collected", out.stdout)
+    assert m, f"no collection summary in output:\n{tail}"
+    count = int(m.group(1))
+    assert count >= MIN_COLLECTED, (
+        f"only {count} tests collected (expected >= {MIN_COLLECTED}) — "
+        "did a module or parametrization silently vanish?")

@@ -1,0 +1,440 @@
+"""ZeRO++-style low-bandwidth collectives (runtime/comm/low_bandwidth.py):
+qwZ quantized weight all-gather, qgZ quantized grad reduce-scatter with
+error feedback, hpZ secondary partitioning — plus the end-to-end
+acceptance check: loss-trajectory parity with the fp32 path over 20
+optimizer steps AND a ~4x wire-byte reduction visible in the jaxpr.
+
+Reference: ZeRO++ (arXiv:2306.10209) qwZ/qgZ/hpZ; Frontier low-bandwidth
+partitioning (arXiv:2501.04266).  All on the 8-device CPU sim mesh.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.runtime.comm.low_bandwidth import (
+    as_quantized_weight, blockwise_dequantize, blockwise_quantize,
+    collective_wire_bytes, init_error_feedback, low_bandwidth_all_gather,
+    pack_int4, qgz_reduce_scatter, qgz_reduce_scatter_inner,
+    quantized_gather_saves_bytes, quantized_psum_scatter, unpack_int4)
+
+
+def _mesh(shape, names):
+    devs = np.array(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, names)
+
+
+# --------------------------------------------------------------------- #
+# blockwise quantization primitives
+# --------------------------------------------------------------------- #
+def test_blockwise_roundtrip_error_bounds():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(4, 96)).astype(np.float32))
+    for bits, tol in ((8, 0.02), (4, 0.35)):
+        q, scale = blockwise_quantize(x, dim=0, bits=bits, block=32)
+        assert scale.shape == (4, 3)  # 96/32 blocks per row
+        y = blockwise_dequantize(q, scale, x.shape, dim=0, bits=bits)
+        assert y.shape == x.shape and y.dtype == x.dtype
+        # symmetric quantizer: |err| <= scale/2 per element; amax/qmax
+        # scale bounds the relative error blockwise
+        assert float(jnp.max(jnp.abs(x - y))) < tol
+    # int8 payload really is int8 on the wire
+    q, _ = blockwise_quantize(x, dim=0, bits=8, block=32)
+    assert q.dtype == jnp.int8 and q.shape == (4, 3, 32)
+    # int4 packs two-per-byte
+    q4, _ = blockwise_quantize(x, dim=0, bits=4, block=32)
+    assert q4.shape == (4, 3, 16)
+
+
+def test_blockwise_handles_awkward_shapes():
+    rng = np.random.default_rng(1)
+    for shape in ((8,), (3, 7), (2, 5, 9)):
+        x = jnp.asarray(rng.normal(size=shape).astype(np.float32))
+        for bits in (8, 4):
+            q, s = blockwise_quantize(x, dim=0, bits=bits, block=16)
+            y = blockwise_dequantize(q, s, x.shape, dim=0, bits=bits)
+            assert y.shape == x.shape
+            assert float(jnp.max(jnp.abs(x - y))) < 0.6
+    # zero input stays exactly zero (scale guard against amax == 0)
+    z = jnp.zeros((4, 8), jnp.float32)
+    q, s = blockwise_quantize(z, dim=0, bits=8)
+    assert float(jnp.max(jnp.abs(
+        blockwise_dequantize(q, s, z.shape, dim=0)))) == 0.0
+
+
+def test_int4_pack_roundtrip():
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.integers(-7, 8, size=(3, 5, 8)).astype(np.int8))
+    p = pack_int4(q)
+    assert p.shape == (3, 5, 4)
+    assert (unpack_int4(p) == q).all()
+
+
+def test_quantized_gather_saves_bytes_heuristic():
+    """The wire-cost gate behind _gather_leaf: wide leaves win, skinny
+    leaves (per-element fp32 scales) lose and must fall back dense."""
+    # (1, h) gathered along dim 1: rest == 1 → one fp32 scale per int8
+    # element, 5 bytes/elem vs 4 native — quantizing inflates traffic
+    assert not quantized_gather_saves_bytes((1, 128), 1, jnp.float32, 8)
+    # same leaf in a 2-layer group amortizes the scale over 2 elements
+    assert quantized_gather_saves_bytes((2, 128), 1, jnp.float32, 8)
+    # bf16 native halves the bar: a 2-element block (1 + 4/2 bytes vs 4)
+    # still loses, a full block wins
+    assert not quantized_gather_saves_bytes((2, 128), 1, jnp.bfloat16, 8)
+    assert quantized_gather_saves_bytes((256, 128), 1, jnp.bfloat16, 8)
+    # a weight matrix wins in every layout
+    assert quantized_gather_saves_bytes((1, 64, 256), 1, jnp.float32, 8)
+    assert quantized_gather_saves_bytes((128, 512), 0, jnp.float32, 4)
+
+
+def test_as_quantized_weight_bridge():
+    """blockwise_quantize with one block per row IS ops/quant.py's
+    per-row QuantizedWeight — the fused dequant-matmul kernels accept
+    the gathered payload directly."""
+    from deepspeed_tpu.ops.quant import dequant
+    rng = np.random.default_rng(12)
+    w = jnp.asarray(rng.normal(size=(16, 48)).astype(np.float32))
+    q, scale = blockwise_quantize(w, dim=0, bits=8, block=48)
+    assert q.shape == (16, 1, 48) and scale.shape == (16, 1)
+    qw = as_quantized_weight(q, scale)
+    assert qw.qweight.shape == w.shape and qw.scale.shape == (16, 1)
+    np.testing.assert_allclose(
+        np.asarray(dequant(qw, jnp.float32)),
+        np.asarray(blockwise_dequantize(q, scale, w.shape, dim=0)),
+        rtol=1e-6)
+    # multi-block rows have no per-row scale — the bridge refuses
+    q2, s2 = blockwise_quantize(w, dim=0, bits=8, block=16)
+    with pytest.raises(ValueError, match="blockwise"):
+        as_quantized_weight(q2, s2)
+
+
+# --------------------------------------------------------------------- #
+# qwZ: quantized weight all-gather
+# --------------------------------------------------------------------- #
+def _shard_map(fn, mesh, in_specs, out_specs):
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+
+def test_qwz_all_gather_close_to_fp32():
+    mesh = _mesh((8,), ("data",))
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(16, 24)).astype(np.float32))
+    ref = _shard_map(
+        lambda a: jax.lax.all_gather(a, ("data",), axis=0, tiled=True),
+        mesh, P("data"), P())(x)
+    for bits, tol in ((8, 0.03), (4, 0.5)):
+        got = _shard_map(
+            lambda a: low_bandwidth_all_gather(a, ("data",), 0, bits, 0, 64),
+            mesh, P("data"), P())(x)
+        assert got.shape == ref.shape
+        assert float(jnp.max(jnp.abs(ref - got))) < tol
+    # bits=0 is the exact native gather
+    got = _shard_map(
+        lambda a: low_bandwidth_all_gather(a, ("data",), 0, 0, 0, 64),
+        mesh, P("data"), P())(x)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_qwz_backward_transport_identical_to_fp32():
+    """With qgZ off, the quantized gather's VJP is the SAME fp32
+    reduce-scatter as _all_gather_f32grad (straight-through quantizer).
+    A loss LINEAR in the gathered value isolates the transport: its
+    cotangent is independent of the (quantized) forward value, so the
+    grads must be bit-identical, not merely close."""
+    mesh = _mesh((8,), ("data",))
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(size=(16, 24)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(16, 24)).astype(np.float32))
+
+    def grad_of(gather):
+        def loss(a):
+            return jnp.sum(gather(a) * w)
+        return _shard_map(jax.grad(loss), mesh, P("data"), P("data"))(x)
+
+    g_ref = grad_of(
+        lambda a: jax.lax.all_gather(a, ("data",), axis=0, tiled=True))
+    g_q = grad_of(
+        lambda a: low_bandwidth_all_gather(a, ("data",), 0, 8, 0, 64))
+    np.testing.assert_array_equal(np.asarray(g_ref), np.asarray(g_q))
+
+
+# --------------------------------------------------------------------- #
+# qgZ: quantized gradient reduce-scatter
+# --------------------------------------------------------------------- #
+def test_qgz_psum_scatter_close_to_fp32():
+    mesh = _mesh((8,), ("data",))
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(size=(16, 24)).astype(np.float32))
+    ref = _shard_map(
+        lambda a: jax.lax.psum_scatter(a, ("data",), scatter_dimension=0,
+                                       tiled=True),
+        mesh, P(None), P("data"))(x)
+    got = _shard_map(
+        lambda a: quantized_psum_scatter(a, ("data",), 0, bits=8, block=64),
+        mesh, P(None), P("data"))(x)
+    assert got.shape == ref.shape
+    scale = float(jnp.max(jnp.abs(ref)))
+    assert float(jnp.max(jnp.abs(ref - got))) / scale < 0.01
+
+
+def test_qgz_multi_axis_reduce_scatter():
+    """Two ZeRO axes (data=4, expert=2) reduce sequentially — result
+    stays close to the joint fp32 psum_scatter."""
+    mesh = _mesh((4, 2), ("data", "expert"))
+    rng = np.random.default_rng(6)
+    x = jnp.asarray(rng.normal(size=(16, 24)).astype(np.float32))
+    axes = ("data", "expert")
+    ref = _shard_map(
+        lambda a: jax.lax.psum_scatter(a, axes, scatter_dimension=0,
+                                       tiled=True),
+        mesh, P(None), P(axes))(x)
+    got = _shard_map(
+        lambda a: quantized_psum_scatter(a, axes, 0, bits=8, block=64),
+        mesh, P(None), P(axes))(x)
+    scale = float(jnp.max(jnp.abs(ref)))
+    assert float(jnp.max(jnp.abs(ref - got))) / scale < 0.02
+
+
+def test_qgz_error_feedback_running_mean_converges():
+    """Error feedback telescopes: sum_t out_t = reduce(T*x + e_0 - e_T),
+    so the RUNNING MEAN of repeated reductions of a persistent signal
+    converges to the exact reduction at O(1/T) — the same argument as
+    1-bit Adam's worker error compensation, now multi-bit.  int4 makes
+    the effect visible in few steps."""
+    mesh = _mesh((8,), ("data",))
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(16, 24)).astype(np.float32))
+    exact = _shard_map(
+        lambda a: jax.lax.psum_scatter(a, ("data",), scatter_dimension=0,
+                                       tiled=True),
+        mesh, P(None), P("data"))(x)
+
+    # jit once: an unjitted shard_map re-lowers on every call (12 calls
+    # would spend >1 min compiling the same program)
+    step = jax.jit(_shard_map(
+        lambda a, e: qgz_reduce_scatter_inner(a, e, "data", dim=0, bits=4,
+                                              block=64),
+        mesh, (P(None), P(None)), (P("data"), P(None))))
+
+    err = jnp.zeros_like(x)
+    total = jnp.zeros_like(exact)
+    means = []
+    for t in range(1, 13):
+        out, err = step(x, err)
+        total = total + out
+        means.append(float(jnp.max(jnp.abs(total / t - exact))))
+    # one-shot int4 error vs the telescoped mean after 12 rounds: the
+    # residual is the carried buffer / T, i.e. O(1/T)
+    assert means[-1] < means[0] / 3
+    assert means[-1] < 0.2
+    # the error buffer stays bounded (quantizer granularity), not growing
+    assert float(jnp.max(jnp.abs(err))) < 2.0
+
+
+def test_qgz_stacked_wrapper_matches_inner():
+    """Worker-stacked convenience API (compressed_allreduce calling
+    convention): row i of the result is worker i's reduced chunk."""
+    ds.reset_mesh_context()
+    ds.initialize_mesh(data=-1)
+    rng = np.random.default_rng(8)
+    W = 8
+    x = jnp.asarray(rng.normal(size=(W, 16, 6)).astype(np.float32))
+    err = init_error_feedback(x)
+    reduced, new_err = qgz_reduce_scatter(x, err, bits=8, block=48)
+    assert reduced.shape == (W, 2, 6)  # 16/8 chunk per worker
+    assert new_err.shape == x.shape
+    # against a numpy reference: chunk i of the sum over workers
+    full = np.asarray(x).sum(axis=0)  # [16, 6]
+    for i in range(W):
+        approx = np.asarray(reduced)[i]
+        want = full[2 * i:2 * (i + 1)]
+        assert np.max(np.abs(approx - want)) / max(
+            1e-9, np.max(np.abs(want))) < 0.02
+    ds.reset_mesh_context()
+
+
+# --------------------------------------------------------------------- #
+# wire-byte accounting
+# --------------------------------------------------------------------- #
+def test_collective_wire_bytes_walker():
+    mesh = _mesh((4, 2), ("data", "model"))
+    x = jnp.ones((16, 24), np.float32)
+
+    def f(a):  # a is [4, 24] per shard over "data"
+        g = jax.lax.all_gather(a, ("data",), axis=0, tiled=True)
+        s = jax.lax.psum_scatter(g, ("data",), scatter_dimension=0,
+                                 tiled=True)
+        return g.sum() + s.sum()
+
+    jx = jax.make_jaxpr(_shard_map(f, mesh, P("data"), P()))(x)
+    bytes_ = collective_wire_bytes(jx)
+    # gather output: [16, 24] fp32 = 1536 B; reduce operand: same
+    assert bytes_["gather_bytes"] == 16 * 24 * 4
+    assert bytes_["reduce_bytes"] == 16 * 24 * 4
+
+
+# --------------------------------------------------------------------- #
+# config block
+# --------------------------------------------------------------------- #
+def test_low_bandwidth_config_parsing():
+    from deepspeed_tpu.config import (DeepSpeedConfigError,
+                                      ZeroLowBandwidthConfig)
+    off = ZeroLowBandwidthConfig.from_dict(None)
+    assert not off.enabled and off.qwz_bits == 0 and off.qgz_bits == 0
+    cfg = ZeroLowBandwidthConfig.from_dict(
+        {"qwz_bits": 8, "qgz_bits": 4, "hpz_group_size": 2,
+         "block_size": 128})
+    assert cfg.enabled and cfg.qwz_bits == 8 and cfg.qgz_bits == 4
+    assert cfg.hpz_group_size == 2 and cfg.block_size == 128
+    # each knob independently enables
+    assert ZeroLowBandwidthConfig.from_dict({"qwz_bits": 8}).enabled
+    assert ZeroLowBandwidthConfig.from_dict({"hpz_group_size": 4}).enabled
+    for bad in ({"qwz_bits": 3}, {"qgz_bits": 16}, {"block_size": 0}):
+        with pytest.raises(DeepSpeedConfigError):
+            ZeroLowBandwidthConfig.from_dict(bad)
+    # rides inside zero_optimization
+    from deepspeed_tpu.config import ZeroConfig
+    z = ZeroConfig.from_dict(
+        {"stage": 3, "low_bandwidth": {"qgz_bits": 8}})
+    assert z.low_bandwidth.qgz_bits == 8 and z.low_bandwidth.enabled
+
+
+# --------------------------------------------------------------------- #
+# end-to-end acceptance: parity + ~4x byte reduction
+# --------------------------------------------------------------------- #
+def _train_small(zero_cfg, steps, mesh_kwargs=None, bf16=False):
+    from deepspeed_tpu.models import GPT2Config, GPT2Model
+    ds.reset_mesh_context()
+    mesh = ds.initialize_mesh(**(mesh_kwargs or {"data": -1}))
+    cfg = GPT2Config(vocab_size=64, n_positions=16, hidden_size=32,
+                     num_layers=2, num_heads=4, bf16=bf16,
+                     embd_dropout=0.0, attn_dropout=0.0, hidden_dropout=0.0)
+    model = GPT2Model(cfg)
+    engine, _, _, _ = ds.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": zero_cfg,
+                "steps_per_print": 10 ** 9},
+        model_parameters=model.init_params(jax.random.PRNGKey(0)),
+        mesh=mesh, rng=jax.random.PRNGKey(7))
+    ids = np.asarray(jax.random.randint(jax.random.PRNGKey(1), (8, 16),
+                                        0, 64), np.int32)
+    losses = []
+    for _ in range(steps):
+        loss = engine.forward(ids)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+
+    def loss_fn(p):
+        return model.loss(p, None, ids)
+
+    jaxpr = jax.make_jaxpr(jax.grad(loss_fn))(engine.params)
+    stream = engine._zero3_stream
+    ds.reset_mesh_context()
+    return losses, jaxpr, stream
+
+
+_Z3 = {"stage": 3, "stage3_param_persistence_threshold": 0,
+       "stage3_max_live_parameters": 1, "stage3_prefetch_bucket_size": 0}
+
+
+def test_e2e_quantized_parity_and_byte_reduction():
+    """THE acceptance check: with qwz_bits=8 + qgz_bits=8, the loss
+    trajectory stays within tolerance of the fp32 path over 20 optimizer
+    steps, and the grad jaxpr moves ~4x fewer gathered-weight and
+    reduce-scattered-grad bytes."""
+    steps = 20
+    l_f, jx_f, _ = _train_small(dict(_Z3), steps)
+    l_q, jx_q, stream = _train_small(
+        dict(_Z3, low_bandwidth={"qwz_bits": 8, "qgz_bits": 8}), steps)
+    assert stream is not None and stream.active and stream.lbc is not None
+
+    # parity: int8 blockwise noise must not bend the trajectory
+    rel = max(abs(a - b) / max(abs(a), 1e-9) for a, b in zip(l_f, l_q))
+    assert rel < 0.02, (rel, l_f, l_q)
+    assert l_q[-1] < l_q[0]  # still actually training
+
+    # wire bytes: int8 payload + fp32 scales vs fp32 — ~4x on both
+    # directions (scales and the all-to-all transport keep it under 4)
+    bf = collective_wire_bytes(jx_f)
+    bq = collective_wire_bytes(jx_q)
+    assert bf["gather_bytes"] > 0 and bf["reduce_bytes"] > 0
+    assert bf["gather_bytes"] / bq["gather_bytes"] > 3.0, (bf, bq)
+    assert bf["reduce_bytes"] / bq["reduce_bytes"] > 3.0, (bf, bq)
+
+
+def test_e2e_hpz_exact_parity_on_two_axis_mesh():
+    """hpZ alone changes WHERE the weight gathers run (sub-mesh only),
+    not their numerics: fp32 trajectories match to float tolerance, and
+    the stream's param gathers are confined to the inner ZeRO axis."""
+    steps = 4
+    l_f, _, _ = _train_small(dict(_Z3), steps,
+                             mesh_kwargs={"data": 4, "expert": 2})
+    l_h, _, stream = _train_small(
+        dict(_Z3, low_bandwidth={"hpz_group_size": 2}), steps,
+        mesh_kwargs={"data": 4, "expert": 2})
+    assert stream.param_manual == frozenset({"expert"})
+    assert stream.manual == frozenset({"data", "expert"})
+    np.testing.assert_allclose(l_h, l_f, rtol=1e-5)
+
+
+def test_e2e_hpz_bf16_trains_on_cpu():
+    """hpZ + bf16: every leaf's gathers stop at the sub-mesh, so every
+    half-precision leaf takes the fp32-widened entry (boundary grad psum
+    over the slow axes) — this must trace and train on CPU, where a
+    half-precision reduction collective hard-aborts XLA."""
+    losses, _, stream = _train_small(
+        dict(_Z3, low_bandwidth={"hpz_group_size": 2}), 3,
+        mesh_kwargs={"data": 4, "expert": 2}, bf16=True)
+    assert stream.param_manual == frozenset({"expert"})
+    assert losses[-1] < losses[0]
+
+
+def test_engine_warns_low_bandwidth_below_stage3(monkeypatch):
+    """low_bandwidth under stage < 3 is inert — the engine says so
+    instead of silently ignoring the config.  (The repo logger sets
+    propagate=False, so capture the call, not the root-logger record.)"""
+    ds.reset_mesh_context()
+    ds.initialize_mesh(data=-1)
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(4, 4)).astype(np.float32))
+
+    def model(p, rng_, x, y):
+        return jnp.mean((x @ p - y) ** 2)
+
+    from deepspeed_tpu.runtime import engine as engine_mod
+    warnings_seen = []
+    monkeypatch.setattr(
+        engine_mod.logger, "warning",
+        lambda msg, *a, **k: warnings_seen.append(str(msg)))
+    engine, _, _, _ = ds.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {
+                    "stage": 2, "low_bandwidth": {"qwz_bits": 8}},
+                "steps_per_print": 10 ** 9},
+        model_parameters=w)
+    assert any("low_bandwidth" in m for m in warnings_seen)
+    # stage 3 with a model that lacks install_zero3_streaming is the
+    # OTHER inert case — it must warn too, not silently no-op
+    warnings_seen.clear()
+    ds.reset_mesh_context()
+    ds.initialize_mesh(data=-1)
+    engine, _, _, _ = ds.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {
+                    "stage": 3, "low_bandwidth": {"qwz_bits": 8}},
+                "steps_per_print": 10 ** 9},
+        model_parameters=w)
+    assert any("install_zero3_streaming" in m for m in warnings_seen)
+    ds.reset_mesh_context()

@@ -73,3 +73,46 @@ def test_data_sharding_placement():
     x = jnp.zeros((16, 4))
     y = jax.device_put(x, ctx.data_sharding())
     assert len(y.sharding.device_set) == 8
+
+
+def test_resolve_hpz_axes_suffix_rule():
+    """hpZ (ZeRO++): the secondary-partition group must be the product of
+    a SUFFIX of the ZeRO axes — inner axes ride the fastest links."""
+    from deepspeed_tpu.runtime.zero.partition import resolve_hpz_axes
+
+    sizes = {"data": 4, "expert": 2}
+    assert resolve_hpz_axes(sizes, 2) == ("expert",)
+    assert resolve_hpz_axes(sizes, 8) == ("data", "expert")
+    # size-1 axes drop out of the returned tuple
+    assert resolve_hpz_axes({"data": 8, "expert": 1}, 8) == ("data",)
+    assert resolve_hpz_axes({"data": 8, "expert": 1}, 1) == ()
+    # non-suffix sizes raise, listing the valid ones
+    with pytest.raises(ValueError, match=r"valid sizes.*\[1, 2, 8\]"):
+        resolve_hpz_axes(sizes, 4)
+    with pytest.raises(ValueError):
+        resolve_hpz_axes(sizes, 3)
+
+
+def test_hpz_secondary_shardings_on_two_axis_mesh():
+    """ZeroPartitioner.secondary_shardings: the hpZ secondary weight copy
+    shards ONLY within the sub-mesh (inner ZeRO axes), replicated across
+    the slow outer axes — so hot-loop gathers never cross them."""
+    from jax.sharding import PartitionSpec as P
+    from deepspeed_tpu.runtime.zero.partition import ZeroPartitioner
+
+    ctx = MeshContext.create(data=4, expert=2)
+    part = ZeroPartitioner(ctx, stage=3, persistence_threshold=0)
+    params = {"w": jnp.zeros((16, 8)), "b": jnp.zeros((8,))}
+
+    primary = part.param_shardings(params)
+    secondary = part.secondary_shardings(params, hpz_group_size=2)
+    # primary spans both ZeRO axes; secondary only the inner one
+    assert primary["w"].spec == P(("data", "expert"), None)
+    assert secondary["w"].spec == P("expert", None)
+    assert secondary["b"].spec == P("expert")
+    # full-group size degenerates to the primary partition
+    full = part.secondary_shardings(params, hpz_group_size=8)
+    assert full["w"].spec == primary["w"].spec
+    # a group that doesn't align with whole inner axes is rejected
+    with pytest.raises(ValueError, match="hpz_group_size=3"):
+        part.secondary_shardings(params, hpz_group_size=3)

@@ -694,21 +694,44 @@ def test_dropout_mask_pack_bit_layout():
 
 def test_dropout_mask_reuse_mode_guards():
     """save_dropout_mask demands return_lse + dropout; bwd rejects a
-    mask when the fwd/bwd modes disagree."""
+    mask when the fwd/bwd modes disagree.  Every guard must name the
+    OFFENDING VALUE and the config knob that fixes it (round-5 feedback:
+    'multiple of 256' / mask_block_q failures were not actionable)."""
     import importlib
     fa = importlib.import_module("deepspeed_tpu.ops.flash_attention")
     q = k = v = jnp.zeros((1, 1, 512, 64), jnp.float32)
     with pytest.raises(ValueError, match="save_dropout_mask"):
         fa.flash_attention_pallas(q, k, v, save_dropout_mask=True,
                                   interpret=True)
+    # fwd 256-alignment guard: names q_len, the resolved block, and both
+    # ways out (block_q config / reuse off).  q_len=384 resolves a 384
+    # block — aligned but not packable.
+    q384 = jnp.zeros((1, 1, 384, 64), jnp.float32)
+    with pytest.raises(ValueError) as ei:
+        fa.flash_attention_pallas(q384, q384, q384, save_dropout_mask=True,
+                                  return_lse=True, dropout_rate=0.1)
+    msg = str(ei.value)
+    assert "q_len=384" in msg and "384" in msg
+    assert "block_q" in msg and "DS_DROPOUT_REUSE" in msg
     lse = jnp.zeros((1, 1, 512), jnp.float32)
     mask = jnp.zeros((1, 1, 16, 512), jnp.uint32)
-    with pytest.raises(ValueError, match="mode mismatch"):
+    # mask without dropout_rate: names the rate and the fix
+    with pytest.raises(ValueError, match=r"dropout_rate=0\.0"):
         fa.flash_attention_bwd_pallas(q, k, v, q, lse, q, dropout_mask=mask,
                                       interpret=True)
+    # mask at a non-packable backward block: names the value + knobs
+    lse384 = jnp.zeros((1, 1, 384), jnp.float32)
+    mask384 = jnp.zeros((1, 1, 12, 384), jnp.uint32)
+    with pytest.raises(ValueError,
+                       match=r"384.*not a multiple of 256.*DS_DROPOUT_REUSE"):
+        fa.flash_attention_bwd_pallas(
+            q384, q384, q384, q384, lse384, q384, dropout_rate=0.1,
+            dropout_mask=mask384, dropout_mask_block_q=384, interpret=True)
     # block_q mismatch: the packed bit layout depends on the forward's
-    # resolved q block — a mismatched direct call must error, not corrupt
-    with pytest.raises(ValueError, match="packed bit layout|packed with"):
+    # resolved q block — a mismatched direct call must error, not
+    # corrupt, and the error names both blocks and the fix
+    with pytest.raises(ValueError,
+                       match=r"block_q=256.*block_q=512.*dropout_mask_block_q"):
         fa.flash_attention_bwd_pallas(
             q, k, v, q, lse, q, dropout_rate=0.1, dropout_mask=mask,
             dropout_mask_block_q=256, block_q=512, interpret=True)

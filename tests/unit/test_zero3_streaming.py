@@ -222,3 +222,100 @@ def test_zero3_bf16_streams_on_cpu():
         engine.step()
         losses.append(float(loss))
     assert losses[-1] < losses[0]
+
+
+def test_stream_context_low_bandwidth_wiring():
+    """Zero3StreamContext consumes the ZeroLowBandwidthConfig: hpZ
+    confines the param manual set (and spec sizes) to the resolved
+    sub-mesh; qwZ/qgZ route leaf gathers through the quantized
+    collective (jaxpr shows the int8 payload riding the wire)."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.config import ZeroLowBandwidthConfig
+    from deepspeed_tpu.runtime.zero.stage3_streaming import Zero3StreamContext
+
+    ds.reset_mesh_context()
+    mesh = ds.initialize_mesh(data=4, expert=2)
+    ctx = ds.get_mesh_context()
+
+    # hpZ: param gathers confined to the inner axis, grads still span all
+    lbc = ZeroLowBandwidthConfig(hpz_group_size=2)
+    stream = Zero3StreamContext(ctx, 10 ** 9, 0, low_bandwidth=lbc)
+    assert stream.manual == frozenset({"data", "expert"})
+    assert stream.param_manual == frozenset({"expert"})
+    assert stream.param_axis_sizes["data"] == 1
+    assert stream.param_axis_sizes["expert"] == 2
+
+    # qwZ: the quantized gather traces an int8 all_gather + fp32 scales
+    lbc = ZeroLowBandwidthConfig(qwz_bits=8)
+    stream = Zero3StreamContext(ctx, 10 ** 9, 0, low_bandwidth=lbc)
+
+    def body(shard):
+        return stream._gather_leaf(shard, ("data", "expert"), 0)
+
+    from jax.sharding import PartitionSpec as P
+    x = jnp.zeros((16, 8), jnp.float32)
+    jaxpr = str(jax.make_jaxpr(jax.shard_map(
+        body, mesh=ctx.mesh, in_specs=P(("data", "expert")), out_specs=P(),
+        check_vma=False))(x))
+    assert "i8" in jaxpr and "all_gather" in jaxpr
+    # off (or integer leaves) falls back to the fp32-transpose gather
+    stream_off = Zero3StreamContext(ctx, 10 ** 9, 0)
+    jaxpr_off = str(jax.make_jaxpr(jax.shard_map(
+        lambda s: stream_off._gather_leaf(s, ("data", "expert"), 0),
+        mesh=ctx.mesh, in_specs=P(("data", "expert")), out_specs=P(),
+        check_vma=False))(x))
+    assert "i8" not in jaxpr_off
+    ds.reset_mesh_context()
+
+
+def test_stream_context_per_direction_wire_gate():
+    """_leaf_wire_bits degrades each direction independently: the
+    forward gate compares against the leaf's native width, the backward
+    against the fp32 wire the dense fallback actually moves
+    (f32_psum_scatter promotes half grads) — so a bf16 leaf too skinny
+    for qwZ still gets its qgZ reduce-scatter, and a truly skinny leaf
+    (per-element scales) goes fully dense."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.config import ZeroLowBandwidthConfig
+    from deepspeed_tpu.runtime.zero.stage3_streaming import Zero3StreamContext
+
+    ds.reset_mesh_context()
+    ds.initialize_mesh(data=-1)
+    ctx = ds.get_mesh_context()
+    lbc = ZeroLowBandwidthConfig(qwz_bits=8, qgz_bits=8)
+    stream = Zero3StreamContext(ctx, 10 ** 9, 0, low_bandwidth=lbc)
+
+    wide = jnp.zeros((1, 64, 256), jnp.float32)
+    assert stream._leaf_wire_bits(wide, 1) == (8, 8)
+    # (2, 128) bf16 gathered along dim 1: rest=2 → fwd int8+scales (6B)
+    # loses to native bf16 (4B) but beats the fp32 backward wire (8B)
+    half = jnp.zeros((2, 128), jnp.bfloat16)
+    assert stream._leaf_wire_bits(half, 1) == (0, 8)
+    # rest=1 (bias, one layer per group): per-element scales lose to
+    # both wires — fully dense
+    bias = jnp.zeros((1, 128), jnp.float32)
+    assert stream._leaf_wire_bits(bias, 1) == (0, 0)
+    # integer leaves never quantize
+    ints = jnp.zeros((1, 64, 256), jnp.int32)
+    assert stream._leaf_wire_bits(ints, 1) == (0, 0)
+    # lbc off → always dense
+    off = Zero3StreamContext(ctx, 10 ** 9, 0)
+    assert off._leaf_wire_bits(wide, 1) == (0, 0)
+    ds.reset_mesh_context()
+
+
+def test_stream_context_rejects_misaligned_hpz():
+    """An hpz_group_size that doesn't match a ZeRO-axis suffix fails at
+    context build with the valid sizes listed (engine-build-time error,
+    not a mid-training trace surprise)."""
+    from deepspeed_tpu.config import ZeroLowBandwidthConfig
+    from deepspeed_tpu.runtime.zero.stage3_streaming import Zero3StreamContext
+
+    ds.reset_mesh_context()
+    ds.initialize_mesh(data=4, expert=2)
+    ctx = ds.get_mesh_context()
+    with pytest.raises(ValueError, match="hpz_group_size=3.*valid sizes"):
+        Zero3StreamContext(ctx, 10 ** 9, 0,
+                           low_bandwidth=ZeroLowBandwidthConfig(
+                               hpz_group_size=3))
+    ds.reset_mesh_context()
