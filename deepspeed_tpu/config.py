@@ -551,6 +551,162 @@ class CheckpointConfig:
 
 
 @dataclass
+class PreemptionConfig:
+    """SIGTERM/SIGINT → graceful stop at the next step boundary with an
+    emergency checkpoint (TPU-native: preemptible pods)."""
+    enabled: bool = C.PREEMPTION_ENABLED_DEFAULT
+    signals: tuple = C.PREEMPTION_SIGNALS_DEFAULT
+    emergency_tag_prefix: str = C.PREEMPTION_EMERGENCY_TAG_PREFIX_DEFAULT
+    save_dir: Optional[str] = C.PREEMPTION_SAVE_DIR_DEFAULT
+    reraise: bool = C.PREEMPTION_RERAISE_DEFAULT
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "PreemptionConfig":
+        d = d or {}
+        signals = d.get(C.PREEMPTION_SIGNALS, C.PREEMPTION_SIGNALS_DEFAULT)
+        if isinstance(signals, str):
+            signals = [signals]  # a bare "SIGTERM" is not 7 signals
+        import signal as _signal
+        for name in signals:
+            # membership in Signals, not hasattr: the signal module also
+            # exposes non-signal attributes (SIG_DFL, SIG_IGN, ...) that
+            # would install a handler on the wrong signal
+            if not (isinstance(name, str)
+                    and name in _signal.Signals.__members__):
+                raise DeepSpeedConfigError(
+                    f"resilience.preemption.signals entry {name!r} is not "
+                    "a signal name (expected e.g. \"SIGTERM\", \"SIGINT\")")
+        return PreemptionConfig(
+            enabled=get_scalar_param(d, C.PREEMPTION_ENABLED,
+                                     C.PREEMPTION_ENABLED_DEFAULT),
+            signals=tuple(signals),
+            emergency_tag_prefix=get_scalar_param(
+                d, C.PREEMPTION_EMERGENCY_TAG_PREFIX,
+                C.PREEMPTION_EMERGENCY_TAG_PREFIX_DEFAULT),
+            save_dir=get_scalar_param(d, C.PREEMPTION_SAVE_DIR,
+                                      C.PREEMPTION_SAVE_DIR_DEFAULT),
+            reraise=get_scalar_param(d, C.PREEMPTION_RERAISE,
+                                     C.PREEMPTION_RERAISE_DEFAULT),
+        )
+
+
+@dataclass
+class SentinelConfig:
+    """On-device training-health monitor: EWMA of loss + global grad-norm,
+    NaN/Inf and k-sigma spike detection — catches bf16 blow-ups the fp16
+    overflow skip never sees."""
+    enabled: bool = C.SENTINEL_ENABLED_DEFAULT
+    ewma_alpha: float = C.SENTINEL_EWMA_ALPHA_DEFAULT
+    k_sigma: float = C.SENTINEL_K_SIGMA_DEFAULT
+    warmup_steps: int = C.SENTINEL_WARMUP_STEPS_DEFAULT
+    policy: str = C.SENTINEL_POLICY_DEFAULT
+    anomaly_budget: int = C.SENTINEL_ANOMALY_BUDGET_DEFAULT
+    monitor_grad_norm: bool = C.SENTINEL_MONITOR_GRAD_NORM_DEFAULT
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "SentinelConfig":
+        d = d or {}
+        cfg = SentinelConfig(
+            enabled=get_scalar_param(d, C.SENTINEL_ENABLED,
+                                     C.SENTINEL_ENABLED_DEFAULT),
+            ewma_alpha=float(get_scalar_param(
+                d, C.SENTINEL_EWMA_ALPHA, C.SENTINEL_EWMA_ALPHA_DEFAULT)),
+            k_sigma=float(get_scalar_param(d, C.SENTINEL_K_SIGMA,
+                                           C.SENTINEL_K_SIGMA_DEFAULT)),
+            warmup_steps=int(get_scalar_param(
+                d, C.SENTINEL_WARMUP_STEPS, C.SENTINEL_WARMUP_STEPS_DEFAULT)),
+            policy=get_scalar_param(d, C.SENTINEL_POLICY,
+                                    C.SENTINEL_POLICY_DEFAULT),
+            anomaly_budget=int(get_scalar_param(
+                d, C.SENTINEL_ANOMALY_BUDGET,
+                C.SENTINEL_ANOMALY_BUDGET_DEFAULT)),
+            monitor_grad_norm=get_scalar_param(
+                d, C.SENTINEL_MONITOR_GRAD_NORM,
+                C.SENTINEL_MONITOR_GRAD_NORM_DEFAULT),
+        )
+        if cfg.policy not in C.SENTINEL_POLICIES:
+            raise DeepSpeedConfigError(
+                f"resilience.sentinel.policy={cfg.policy!r} — supported "
+                f"policies are {list(C.SENTINEL_POLICIES)}")
+        if not 0.0 < cfg.ewma_alpha <= 1.0:
+            raise DeepSpeedConfigError(
+                "resilience.sentinel.ewma_alpha must be in (0, 1], got "
+                f"{cfg.ewma_alpha}")
+        if cfg.anomaly_budget < 1:
+            raise DeepSpeedConfigError(
+                "resilience.sentinel.anomaly_budget must be >= 1, got "
+                f"{cfg.anomaly_budget}")
+        return cfg
+
+
+@dataclass
+class ResilienceConfig:
+    """Fault-tolerance block (all off by default — the engine is
+    byte-identical to the pre-resilience behavior when disabled, except
+    the always-on atomic `latest` rename bugfix)."""
+    enabled: bool = C.RESILIENCE_ENABLED_DEFAULT
+    atomic_checkpoints: bool = C.RESILIENCE_ATOMIC_CHECKPOINTS_DEFAULT
+    verify_on_load: bool = C.RESILIENCE_VERIFY_ON_LOAD_DEFAULT
+    max_fallback_tags: int = C.RESILIENCE_MAX_FALLBACK_TAGS_DEFAULT
+    keep_last_n: int = C.RESILIENCE_KEEP_LAST_N_DEFAULT
+    keep_every: int = C.RESILIENCE_KEEP_EVERY_DEFAULT
+    io_retries: int = C.RESILIENCE_IO_RETRIES_DEFAULT
+    io_backoff_seconds: float = C.RESILIENCE_IO_BACKOFF_SECONDS_DEFAULT
+    preemption: PreemptionConfig = field(default_factory=PreemptionConfig)
+    sentinel: SentinelConfig = field(default_factory=SentinelConfig)
+
+    @property
+    def atomic_enabled(self) -> bool:
+        return self.enabled and self.atomic_checkpoints
+
+    @property
+    def verify_enabled(self) -> bool:
+        return self.enabled and self.verify_on_load
+
+    @property
+    def gc_enabled(self) -> bool:
+        return self.enabled and self.keep_last_n > 0
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "ResilienceConfig":
+        d = d or {}
+        cfg = ResilienceConfig(
+            enabled=get_scalar_param(d, C.RESILIENCE_ENABLED,
+                                     C.RESILIENCE_ENABLED_DEFAULT),
+            atomic_checkpoints=get_scalar_param(
+                d, C.RESILIENCE_ATOMIC_CHECKPOINTS,
+                C.RESILIENCE_ATOMIC_CHECKPOINTS_DEFAULT),
+            verify_on_load=get_scalar_param(
+                d, C.RESILIENCE_VERIFY_ON_LOAD,
+                C.RESILIENCE_VERIFY_ON_LOAD_DEFAULT),
+            max_fallback_tags=int(get_scalar_param(
+                d, C.RESILIENCE_MAX_FALLBACK_TAGS,
+                C.RESILIENCE_MAX_FALLBACK_TAGS_DEFAULT)),
+            keep_last_n=int(get_scalar_param(
+                d, C.RESILIENCE_KEEP_LAST_N,
+                C.RESILIENCE_KEEP_LAST_N_DEFAULT)),
+            keep_every=int(get_scalar_param(
+                d, C.RESILIENCE_KEEP_EVERY, C.RESILIENCE_KEEP_EVERY_DEFAULT)),
+            io_retries=int(get_scalar_param(
+                d, C.RESILIENCE_IO_RETRIES, C.RESILIENCE_IO_RETRIES_DEFAULT)),
+            io_backoff_seconds=float(get_scalar_param(
+                d, C.RESILIENCE_IO_BACKOFF_SECONDS,
+                C.RESILIENCE_IO_BACKOFF_SECONDS_DEFAULT)),
+            preemption=PreemptionConfig.from_dict(
+                d.get(C.RESILIENCE_PREEMPTION)),
+            sentinel=SentinelConfig.from_dict(d.get(C.RESILIENCE_SENTINEL)),
+        )
+        if cfg.keep_last_n < 0 or cfg.keep_every < 0:
+            raise DeepSpeedConfigError(
+                "resilience.keep_last_n / keep_every must be >= 0, got "
+                f"{cfg.keep_last_n} / {cfg.keep_every}")
+        if cfg.io_retries < 0:
+            raise DeepSpeedConfigError(
+                f"resilience.io_retries must be >= 0, got {cfg.io_retries}")
+        return cfg
+
+
+@dataclass
 class MeshConfig:
     """TPU-native: named-axis device mesh shape.  -1 means "fill with the
     remaining devices" (like a reshape wildcard); exactly one axis may be -1.
@@ -687,6 +843,8 @@ class DeepSpeedConfig:
         self.quantize_training_config = QuantizeTrainingConfig.from_dict(
             pd.get(C.QUANTIZE_TRAINING))
         self.checkpoint_config = CheckpointConfig.from_dict(pd.get(C.CHECKPOINT))
+        self.resilience_config = ResilienceConfig.from_dict(
+            pd.get(C.RESILIENCE))
         self.sparse_attention = pd.get(C.SPARSE_ATTENTION)
         self.mesh_config = MeshConfig.from_dict(pd.get(C.MESH))
         self.sequence_parallel_config = SequenceParallelConfig.from_dict(

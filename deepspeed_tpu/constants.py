@@ -442,6 +442,68 @@ CHECKPOINT_TAG_VALIDATION_MODES = [
 ]
 
 #############################################
+# Resilience (fault tolerance; TPU-native addition — preemptible pods
+# make checkpoint durability and run-health first-class.  All off by
+# default: with the block absent the engine behaves exactly as before.)
+#############################################
+RESILIENCE = "resilience"
+RESILIENCE_ENABLED = "enabled"
+RESILIENCE_ENABLED_DEFAULT = False
+# Atomic commit protocol: write the tag dir as <tag>.tmp.<nonce>, fsync,
+# manifest with per-file size+CRC32, os.replace into place, `latest` last.
+RESILIENCE_ATOMIC_CHECKPOINTS = "atomic_checkpoints"
+RESILIENCE_ATOMIC_CHECKPOINTS_DEFAULT = True
+# Validate the manifest on load; fall back to the newest intact tag.
+RESILIENCE_VERIFY_ON_LOAD = "verify_on_load"
+RESILIENCE_VERIFY_ON_LOAD_DEFAULT = True
+# Bound on how many candidate tags the corruption fallback will scan.
+RESILIENCE_MAX_FALLBACK_TAGS = "max_fallback_tags"
+RESILIENCE_MAX_FALLBACK_TAGS_DEFAULT = 8
+# Retention/GC: keep the newest N tags (0 = no GC); tags whose trailing
+# step number is a multiple of keep_every are kept forever.  The tag
+# `latest` points to is never deleted.
+RESILIENCE_KEEP_LAST_N = "keep_last_n"
+RESILIENCE_KEEP_LAST_N_DEFAULT = 0
+RESILIENCE_KEEP_EVERY = "keep_every"
+RESILIENCE_KEEP_EVERY_DEFAULT = 0
+# Retry/backoff wrapper around checkpoint IO (transient FS errors).
+RESILIENCE_IO_RETRIES = "io_retries"
+RESILIENCE_IO_RETRIES_DEFAULT = 3
+RESILIENCE_IO_BACKOFF_SECONDS = "io_backoff_seconds"
+RESILIENCE_IO_BACKOFF_SECONDS_DEFAULT = 0.5
+
+# -- preemption sub-block ------------------------------------------- #
+RESILIENCE_PREEMPTION = "preemption"
+PREEMPTION_ENABLED = "enabled"
+PREEMPTION_ENABLED_DEFAULT = False
+PREEMPTION_SIGNALS = "signals"            # e.g. ["SIGTERM", "SIGINT"]
+PREEMPTION_SIGNALS_DEFAULT = ("SIGTERM", "SIGINT")
+PREEMPTION_EMERGENCY_TAG_PREFIX = "emergency_tag_prefix"
+PREEMPTION_EMERGENCY_TAG_PREFIX_DEFAULT = "emergency"
+PREEMPTION_SAVE_DIR = "save_dir"          # None → last save_checkpoint dir
+PREEMPTION_SAVE_DIR_DEFAULT = None
+PREEMPTION_RERAISE = "reraise"            # restore handler + re-deliver
+PREEMPTION_RERAISE_DEFAULT = True
+
+# -- training-health sentinel sub-block ----------------------------- #
+RESILIENCE_SENTINEL = "sentinel"
+SENTINEL_ENABLED = "enabled"
+SENTINEL_ENABLED_DEFAULT = False
+SENTINEL_EWMA_ALPHA = "ewma_alpha"
+SENTINEL_EWMA_ALPHA_DEFAULT = 0.02
+SENTINEL_K_SIGMA = "k_sigma"
+SENTINEL_K_SIGMA_DEFAULT = 6.0
+SENTINEL_WARMUP_STEPS = "warmup_steps"
+SENTINEL_WARMUP_STEPS_DEFAULT = 20
+SENTINEL_POLICY = "policy"                # warn | skip_step | rewind
+SENTINEL_POLICY_DEFAULT = "warn"
+SENTINEL_POLICIES = ("warn", "skip_step", "rewind")
+SENTINEL_ANOMALY_BUDGET = "anomaly_budget"  # consecutive anomalies → abort
+SENTINEL_ANOMALY_BUDGET_DEFAULT = 5
+SENTINEL_MONITOR_GRAD_NORM = "monitor_grad_norm"
+SENTINEL_MONITOR_GRAD_NORM_DEFAULT = True
+
+#############################################
 # Elasticity (reference: deepspeed/elasticity/constants.py)
 #############################################
 ELASTICITY = "elasticity"

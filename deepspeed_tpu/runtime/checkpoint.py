@@ -68,10 +68,25 @@ def _resharded(template: Any, restored: Any) -> Any:
 def save_checkpoint_state(save_dir: str, tag: str, module_state: Any,
                           optimizer_state: Any = None,
                           client_state: Optional[Dict] = None,
-                          mp_rank: int = 0, dp_rank: int = 0) -> str:
-    """Write one checkpoint under <save_dir>/<tag>/ and update `latest`."""
-    ckpt_dir = os.path.join(save_dir, str(tag))
-    os.makedirs(ckpt_dir, exist_ok=True)
+                          mp_rank: int = 0, dp_rank: int = 0,
+                          atomic: bool = False) -> str:
+    """Write one checkpoint under <save_dir>/<tag>/ and update `latest`.
+
+    With ``atomic=True`` (resilience.atomic_checkpoints) the files are
+    staged in a ``<tag>.tmp.<nonce>/`` dir, fsync'd, recorded in a
+    size+CRC32 manifest, and renamed into place before `latest` moves —
+    a crash at any point leaves the previous checkpoint loadable.  The
+    `latest` update itself is ALWAYS tmp-file + atomic rename: a
+    half-written `latest` is a plain bug, not a feature level."""
+    from .resilience.atomic import (commit_tag_dir, tmp_tag_dir,
+                                    write_latest_atomic)
+    final_dir = os.path.join(save_dir, str(tag))
+    if atomic:
+        os.makedirs(save_dir, exist_ok=True)
+        ckpt_dir = tmp_tag_dir(save_dir, str(tag))
+    else:
+        ckpt_dir = final_dir
+        os.makedirs(ckpt_dir, exist_ok=True)
 
     model_file = os.path.join(ckpt_dir,
                               f"mp_rank_{mp_rank:02d}_model_states.npz")
@@ -87,9 +102,10 @@ def save_checkpoint_state(save_dir: str, tag: str, module_state: Any,
     with open(os.path.join(ckpt_dir, "ds_meta.json"), "w") as f:
         json.dump(meta, f)
 
-    with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-        f.write(str(tag))
-    return ckpt_dir
+    if atomic:
+        commit_tag_dir(save_dir, str(tag), ckpt_dir)
+    write_latest_atomic(save_dir, str(tag), LATEST_FILE)
+    return final_dir
 
 
 def read_latest_tag(load_dir: str) -> Optional[str]:
@@ -117,6 +133,17 @@ def load_checkpoint_state(load_dir: str, tag: Optional[str],
 
     model_file = os.path.join(ckpt_dir,
                               f"mp_rank_{mp_rank:02d}_model_states.npz")
+    # Fail fast with an actionable error on a missing or partial tag —
+    # not a bare FileNotFoundError from whichever file happened to be
+    # opened first.
+    if not os.path.isdir(ckpt_dir) or not os.path.isfile(model_file):
+        from .resilience.recovery import list_tags
+        missing = ("tag dir is missing" if not os.path.isdir(ckpt_dir)
+                   else f"tag dir exists but {os.path.basename(model_file)} "
+                        f"is missing (partial save?)")
+        raise FileNotFoundError(
+            f"checkpoint tag {tag!r} not loadable from {load_dir}: "
+            f"{missing}; available tags: {list_tags(load_dir) or 'none'}")
     with np.load(model_file, allow_pickle=False) as data:
         flat = {k: data[k] for k in data.files}
     module_state = _resharded(

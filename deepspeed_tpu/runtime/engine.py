@@ -270,6 +270,31 @@ class DeepSpeedEngine:
         self.scaler_state = jax.device_put(
             scaler_state, self.mesh_ctx.replicated())
 
+        # ---- resilience (all off by default; see docs/resilience.md) - #
+        res = self.config.resilience_config
+        self.resilience = res
+        self.sentinel = None
+        if res.sentinel.enabled:
+            from .resilience.sentinel import TrainingSentinel
+            self.sentinel = TrainingSentinel(
+                ewma_alpha=res.sentinel.ewma_alpha,
+                k_sigma=res.sentinel.k_sigma,
+                warmup_steps=res.sentinel.warmup_steps,
+                policy=res.sentinel.policy,
+                anomaly_budget=res.sentinel.anomaly_budget,
+                monitor_grad_norm=res.sentinel.monitor_grad_norm)
+        self._preemption = None
+        if res.preemption.enabled:
+            from .resilience.preemption import PreemptionHandler
+            self._preemption = PreemptionHandler(
+                signals=res.preemption.signals,
+                reraise=res.preemption.reraise).install()
+        # rewind target + default emergency-save dir, tracked across
+        # save_checkpoint/load_checkpoint
+        self._last_good_ckpt = None
+        self._last_save_dir = None
+        self._grad_norm_fn = None
+
         # ---- compiled programs --------------------------------------- #
         self._build_functions()
 
@@ -691,13 +716,26 @@ class DeepSpeedEngine:
             accumulate, out_shardings=self.grad_shardings,
             donate_argnums=(0,))
 
+        if self.sentinel is not None and self.sentinel.monitor_grad_norm:
+            # one fused fp32 reduction over the (still loss-scaled,
+            # un-averaged) accumulated grads; the host divides by
+            # loss_scale*gas for the true global norm
+            def global_grad_norm(grads):
+                total = jnp.zeros((), jnp.float32)
+                for g in jax.tree.leaves(grads):
+                    total += jnp.sum(jnp.square(g.astype(jnp.float32)))
+                return jnp.sqrt(total)
+
+            self._grad_norm_fn = jax.jit(global_grad_norm,
+                                         out_shardings=replicated)
+
         if self._offload_enabled:
             # Offload path: the optimizer step is host-side (HostOffload /
             # NVMe swapper); no compiled apply program.
             self._apply_fn = None
             return
 
-        def apply_step(params, opt_state, scaler_state, grads):
+        def apply_step(params, opt_state, scaler_state, grads, healthy=None):
             inv = 1.0 / (scaler_state.loss_scale * gas)
             grads = jax.tree.map(
                 lambda g: g.astype(jnp.float32) * inv, grads)
@@ -705,6 +743,13 @@ class DeepSpeedEngine:
             for g in jax.tree.leaves(grads):
                 finite &= jnp.all(jnp.isfinite(g))
             overflow = ~finite
+            # Sentinel skip rides the same per-leaf select machinery as the
+            # overflow skip: `healthy` (host verdict) ANDs into the select
+            # predicate, so a flagged step applies an exactly-zero update
+            # while donation aliasing stays intact.  The loss scaler only
+            # reacts to REAL overflow — a sentinel skip must not shrink it.
+            if healthy is not None:
+                finite &= healthy
 
             # Overflow skip as per-leaf selects, NOT lax.cond: a cond keeps
             # both branches' operands alive across the branch, which blocks
@@ -875,8 +920,29 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown():
             self.timers(STEP_MICRO_TIMER).start()
 
+        sentinel_skip = False
+        if self.sentinel is not None:
+            verdict = self._sentinel_check()
+            if verdict == "rewind":
+                # params/opt/scaler were just restored from the last good
+                # checkpoint; this step's gradients are from the bad
+                # trajectory and are dropped wholesale
+                self._grad_acc = None
+                self._last_overflow = None
+                if self.wall_clock_breakdown():
+                    self.timers(STEP_MICRO_TIMER).stop()
+                self._maybe_handle_preemption()
+                return
+            sentinel_skip = verdict == "skip"
+
         if self._offload_enabled:
-            overflow = self._offload_step()
+            # host-side optimizer: a sentinel skip simply never runs it
+            overflow = False if sentinel_skip else self._offload_step()
+        elif self.sentinel is not None:
+            (self.params, self.opt_state, self.scaler_state,
+             overflow) = self._apply_fn(self.params, self.opt_state,
+                                        self.scaler_state, self._grad_acc,
+                                        jnp.asarray(not sentinel_skip))
         else:
             (self.params, self.opt_state, self.scaler_state,
              overflow) = self._apply_fn(self.params, self.opt_state,
@@ -892,7 +958,11 @@ class DeepSpeedEngine:
         # fp32 paths keep fully-async dispatch: overflow is (near-)impossible
         # and the on-device cond still protects the weights.
         step_skipped = False
-        if self.scaler_cfg.dynamic:
+        if sentinel_skip:
+            step_skipped = True
+            self.skipped_steps += 1
+            self.sentinel.record_skip()
+        elif self.scaler_cfg.dynamic:
             if bool(overflow):
                 step_skipped = True
                 self.skipped_steps += 1
@@ -934,8 +1004,14 @@ class DeepSpeedEngine:
             loss_val = (float(self._last_loss)
                         if self._last_loss is not None else float("nan"))
             lr = self.get_lr()[0]
+            extra = f", skipped={self.skipped_steps}"
+            if self.sentinel is not None:
+                c = self.sentinel.counters()
+                extra += (f", sentinel_anomalies={c['anomalies_seen']}, "
+                          f"sentinel_skips={c['steps_skipped']}, "
+                          f"sentinel_rewinds={c['rewinds']}")
             log_dist(f"step={self.global_steps}, loss={loss_val:.6f}, "
-                     f"lr={lr:.3e}, loss_scale={self.loss_scale:g}",
+                     f"lr={lr:.3e}, loss_scale={self.loss_scale:g}{extra}",
                      ranks=[0])
         if self._summary_writer is not None:
             self._summary_writer.add_scalar(
@@ -946,6 +1022,148 @@ class DeepSpeedEngine:
                                             self.global_steps)
         if self.wall_clock_breakdown():
             self.timers(STEP_MICRO_TIMER).stop()
+        self._maybe_handle_preemption()
+
+    # ------------------------------------------------------------------ #
+    # resilience: sentinel + preemption (docs/resilience.md)
+    # ------------------------------------------------------------------ #
+    def _sentinel_check(self) -> str:
+        """Observe this step's (loss, grad_norm); returns the action:
+        "ok" | "skip" | "rewind".  Raises SentinelAbort once the
+        consecutive-anomaly budget is exhausted — a wedged run stops with
+        a structured diagnostic instead of burning compute."""
+        s = self.sentinel
+        loss = (float(self._last_loss) if self._last_loss is not None
+                else float("nan"))
+        norm = None
+        if self._grad_norm_fn is not None:
+            # the stored grads are loss-scaled and un-averaged; normalize
+            # host-side (one scalar)
+            norm = float(self._grad_norm_fn(self._grad_acc)) / (
+                float(self.scaler_state.loss_scale) *
+                self.gradient_accumulation_steps())
+            if (self.scaler_cfg.dynamic and np.isfinite(loss)
+                    and not np.isfinite(norm)):
+                # fp16 dynamic scaling: a scaled-grad overflow with a
+                # finite loss is the scaler's territory (it skips the
+                # step and shrinks the scale — routine during warmup);
+                # counting it against the anomaly budget would abort
+                # healthy fp16 runs
+                norm = None
+        step = self.global_steps + 1
+        if not s.observe(step, loss, norm):
+            return "ok"
+        if s.over_budget:
+            s.abort(step, loss, norm)
+        if s.policy == "warn":
+            return "ok"
+        if s.policy == "rewind":
+            if self._last_good_ckpt is not None:
+                self._sentinel_rewind()
+                return "rewind"
+            logger.warning(
+                "sentinel: rewind requested but no checkpoint has been "
+                "saved or loaded this run — skipping the step instead")
+        return "skip"
+
+    def _sentinel_rewind(self) -> None:
+        """Restore the last good checkpoint, preserving the sentinel's
+        anomaly bookkeeping across the load (a rewind must not reset the
+        budget, or a deterministic divergence loops forever)."""
+        load_dir, tag = self._last_good_ckpt
+        snapshot = self.sentinel.state_dict()
+        logger.error(f"sentinel: rewinding to checkpoint {tag!r} under "
+                     f"{load_dir}")
+        self.load_checkpoint(load_dir, tag=tag)
+        self.sentinel.load_state_dict(snapshot)
+        self.sentinel.record_rewind()
+
+    def _resolve_verified_tag(self, load_dir, tag):
+        """Manifest-verified tag resolution.  An EXPLICIT tag is a
+        contract — verification failure raises, never silently
+        substitutes different weights; a resume (tag=None) falls back to
+        the newest intact tag (bounded scan) instead of crashing or
+        loading garbage.  Multi-host: process 0 does the (full-CRC,
+        full-read) verification once and broadcasts the verdict — N
+        hosts re-reading every checkpoint byte would multiply resume IO,
+        and independent fallback scans could resolve different tags."""
+
+        def resolve_local():
+            from .resilience.recovery import (list_tags, resolve_intact_tag,
+                                              tag_problems)
+            if tag is not None:
+                problems = tag_problems(load_dir, tag)
+                if problems:
+                    raise FileNotFoundError(
+                        f"checkpoint tag {tag!r} under {load_dir} failed "
+                        f"verification: {problems}; available tags: "
+                        f"{list_tags(load_dir) or 'none'} (pass tag=None "
+                        f"to resume from the newest intact tag)")
+                return str(tag)
+            resolved, _ = resolve_intact_tag(
+                load_dir, None,
+                latest_tag=ckpt_mod.read_latest_tag(load_dir),
+                max_fallback_tags=self.resilience.max_fallback_tags)
+            return resolved
+
+        if jax.process_count() <= 1:
+            return resolve_local()
+        from jax.experimental import multihost_utils
+        payload = ""
+        if jax.process_index() == 0:
+            try:
+                payload = resolve_local()
+            except Exception as e:  # noqa: BLE001 — re-raised on ALL hosts
+                payload = "!" + str(e)
+        buf = np.zeros(1024, np.uint8)
+        raw = payload.encode("utf-8", errors="replace")[:1023]
+        buf[:len(raw)] = np.frombuffer(raw, np.uint8)
+        out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+        payload = bytes(out[:int(np.max(np.nonzero(out)[0], initial=-1)) + 1]
+                        ).decode("utf-8", errors="replace")
+        if payload.startswith("!"):
+            raise FileNotFoundError(
+                f"checkpoint verification failed on process 0: "
+                f"{payload[1:]}")
+        return payload
+
+    def _maybe_handle_preemption(self) -> None:
+        """Step-boundary half of the preemption protocol: the signal
+        handler only sets a flag; here we take the emergency checkpoint
+        (params/opt state are consistent between steps) and stop."""
+        if self._preemption is None:
+            return
+        triggered = self._preemption.triggered
+        if jax.process_count() > 1:
+            # signals land on hosts at different times; without agreement
+            # one host would enter the emergency save's collectives while
+            # the others run the next training step — mismatched
+            # collectives wedge the pod.  One tiny allgather per boundary
+            # makes the stop decision collective.
+            from jax.experimental import multihost_utils
+            flags = np.asarray(multihost_utils.process_allgather(
+                np.asarray([1 if triggered else 0], np.int32)))
+            if flags.max() and not triggered:
+                self._preemption.request_stop()  # adopt the peer's signal
+            triggered = bool(flags.max())
+        if not triggered:
+            return
+        pre = self.resilience.preemption
+        save_dir = pre.save_dir or self._last_save_dir
+        tag = None
+        if save_dir is not None:
+            tag = f"{pre.emergency_tag_prefix}_step{self.global_steps}"
+            try:
+                self.save_checkpoint(save_dir, tag=tag)
+            except Exception as e:  # noqa: BLE001 — still stop cleanly
+                logger.error(f"preemption: emergency checkpoint failed: {e}")
+                tag = None
+        else:
+            logger.error(
+                "preemption: no emergency save dir known (no prior "
+                "save_checkpoint and resilience.preemption.save_dir unset) "
+                "— stopping without an emergency checkpoint")
+        self._preemption.finalize(emergency_tag=tag)
 
     def _block_hvp(self, key):
         """Compiled-once per-block Hessian-vector product: (params, v,
@@ -1139,22 +1357,97 @@ class DeepSpeedEngine:
                 jax.random.key_data(self._rng)).tolist(),
             "engine_rng_impl": str(jax.random.key_impl(self._rng)),
         })
+        if self.sentinel is not None:
+            client["sentinel"] = self.sentinel.state_dict()
+        res = self.resilience
+        atomic = res.atomic_enabled
+        if atomic and jax.process_count() > 1 and \
+                not self._sharded_checkpoints():
+            # the consolidated layout has every process writing the same
+            # final dir (identical gathered data, last writer wins);
+            # per-process staged commits would race os.rename on it.
+            # Only the sharded layout coordinates multi-process commits
+            # (shared staging dir, process-0 committer).
+            logger.warning(
+                "resilience.atomic_checkpoints is not supported for "
+                "multi-process consolidated checkpoints — saving with the "
+                "legacy in-place layout (set checkpoint.sharded=true for "
+                "atomic multi-process saves)")
+            atomic = False
+
+        def run_io(fn, what):
+            if not res.enabled:
+                return fn()
+            from .resilience.atomic import retry_io
+            return retry_io(fn, retries=res.io_retries,
+                            backoff_seconds=res.io_backoff_seconds,
+                            what=what)
+
+        if atomic and jax.process_count() <= 1:
+            # sweep orphaned *.tmp.* staging dirs from crashed saves
+            # (skipped multi-process: another host may be mid-commit)
+            from .resilience.atomic import cleanup_tmp_dirs
+            cleanup_tmp_dirs(save_dir)
         if self._sharded_checkpoints():
             # per-process shard files keyed by global slice (reference:
             # engine.py:1821-1878 per-rank model/optim shards) — no host
             # materializes the full model
             from . import sharded_checkpoint as sc
-            path = os.path.join(save_dir, str(tag))
-            sc.save_sharded(path, "model", {"module": self.params})
+            if atomic:
+                # deterministic nonce: every process stages into the SAME
+                # dir without a broadcast round
+                os.makedirs(save_dir, exist_ok=True)
+                tmp_dir = os.path.join(
+                    save_dir, f"{tag}.tmp.g{self.global_steps}")
+                if jax.process_count() > 1:
+                    # crashed earlier saves (possibly a different world
+                    # size) may have left stale staging dirs — including
+                    # this very nonce, whose leftover shards would be
+                    # manifested and committed alongside fresh ones and
+                    # corrupt the restore.  Saves are collective, so no
+                    # other save is in flight: process 0 sweeps ALL
+                    # orphans, then everyone barriers before writing.
+                    from jax.experimental import multihost_utils
+                    from .resilience.atomic import cleanup_tmp_dirs
+                    if jax.process_index() == 0:
+                        cleanup_tmp_dirs(save_dir)
+                    multihost_utils.sync_global_devices(
+                        f"ckpt_stage_{tag}_g{self.global_steps}")
+                write_dir = tmp_dir
+            else:
+                tmp_dir = None
+                write_dir = os.path.join(save_dir, str(tag))
+            run_io(lambda: sc.save_sharded(
+                write_dir, "model", {"module": self.params}),
+                "sharded model save")
             # offload-tier optimizer states are host numpy arrays — the
             # sharded writer stores those whole from process 0
-            sc.save_sharded(path, "optim", self._engine_state())
-            sc.finalize_checkpoint(save_dir, tag, client,
-                                   save_latest=save_latest)
+            run_io(lambda: sc.save_sharded(
+                write_dir, "optim", self._engine_state()),
+                "sharded optimizer save")
+            if jax.process_count() > 1:
+                # finalize contains cross-process barriers: retrying it on
+                # ONE process would re-enter the collectives out of
+                # lockstep and wedge the pod — run it once, unwrapped
+                sc.finalize_checkpoint(save_dir, tag, client,
+                                       save_latest=save_latest,
+                                       tmp_dir=tmp_dir)
+            else:
+                run_io(lambda: sc.finalize_checkpoint(
+                    save_dir, tag, client, save_latest=save_latest,
+                    tmp_dir=tmp_dir), "checkpoint finalize")
+            path = os.path.join(save_dir, str(tag))
         else:
-            path = ckpt_mod.save_checkpoint_state(
+            path = run_io(lambda: ckpt_mod.save_checkpoint_state(
                 save_dir, tag, module_state={"module": self.params},
-                optimizer_state=self._engine_state(), client_state=client)
+                optimizer_state=self._engine_state(), client_state=client,
+                atomic=atomic), "checkpoint save")
+        if res.gc_enabled and jax.process_index() == 0:
+            from .resilience.recovery import gc_checkpoints
+            gc_checkpoints(save_dir, res.keep_last_n, res.keep_every,
+                           latest_tag=ckpt_mod.read_latest_tag(save_dir))
+        self._last_save_dir = save_dir
+        self._last_good_ckpt = (save_dir, str(tag))
         log_dist(f"saved checkpoint {path}", ranks=[0])
         return path
 
@@ -1165,6 +1458,8 @@ class DeepSpeedEngine:
         opt_tmpl = (None if load_module_only or not load_optimizer_states
                     else self._engine_state())
         resolved_tag = tag or ckpt_mod.read_latest_tag(load_dir)
+        if self.resilience.verify_enabled:
+            resolved_tag = self._resolve_verified_tag(load_dir, tag)
         sharded_index = os.path.join(load_dir, str(resolved_tag),
                                      "model_index.json")
         if os.path.isfile(sharded_index):
@@ -1192,7 +1487,7 @@ class DeepSpeedEngine:
                     client = json.load(f).get("client_state", {})
         else:
             module_state, opt_state, client = ckpt_mod.load_checkpoint_state(
-                load_dir, tag, module_tmpl, opt_tmpl,
+                load_dir, resolved_tag, module_tmpl, opt_tmpl,
                 strict=load_module_strict)
         self.params = module_state["module"]
         if opt_state is not None:
@@ -1214,6 +1509,8 @@ class DeepSpeedEngine:
             self.global_steps = client.get("global_steps", 0)
             self.micro_steps = client.get("micro_steps", 0)
             self.skipped_steps = client.get("skipped_steps", 0)
+            if self.sentinel is not None and client.get("sentinel"):
+                self.sentinel.load_state_dict(client["sentinel"])
             if self.quantizer is not None and client.get("quantizer"):
                 self.quantizer.load_state_dict(client["quantizer"])
             if self.curriculum_scheduler is not None and client.get(
@@ -1231,12 +1528,21 @@ class DeepSpeedEngine:
                 except Exception as e:  # noqa: BLE001 — old/foreign ckpt
                     log_dist(f"engine_rng restore skipped: {e}", ranks=[0])
         load_path = os.path.join(load_dir, str(resolved_tag))
+        self._last_save_dir = load_dir
+        self._last_good_ckpt = (load_dir, str(resolved_tag))
         log_dist(f"loaded checkpoint {load_path}", ranks=[0])
         return load_path, client
 
     def _check_tag(self, tag):
         """Validate tag agreement across hosts (reference: engine.py:2112-2127
         does this with a bytes-allreduce).  Single-process always agrees."""
+        if ".tmp." in str(tag) or ".old." in str(tag):
+            # reserved by the atomic commit protocol: such a tag would be
+            # invisible to tag discovery and swept by staging-dir cleanup
+            raise ValueError(
+                f"checkpoint tag {tag!r} contains a reserved marker "
+                "('.tmp.' / '.old.' name in-flight checkpoint dirs) — "
+                "pick a different tag")
         mode = self.config.checkpoint_config.tag_validation
         if jax.process_count() <= 1 or mode == "IGNORE":
             return

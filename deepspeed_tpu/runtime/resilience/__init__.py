@@ -1,0 +1,24 @@
+"""Fault-tolerance subsystem: atomic checksummed checkpoints, verified
+load with fallback + retention GC, preemption-safe saves, and a
+training-health sentinel.  Wired through the engine behind the
+``resilience`` config block (all off by default); see docs/resilience.md.
+"""
+
+from .atomic import (cleanup_tmp_dirs, commit_tag_dir, file_crc32,
+                     has_manifest, is_tmp_dir, is_working_dir, retry_io,
+                     tmp_tag_dir, verify_manifest, write_latest_atomic,
+                     write_manifest, MANIFEST_FILE)
+from .preemption import PreemptionHandler, TrainingInterrupted
+from .recovery import (gc_checkpoints, list_tags, rescue_renamed_aside,
+                       resolve_intact_tag, tag_problems, tag_step)
+from .sentinel import SentinelAbort, TrainingSentinel
+
+__all__ = [
+    "MANIFEST_FILE", "PreemptionHandler", "SentinelAbort",
+    "TrainingInterrupted", "TrainingSentinel", "cleanup_tmp_dirs",
+    "commit_tag_dir", "file_crc32", "gc_checkpoints", "has_manifest",
+    "is_tmp_dir", "is_working_dir", "list_tags", "rescue_renamed_aside",
+    "resolve_intact_tag", "retry_io", "tag_problems", "tag_step",
+    "tmp_tag_dir", "verify_manifest", "write_latest_atomic",
+    "write_manifest",
+]

@@ -106,23 +106,50 @@ def save_sharded(ckpt_dir: str, name: str, tree: Any) -> None:
 
 
 def finalize_checkpoint(save_dir: str, tag: str, client_state: Dict,
-                        save_latest: bool = True) -> None:
+                        save_latest: bool = True,
+                        tmp_dir: Optional[str] = None) -> None:
     """Barrier until EVERY process's shard files are on disk, then process
     0 writes ds_meta.json and (optionally) `latest` — so `latest` never
     names a checkpoint missing another process's shards (the reference
     barriers before the rank-0 bookkeeping the same way,
-    engine.py:2311-2320)."""
+    engine.py:2311-2320).
+
+    With `tmp_dir` (the atomic commit protocol: all processes wrote their
+    shards into a shared ``<tag>.tmp.<nonce>/`` staging dir), process 0
+    additionally fsyncs + manifests the staged files and renames the dir
+    into place before touching `latest` — a preemption mid-save leaves
+    the previous tag intact.  The `latest` write is always tmp-file +
+    atomic rename (plain bugfix: the in-place rewrite could be observed
+    half-written)."""
     from .checkpoint import LATEST_FILE, jsonable
+    from .resilience.atomic import commit_tag_dir, write_latest_atomic
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices(f"ckpt_shards_{tag}")
     if jax.process_index() == 0:
-        ckpt_dir = os.path.join(save_dir, str(tag))
-        with open(os.path.join(ckpt_dir, "ds_meta.json"), "w") as f:
-            json.dump({"client_state": jsonable(client_state or {})}, f)
+        final_dir = os.path.join(save_dir, str(tag))
+        already_committed = (tmp_dir is not None and
+                             not os.path.isdir(tmp_dir) and
+                             os.path.isdir(final_dir))
+        if already_committed:
+            # idempotent re-entry: a retry wrapper may re-invoke finalize
+            # after the commit rename succeeded but a later step (e.g.
+            # the `latest` write) failed transiently — ds_meta.json and
+            # the manifest already live in the committed dir
+            pass
+        else:
+            ckpt_dir = tmp_dir if tmp_dir is not None else final_dir
+            with open(os.path.join(ckpt_dir, "ds_meta.json"), "w") as f:
+                json.dump({"client_state": jsonable(client_state or {})}, f)
+            if tmp_dir is not None:
+                commit_tag_dir(save_dir, str(tag), tmp_dir)
         if save_latest:
-            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-                f.write(str(tag))
+            write_latest_atomic(save_dir, str(tag), LATEST_FILE)
+    if jax.process_count() > 1:
+        # no process returns (and possibly starts the next save into the
+        # same dir) until the commit is visible
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(f"ckpt_commit_{tag}")
 
 
 class _ShardCatalog:

@@ -18,6 +18,29 @@ REPO = Path(__file__).resolve().parents[2]
 MIN_COLLECTED = 400
 
 
+def test_resilience_package_imports_cleanly():
+    """The resilience package is imported lazily by the engine (only when
+    the config block is on), so a syntax/import error in it would not
+    surface in most tests — and an ImportError in test_resilience.py
+    would just shrink the suite under --continue-on-collection-errors.
+    Import every module explicitly, in a subprocess, so it fails loudly."""
+    mods = ("deepspeed_tpu.runtime.resilience",
+            "deepspeed_tpu.runtime.resilience.atomic",
+            "deepspeed_tpu.runtime.resilience.recovery",
+            "deepspeed_tpu.runtime.resilience.preemption",
+            "deepspeed_tpu.runtime.resilience.sentinel",
+            "deepspeed_tpu.runtime.resilience.fault_injection")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import importlib\n"
+         + "\n".join(f"importlib.import_module({m!r})" for m in mods)],
+        cwd=str(REPO), capture_output=True, text=True, timeout=120, env=env)
+    assert out.returncode == 0, (
+        f"resilience package import failed:\n{out.stderr[-2000:]}")
+
+
 def test_unit_suite_collects_cleanly():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
