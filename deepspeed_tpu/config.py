@@ -402,10 +402,18 @@ class TensorboardConfig:
     enabled: bool = C.TENSORBOARD_ENABLED_DEFAULT
     output_path: str = C.TENSORBOARD_OUTPUT_PATH_DEFAULT
     job_name: str = C.TENSORBOARD_JOB_NAME_DEFAULT
+    # scalar-write cadence in optimizer steps; None inherits steps_per_print
+    # (writing every step forces a device sync per step — see engine.step)
+    write_interval: Optional[int] = C.TENSORBOARD_WRITE_INTERVAL_DEFAULT
 
     @staticmethod
     def from_dict(d: Optional[Dict[str, Any]]) -> "TensorboardConfig":
         d = d or {}
+        interval = get_scalar_param(d, C.TENSORBOARD_WRITE_INTERVAL,
+                                    C.TENSORBOARD_WRITE_INTERVAL_DEFAULT)
+        if interval is not None and int(interval) <= 0:
+            raise DeepSpeedConfigError(
+                f"tensorboard.write_interval must be positive, got {interval}")
         return TensorboardConfig(
             enabled=get_scalar_param(d, C.TENSORBOARD_ENABLED,
                                      C.TENSORBOARD_ENABLED_DEFAULT),
@@ -413,7 +421,27 @@ class TensorboardConfig:
                                          C.TENSORBOARD_OUTPUT_PATH_DEFAULT),
             job_name=get_scalar_param(d, C.TENSORBOARD_JOB_NAME,
                                       C.TENSORBOARD_JOB_NAME_DEFAULT),
+            write_interval=None if interval is None else int(interval),
         )
+
+
+@dataclass
+class FusedStepConfig:
+    """Fused whole-step train program (docs/fused_step.md): gradient
+    accumulation as an in-program ``lax.scan`` + the optimizer apply in the
+    same compiled program — one XLA dispatch per optimizer step.  Off by
+    default; the engine falls back to the modular forward/backward/step
+    loop automatically whenever a host-interactive feature is active (the
+    fallback matrix is logged and exposed as ``engine.fused_step_reason``).
+    """
+    enabled: bool = C.FUSED_STEP_ENABLED_DEFAULT
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "FusedStepConfig":
+        d = d or {}
+        return FusedStepConfig(
+            enabled=get_scalar_param(d, C.FUSED_STEP_ENABLED,
+                                     C.FUSED_STEP_ENABLED_DEFAULT))
 
 
 @dataclass
@@ -836,6 +864,8 @@ class DeepSpeedConfig:
             pd.get(C.FLOPS_PROFILER))
         self.tensorboard_config = TensorboardConfig.from_dict(
             pd.get(C.TENSORBOARD))
+        self.fused_step_config = FusedStepConfig.from_dict(
+            pd.get(C.FUSED_STEP))
         self.eigenvalue_config = EigenvalueConfig.from_dict(pd.get(C.EIGENVALUE))
         self.pld_config = PLDConfig.from_dict(pd.get(C.PROGRESSIVE_LAYER_DROP))
         self.curriculum_config = CurriculumConfig.from_dict(

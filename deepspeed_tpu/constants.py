@@ -130,6 +130,23 @@ MEMORY_BREAKDOWN = "memory_breakdown"
 MEMORY_BREAKDOWN_DEFAULT = False
 
 #############################################
+# Fused whole-step train program (TPU-native addition; docs/fused_step.md)
+#
+# One jitted program per optimizer step: gradient accumulation as a
+# lax.scan over a leading microbatch axis + the optimizer/loss-scale
+# update in the same program — 1 XLA dispatch instead of 2N+1, grad
+# buffers never leave the program, and XLA's latency-hiding scheduler
+# overlaps microbatch i's grad collective with microbatch i+1's compute.
+# Off by default; host-interactive features (offload optimizer,
+# eigenvalue/MoQ, sentinel rewind or grad-norm monitoring, PLD,
+# curriculum, custom grad programs) automatically fall back to the
+# modular forward/backward/step loop.
+#############################################
+FUSED_STEP = "fused_step"
+FUSED_STEP_ENABLED = "enabled"
+FUSED_STEP_ENABLED_DEFAULT = False
+
+#############################################
 # Tensorboard
 #############################################
 TENSORBOARD = "tensorboard"
@@ -139,6 +156,12 @@ TENSORBOARD_OUTPUT_PATH = "output_path"
 TENSORBOARD_OUTPUT_PATH_DEFAULT = ""
 TENSORBOARD_JOB_NAME = "job_name"
 TENSORBOARD_JOB_NAME_DEFAULT = "DeepSpeedJobName"
+# Summary-writer cadence: scalars are written (and the loss/LR device
+# reads forced) only every `write_interval` steps — None inherits
+# steps_per_print.  Per-step writes would force a device sync each step
+# and drain the dispatch queue (the async-host-loop fix, PR 3).
+TENSORBOARD_WRITE_INTERVAL = "write_interval"
+TENSORBOARD_WRITE_INTERVAL_DEFAULT = None
 
 #############################################
 # ZeRO optimization

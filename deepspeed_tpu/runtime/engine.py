@@ -298,6 +298,35 @@ class DeepSpeedEngine:
         # ---- compiled programs --------------------------------------- #
         self._build_functions()
 
+        # ---- fused whole-step program (off by default) --------------- #
+        # One dispatch per optimizer step: grad accumulation as a lax.scan
+        # + in-program apply (runtime/fused_step.py; docs/fused_step.md).
+        # Host-interactive features fall back to the modular loop — the
+        # reason is logged once and kept on `fused_step_reason`.
+        self._fused_step_fn = None
+        self._fused_sent_state = ()
+        self._fused_pending_flags = []
+        self.fused_step_reason = None
+        if self.config.fused_step_config.enabled:
+            from .fused_step import (build_fused_step, fused_fallback_reason,
+                                     sentinel_state_from_host)
+            reason = fused_fallback_reason(self)
+            if reason is not None:
+                self.fused_step_reason = reason
+                logger.warning(
+                    "fused_step: falling back to the modular forward/"
+                    f"backward/step loop — {reason}")
+            else:
+                if self.sentinel is not None:
+                    self._fused_sent_state = sentinel_state_from_host(
+                        self.sentinel, self.mesh_ctx)
+                self._fused_step_fn = build_fused_step(self)
+                log_dist(
+                    f"fused_step: 1 dispatch per optimizer step "
+                    f"(gas={self.gradient_accumulation_steps()}; modular "
+                    f"loop would issue "
+                    f"{2 * self.gradient_accumulation_steps()})", ranks=[0])
+
         # ---- data ---------------------------------------------------- #
         self.training_dataloader = self._configure_dataloader(
             training_data, collate_fn)
@@ -372,6 +401,11 @@ class DeepSpeedEngine:
         self._last_loss = None
         self._last_overflow = None
         self._summary_writer = self._configure_tensorboard()
+        # Summary scalars (and the loss/LR device reads they force) are
+        # coalesced to this boundary — per-step writes would sync the
+        # device every step (see _boundary_logging).
+        self._tb_write_interval = (self.config.tensorboard_config.
+                                   write_interval or self.steps_per_print())
         self._is_train_mode = True
 
         log_dist(
@@ -705,6 +739,9 @@ class DeepSpeedEngine:
                 return loss, grads
 
         replicated = self.mesh_ctx.replicated()
+        # the un-jitted body doubles as the fused whole-step program's scan
+        # body (runtime/fused_step.py) — one definition, two compilations
+        self._loss_and_grads = loss_and_grads
         self._grad_fn = jax.jit(
             loss_and_grads,
             out_shardings=(replicated, self.grad_shardings))
@@ -733,6 +770,7 @@ class DeepSpeedEngine:
             # Offload path: the optimizer step is host-side (HostOffload /
             # NVMe swapper); no compiled apply program.
             self._apply_fn = None
+            self._apply_core = None
             return
 
         def apply_step(params, opt_state, scaler_state, grads, healthy=None):
@@ -779,6 +817,8 @@ class DeepSpeedEngine:
         # expected warning is filtered once, on first engine build
         # (_install_donation_warning_filter at top of file).
         _install_donation_warning_filter()
+        # un-jitted apply body reused as the fused program's epilogue
+        self._apply_core = apply_step
         self._apply_fn = jax.jit(
             apply_step,
             out_shardings=(self.param_shardings, self.opt_shardings,
@@ -804,6 +844,29 @@ class DeepSpeedEngine:
             x = jnp.asarray(x) if not isinstance(x, jax.Array) else x
             if getattr(x, "ndim", 0) >= 1 and x.shape[0] % dp == 0:
                 return jax.device_put(x, self.mesh_ctx.data_sharding())
+            return jax.device_put(x, self.mesh_ctx.replicated())
+        return jax.tree.map(place, tree)
+
+    def _shard_stacked_batch(self, tree):
+        """Placement for fused-step input: leaves carry a leading [gas]
+        microbatch (scan) axis, so the data-parallel batch dim is axis 1
+        (same decision rule as _shard_batch, shifted by one)."""
+        dp = self.world_size
+        multihost = jax.process_count() > 1
+        stacked_data = self.mesh_ctx.sharding(
+            None, (mesh_mod.DATA_AXIS, mesh_mod.EXPERT_AXIS))
+
+        def place(x):
+            if multihost:
+                x = np.asarray(x)
+                if x.ndim >= 2:
+                    return jax.make_array_from_process_local_data(
+                        stacked_data, x)
+                return jax.make_array_from_process_local_data(
+                    self.mesh_ctx.replicated(), x)
+            x = jnp.asarray(x) if not isinstance(x, jax.Array) else x
+            if getattr(x, "ndim", 0) >= 2 and x.shape[1] % dp == 0:
+                return jax.device_put(x, stacked_data)
             return jax.device_put(x, self.mesh_ctx.replicated())
         return jax.tree.map(place, tree)
 
@@ -999,11 +1062,29 @@ class DeepSpeedEngine:
                     self.params = self._quantize_fn(bits)(
                         self.params, self._next_rng())
         self.tput_timer.stop(global_step=True)
+        self._boundary_logging()
+        if self.wall_clock_breakdown():
+            self.timers(STEP_MICRO_TIMER).stop()
+        self._maybe_handle_preemption()
 
-        if self.global_steps % self.steps_per_print() == 0:
-            loss_val = (float(self._last_loss)
-                        if self._last_loss is not None else float("nan"))
-            lr = self.get_lr()[0]
+    def _boundary_logging(self):
+        """Coalesced host reads: the loss fetch (`float(self._last_loss)`),
+        `get_lr()` (whose applied-step count reads an opt-state scalar),
+        and the summary-writer scalars each force a device sync, so they
+        run ONLY at steps_per_print / tensorboard.write_interval
+        boundaries — off-boundary steps leave the dispatch queue deep.
+        (The fp16 dynamic-scaling overflow fetch in step() is the one
+        deliberate per-step read; sentinel monitoring documents its own.)
+        """
+        print_b = self.global_steps % self.steps_per_print() == 0
+        write_b = (self._summary_writer is not None and
+                   self.global_steps % self._tb_write_interval == 0)
+        if not (print_b or write_b):
+            return
+        loss_val = (float(self._last_loss)
+                    if self._last_loss is not None else float("nan"))
+        lr = self.get_lr()[0]
+        if print_b:
             extra = f", skipped={self.skipped_steps}"
             if self.sentinel is not None:
                 c = self.sentinel.counters()
@@ -1013,16 +1094,12 @@ class DeepSpeedEngine:
             log_dist(f"step={self.global_steps}, loss={loss_val:.6f}, "
                      f"lr={lr:.3e}, loss_scale={self.loss_scale:g}{extra}",
                      ranks=[0])
-        if self._summary_writer is not None:
+        if write_b:
             self._summary_writer.add_scalar(
-                "Train/Samples/train_loss", float(self._last_loss),
+                "Train/Samples/train_loss", loss_val,
                 self.global_steps * self.train_batch_size())
-            self._summary_writer.add_scalar("Train/Samples/lr",
-                                            self.get_lr()[0],
+            self._summary_writer.add_scalar("Train/Samples/lr", lr,
                                             self.global_steps)
-        if self.wall_clock_breakdown():
-            self.timers(STEP_MICRO_TIMER).stop()
-        self._maybe_handle_preemption()
 
     # ------------------------------------------------------------------ #
     # resilience: sentinel + preemption (docs/resilience.md)
@@ -1287,15 +1364,23 @@ class DeepSpeedEngine:
     # train_batch convenience: full GAS loop in one call
     # ------------------------------------------------------------------ #
     def train_batch(self, data_iter=None):
-        """Run gradient_accumulation_steps micro-steps + one optimizer step.
+        """Run gradient_accumulation_steps micro-steps + one optimizer step
+        (mirrors the reference PipelineEngine.train_batch API).
 
-        (The non-pipeline reference leaves this loop to user code; provided
-        here because it is the natural TPU entry point for a whole batch.)"""
+        With ``fused_step.enabled`` (and no fallback feature active) the
+        whole batch is ONE compiled dispatch — scan-based accumulation plus
+        the in-program apply (runtime/fused_step.py); the returned loss is
+        a device scalar (mean over the gas microbatches) that the caller
+        may float() when it actually needs the value.  Otherwise the
+        modular forward/backward/step loop runs, fetching the losses once
+        at the end of the batch instead of once per microbatch."""
         if data_iter is None:
             if self.training_dataloader is None:
                 raise ValueError("train_batch needs data_iter or training_data")
             data_iter = iter(self.training_dataloader)
-        total = 0.0
+        if self._fused_step_fn is not None and self._is_train_mode:
+            return self._fused_train_batch(data_iter)
+        losses = []
         for _ in range(self.gradient_accumulation_steps()):
             batch = next(data_iter)
             if not isinstance(batch, tuple):
@@ -1303,8 +1388,112 @@ class DeepSpeedEngine:
             loss = self.forward(*batch)
             self.backward(loss)
             self.step()
-            total += float(loss)
-        return total / self.gradient_accumulation_steps()
+            losses.append(loss)
+        # one host fetch AFTER the whole window is dispatched (not one per
+        # microbatch) so the queue stays deep across the accumulation loop
+        return float(np.mean([np.asarray(l) for l in losses]))
+
+    def _fused_train_batch(self, data_iter):
+        """One fused dispatch: pull gas microbatches, stack them on a
+        leading scan axis, run the whole-step program, then do the same
+        host bookkeeping step() would — minus the per-microbatch fences."""
+        from .dataloader import stack_microbatches
+        gas = self.gradient_accumulation_steps()
+        batches = []
+        for _ in range(gas):
+            b = next(data_iter)
+            batches.append(b if isinstance(b, tuple) else (b,))
+        if self.wall_clock_breakdown():
+            self.timers(STEP_MICRO_TIMER).start()
+        self.tput_timer.start()
+        args = self._shard_stacked_batch(stack_microbatches(batches))
+        rng = self._next_rng()
+        (self.params, self.opt_state, self.scaler_state,
+         self._fused_sent_state, loss, overflow,
+         sent_flags) = self._fused_step_fn(
+            self.params, self.opt_state, self.scaler_state,
+            self._fused_sent_state, rng, args, {})
+        self._last_loss = loss
+        self._last_overflow = overflow
+        self.micro_steps += gas
+        self.global_steps += 1
+        # Mirror step()'s skip/scheduler chain exactly: a sentinel skip
+        # wins over the overflow branch (counted once), and the host
+        # scheduler never advances on a skipped step.  The skip_step
+        # policy's verdict is a per-step scalar fetch — like the modular
+        # path's per-step host observe, opting into monitoring opts into
+        # that read; policy "warn" stays fully async (verdicts drain at
+        # boundaries).
+        sentinel_skip = False
+        if self.sentinel is not None and self.sentinel.policy == "skip_step":
+            sentinel_skip = bool(sent_flags[0])
+        if sentinel_skip:
+            self.skipped_steps += 1
+            self.sentinel.record_skip()
+        elif self.scaler_cfg.dynamic:
+            # fp16 keeps its one scalar overflow fetch per optimizer step
+            # (exactly like the modular path — skipped_steps and the
+            # python-side scheduler must stay faithful); amortized over
+            # gas microbatches in one program it is the only read here
+            if bool(overflow):
+                self.skipped_steps += 1
+            elif self.lr_scheduler is not None:
+                self.lr_scheduler.step()
+        elif self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        if self.sentinel is not None:
+            self._fused_pending_flags.append(
+                (self.global_steps, loss, sent_flags))
+            if (self.global_steps % self.steps_per_print() == 0
+                    or len(self._fused_pending_flags) >= 32):
+                self._drain_fused_sentinel()
+            if self.sentinel.over_budget:
+                # a deferred (non-raising) drain — e.g. from a checkpoint
+                # save — may have exhausted the budget without aborting;
+                # stop at the next step boundary
+                self.sentinel.abort(self.global_steps,
+                                    float(self._last_loss))
+        self.tput_timer.stop(global_step=True)
+        self._boundary_logging()
+        if self.wall_clock_breakdown():
+            self.timers(STEP_MICRO_TIMER).stop()
+        self._maybe_handle_preemption()
+        return loss
+
+    def _drain_fused_sentinel(self, raise_abort=True):
+        """Fold the fused program's per-step sentinel verdicts into the
+        host sentinel's counters/budget.  The flags are tiny device bools
+        already computed — draining at boundaries (or every 32 steps)
+        batches the syncs instead of fencing every step; the abort-budget
+        check consequently fires with up to that much latency
+        (docs/fused_step.md).  skipped_steps is NOT counted here — the
+        per-step chain in _fused_train_batch owns it, mirroring step().
+
+        raise_abort=False defers a budget-exhaustion abort to the next
+        step boundary: a drain running inside save_checkpoint (e.g. the
+        preemption emergency save) must never turn the save into a
+        SentinelAbort and lose the checkpoint."""
+        s = self.sentinel
+        pending, self._fused_pending_flags = self._fused_pending_flags, []
+        for step, loss, (flagged, nonfinite) in pending:
+            if not bool(flagged):
+                s.consecutive_anomalies = 0
+                continue
+            nf = bool(nonfinite)
+            loss_val = float(loss)
+            s.anomalies_seen += 1
+            s.last_reasons = [
+                f"loss is non-finite ({loss_val})" if nf else
+                f"loss {loss_val:.6g} exceeded k-sigma in-program "
+                f"(k={s.k_sigma})"]
+            if not (s.policy == "warn" and not nf):
+                s.consecutive_anomalies += 1
+            logger.warning(
+                f"sentinel(fused): anomaly at step {step} "
+                f"({s.consecutive_anomalies}/{s.anomaly_budget} "
+                f"consecutive): {s.last_reasons[0]}")
+            if s.over_budget and raise_abort:
+                s.abort(step, loss_val)
 
     # ------------------------------------------------------------------ #
     # memory estimate (reference: stage2.py:2141)
@@ -1358,6 +1547,14 @@ class DeepSpeedEngine:
             "engine_rng_impl": str(jax.random.key_impl(self._rng)),
         })
         if self.sentinel is not None:
+            if self._fused_step_fn is not None:
+                # fold the in-program loss EWMA + pending verdicts into the
+                # host sentinel so state_dict captures what the fused
+                # program learned; never abort from inside a save (the
+                # preemption emergency checkpoint must complete)
+                self._drain_fused_sentinel(raise_abort=False)
+                from .fused_step import sentinel_state_to_host
+                sentinel_state_to_host(self._fused_sent_state, self.sentinel)
             client["sentinel"] = self.sentinel.state_dict()
         res = self.resilience
         atomic = res.atomic_enabled
@@ -1511,6 +1708,11 @@ class DeepSpeedEngine:
             self.skipped_steps = client.get("skipped_steps", 0)
             if self.sentinel is not None and client.get("sentinel"):
                 self.sentinel.load_state_dict(client["sentinel"])
+                if self._fused_step_fn is not None:
+                    from .fused_step import sentinel_state_from_host
+                    self._fused_pending_flags = []
+                    self._fused_sent_state = sentinel_state_from_host(
+                        self.sentinel, self.mesh_ctx)
             if self.quantizer is not None and client.get("quantizer"):
                 self.quantizer.load_state_dict(client["quantizer"])
             if self.curriculum_scheduler is not None and client.get(

@@ -12,7 +12,6 @@ from dataclasses import dataclass
 from typing import NamedTuple
 
 import jax.numpy as jnp
-from jax import lax
 
 
 @dataclass(frozen=True)
@@ -65,33 +64,36 @@ def update_loss_scale(cfg: LossScalerConfig, state: LossScaleState,
     - overflow & hysteresis left      → burn one hysteresis credit
     - clean step                      → good_steps += 1; after scale_window
       consecutive clean steps, scale *= factor and hysteresis resets
+
+    Select form (jnp.where), not lax.cond: the transition is three scalar
+    selects, and a cond would keep both branches' operands alive across the
+    branch boundary — inside the fused whole-step program that blocks XLA
+    from fusing the scaler update into the apply epilogue, the same
+    donation/aliasing argument as the engine's per-leaf overflow skip.
     """
     if not cfg.dynamic:
         return state
     overflow = jnp.asarray(overflow)
 
-    def on_overflow(s: LossScaleState):
-        exhausted = s.hysteresis <= 1
-        new_scale = jnp.where(
-            exhausted,
-            jnp.maximum(s.loss_scale / cfg.scale_factor, cfg.min_loss_scale),
-            s.loss_scale)
-        new_hyst = jnp.where(exhausted, s.hysteresis, s.hysteresis - 1)
-        return LossScaleState(loss_scale=new_scale,
-                              good_steps=jnp.zeros_like(s.good_steps),
-                              hysteresis=new_hyst)
+    exhausted = state.hysteresis <= 1
+    of_scale = jnp.where(
+        exhausted,
+        jnp.maximum(state.loss_scale / cfg.scale_factor, cfg.min_loss_scale),
+        state.loss_scale)
+    of_hyst = jnp.where(exhausted, state.hysteresis, state.hysteresis - 1)
 
-    def on_clean(s: LossScaleState):
-        grow = (s.good_steps + 1) % cfg.scale_window == 0
-        new_scale = jnp.where(grow, s.loss_scale * cfg.scale_factor,
-                              s.loss_scale)
-        new_hyst = jnp.where(grow, jnp.asarray(cfg.init_hysteresis, jnp.int32),
-                             s.hysteresis)
-        return LossScaleState(loss_scale=new_scale,
-                              good_steps=s.good_steps + 1,
-                              hysteresis=new_hyst)
+    grow = (state.good_steps + 1) % cfg.scale_window == 0
+    clean_scale = jnp.where(grow, state.loss_scale * cfg.scale_factor,
+                            state.loss_scale)
+    clean_hyst = jnp.where(grow,
+                           jnp.asarray(cfg.init_hysteresis, jnp.int32),
+                           state.hysteresis)
 
-    return lax.cond(overflow, on_overflow, on_clean, state)
+    return LossScaleState(
+        loss_scale=jnp.where(overflow, of_scale, clean_scale),
+        good_steps=jnp.where(overflow, jnp.zeros_like(state.good_steps),
+                             state.good_steps + 1),
+        hysteresis=jnp.where(overflow, of_hyst, clean_hyst))
 
 
 # API-parity shims (reference exposes these names).

@@ -184,15 +184,27 @@ class NVMeOffloadOptimizer:
         g_all = None
         if not all(np.isfinite(g).all() for g in g_float.values()):
             return None
+
+        def writable(i):
+            # np.asarray of a device array is a zero-copy READ-ONLY view
+            # when dtypes match; in-place scaling/clipping (gas>1 or fp16)
+            # must copy that leaf first — lazily, so gas=1/no-clip keeps
+            # the zero-copy path
+            if not g_float[i].flags.writeable:
+                g_float[i] = g_float[i].copy()
+            return g_float[i]
+
         if scale_inv != 1.0:
-            for g in g_float.values():
+            for i in list(g_float):
+                g = writable(i)
                 g *= scale_inv
         if self.gradient_clipping > 0.0:
             sq = sum(float(np.vdot(g, g).real) for g in g_float.values())
             norm = float(np.sqrt(sq))
             if norm > self.gradient_clipping:
                 clip = self.gradient_clipping / (norm + 1e-6)
-                for g in g_float.values():
+                for i in list(g_float):
+                    g = writable(i)
                     g *= clip
 
         self._step += 1

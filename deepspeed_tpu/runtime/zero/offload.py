@@ -92,14 +92,26 @@ class HostOffloadOptimizer:
         finite = all(np.isfinite(g).all() for g in g_leaves)
         if not finite:
             return None
+
+        def writable(i):
+            # np.asarray of a device array is a zero-copy READ-ONLY view
+            # when dtypes match (the fast gas=1/no-clip path never touches
+            # it); in-place scaling/clipping must copy that leaf first —
+            # lazily, so the copy cost is only paid where a write happens
+            if not g_leaves[i].flags.writeable:
+                g_leaves[i] = g_leaves[i].copy()
+            return g_leaves[i]
+
         if scale_inv != 1.0:
-            for g in g_leaves:
+            for i in range(len(g_leaves)):
+                g = writable(i)
                 g *= scale_inv
         if self.gradient_clipping > 0.0:
             norm = _global_grad_norm(g_leaves)
             if norm > self.gradient_clipping:
                 clip = self.gradient_clipping / (norm + 1e-6)
-                for g in g_leaves:
+                for i in range(len(g_leaves)):
+                    g = writable(i)
                     g *= clip
         if store_dtype == jnp.bfloat16:
             # Native fused update+cast writes the device-bound bf16 copy;

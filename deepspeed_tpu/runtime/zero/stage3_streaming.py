@@ -33,6 +33,16 @@ over the ZeRO ("data","expert") axes:
 Tensor-parallel ("model") and any other non-ZeRO axes stay *automatic*
 (GSPMD) inside the region — explicit ZeRO streaming composes with
 declarative TP.
+
+Scan-in-scan (fused whole-step program, runtime/fused_step.py): the fused
+train step wraps this layer scan in an OUTER ``lax.scan`` over the
+microbatch axis.  No special casing is needed here, but the composition
+leans on an invariant of this file: the ``zero3_gathered`` checkpoint-name
+policy (see ``gather_group``) is what keeps the outer scan's VJP from
+stacking per-microbatch gathered groups as residuals — without it the
+fused program would save gas × (full unsharded model) and defeat max_live
+across microbatches, not just within one.  Tested by
+test_fused_step.py::test_fused_zero3_streaming_parity.
 """
 
 from dataclasses import dataclass

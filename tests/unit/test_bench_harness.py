@@ -389,6 +389,34 @@ def test_time_steps_gas_alignment(monkeypatch):
     assert n == 12 and calls["n"] == 13
 
 
+def test_wall_budget_emits_and_exits_zero_before_driver_timeout():
+    """Round-4 regression (BENCH_r04 rc=124): the probe loop outlived the
+    driver's window, so the diagnostic line arrived only via the TERM
+    handler and the run was still recorded as a timeout kill.  With
+    DS_BENCH_WALL_BUDGET the bench must emit its one JSON line and exit 0
+    ON ITS OWN CLOCK — no external signal."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DS_BENCH_PROBE_PLATFORM"] = "no_such_platform"  # wedge the probes
+    env["DS_BENCH_WALL_BUDGET"] = "3"
+    env.pop("DS_BENCH_LADDER", None)
+    env["DS_BENCH_LADDER"] = "/nonexistent/ladder.jsonl"  # hermetic: 0.0 path
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--config", "gpt2"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env, cwd=str(REPO), timeout=120)
+    elapsed = time.time() - t0
+    assert proc.returncode == 0, proc.stdout
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    payload = json.loads(lines[0])
+    assert payload["metric"] == "gpt2_124m_train_tokens_per_sec_1chip"
+    assert "wall-clock budget" in payload["error"]
+    # the whole point: the bench beat the (simulated) driver window
+    assert elapsed < 60, f"budgeted bench took {elapsed:.0f}s"
+
+
 def test_benches_and_metric_names_stay_in_sync():
     """Every --config has an error-path metric entry and vice versa, and
     the success-path metric a bench emits matches it — a drifted entry

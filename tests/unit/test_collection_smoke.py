@@ -19,17 +19,19 @@ MIN_COLLECTED = 400
 
 
 def test_resilience_package_imports_cleanly():
-    """The resilience package is imported lazily by the engine (only when
-    the config block is on), so a syntax/import error in it would not
-    surface in most tests — and an ImportError in test_resilience.py
-    would just shrink the suite under --continue-on-collection-errors.
-    Import every module explicitly, in a subprocess, so it fails loudly."""
+    """Lazily-imported engine modules (resilience: only when the config
+    block is on; fused_step: only when fused_step.enabled) would not
+    surface a syntax/import error in most tests — and an ImportError in
+    their test modules would just shrink the suite under
+    --continue-on-collection-errors.  Import each explicitly, in a
+    subprocess, so it fails loudly."""
     mods = ("deepspeed_tpu.runtime.resilience",
             "deepspeed_tpu.runtime.resilience.atomic",
             "deepspeed_tpu.runtime.resilience.recovery",
             "deepspeed_tpu.runtime.resilience.preemption",
             "deepspeed_tpu.runtime.resilience.sentinel",
-            "deepspeed_tpu.runtime.resilience.fault_injection")
+            "deepspeed_tpu.runtime.resilience.fault_injection",
+            "deepspeed_tpu.runtime.fused_step")
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     out = subprocess.run(
@@ -59,3 +61,28 @@ def test_unit_suite_collects_cleanly():
     assert count >= MIN_COLLECTED, (
         f"only {count} tests collected (expected >= {MIN_COLLECTED}) — "
         "did a module or parametrization silently vanish?")
+
+
+def test_fused_step_tests_run_in_fast_lane():
+    """Fast-lane marker audit: the fused-step regression surface (parity,
+    dispatch count, fallback matrix) must run in tier-1, i.e. survive the
+    `-m "not slow"` deselection — a conftest _SLOW_PREFIXES entry or a
+    stray marker would silently drop the whole module from the gate."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/unit/test_fused_step.py",
+         "--collect-only", "-q", "-m", "not slow", "-p", "no:cacheprovider"],
+        cwd=str(REPO), capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, (
+        f"fused-step collection failed:\n{out.stdout[-1500:]}"
+        f"\n{out.stderr[-1500:]}")
+    m = re.search(r"(\d+) tests? collected", out.stdout)
+    assert m, f"no collection summary:\n{out.stdout[-1500:]}"
+    selected = int(m.group(1))
+    dm = re.search(r"(\d+) deselected", out.stdout)
+    deselected = int(dm.group(1)) if dm else 0
+    assert selected >= 15 and deselected == 0, (
+        f"fused-step fast lane shrank: {selected} selected, "
+        f"{deselected} deselected — the tier-1 gate no longer covers the "
+        "fused path")
