@@ -288,6 +288,26 @@ def _time_steps(step, warmup=3, iters=30, align=1, final_sync=None):
     return time.time() - t0, final_loss, iters
 
 
+def _program_audit_fields(engine):
+    """Static-audit provenance for a ladder row: the collective-lockstep
+    signature and trip-weighted wire bytes/step of the exact programs
+    this row dispatches (docs/program_auditor.md).  A perf regression
+    that changes PROGRAM SHAPE (dense fallback reappearing, a collective
+    reordered) then shows as a signature/wire diff in the row JSON, not
+    just a slower number.  Best-effort: rows must never fail on an audit
+    bug."""
+    try:
+        from deepspeed_tpu.analysis import audit_engine
+        report = audit_engine(engine, multihost=False)
+        return {
+            "lockstep_signature": (report.signature or "")[:16],
+            "wire_bytes_per_step": report.wire_bytes_per_step,
+            "audit_findings": report.counts(),
+        }
+    except Exception as e:  # noqa: BLE001 — provenance is best-effort
+        return {"lockstep_signature": f"audit-failed: {e}"[:80]}
+
+
 def bench_gpt2(batch=8, metric="gpt2_124m_train_tokens_per_sec_1chip",
                hidden=768, layers=12, heads=12, remat=False,
                grads_half=False):
@@ -359,6 +379,7 @@ def bench_gpt2(batch=8, metric="gpt2_124m_train_tokens_per_sec_1chip",
         "mfu": round(tflops / peak, 4),
         "final_loss": round(final_loss, 4),
         "batch": batch,
+        **_program_audit_fields(engine),
         **({"probe_overrides": overrides} if overrides else {}),
     }
 
@@ -431,6 +452,7 @@ def _bench_gpt2_gas(fused, gas=4, batch=8):
         "gradient_accumulation_steps": gas,
         "dispatches_per_step": 1 if fused else 2 * gas,
         "final_loss": round(final_loss, 4),
+        **_program_audit_fields(engine),
     }
 
 
@@ -481,6 +503,7 @@ def bench_smoke():
         "unit": "tokens/s",
         "vs_baseline": 0.0,
         "final_loss": round(final_loss, 4),
+        **_program_audit_fields(engine),
     }
 
 

@@ -1,0 +1,37 @@
+"""Static Program Auditor (docs/program_auditor.md).
+
+Lints the engine's traced train-step programs — host-syncs in the hot
+loop, donation misses, collective-lockstep divergence, dtype hazards,
+comm-budget breaches — plus a runtime recompile guard.  Shared jaxpr
+traversal (``jaxpr_walk``) also backs the flops profiler and the
+low-bandwidth wire-byte accounting.
+"""
+
+from .auditor import (ProgramAuditor, audit_engine, engine_targets,
+                      enforce, synthesize_sample_batch,
+                      verify_multihost_lockstep)
+from .findings import (ALL_RULES, AuditReport, Finding, ProgramAuditError,
+                       RULE_COMM_BUDGET, RULE_DONATION, RULE_DTYPE_HAZARD,
+                       RULE_HOST_SYNC, RULE_LOCKSTEP, RULE_RECOMPILE)
+from .jaxpr_walk import (EqnCtx, SubJaxpr, as_jaxpr, aval_bytes,
+                         eqn_scope, iter_eqns, sub_jaxprs)
+from .recompile import RecompileGuard, batch_signature
+from .rules import (ArgInfo, AuditTarget, STATIC_RULES, compare_lockstep,
+                    lockstep_expectation_finding, step_wire_bytes)
+from .signature import (collective_sequence, combine_signatures,
+                        first_divergence, lockstep_signature,
+                        signature_of_sequence)
+
+__all__ = [
+    "ALL_RULES", "ArgInfo", "AuditReport", "AuditTarget", "EqnCtx",
+    "Finding", "ProgramAuditError", "ProgramAuditor", "RecompileGuard",
+    "RULE_COMM_BUDGET", "RULE_DONATION", "RULE_DTYPE_HAZARD",
+    "RULE_HOST_SYNC", "RULE_LOCKSTEP", "RULE_RECOMPILE", "STATIC_RULES",
+    "SubJaxpr", "as_jaxpr", "audit_engine", "aval_bytes",
+    "batch_signature", "collective_sequence", "combine_signatures",
+    "compare_lockstep", "engine_targets", "enforce", "eqn_scope",
+    "first_divergence", "iter_eqns", "lockstep_expectation_finding",
+    "lockstep_signature",
+    "signature_of_sequence", "step_wire_bytes", "sub_jaxprs",
+    "synthesize_sample_batch", "verify_multihost_lockstep",
+]

@@ -1,0 +1,243 @@
+"""Program Auditor — trace the engine's train step to closed jaxprs
+(without executing them) and run the static lint registry.
+
+The engine's failure modes stopped being Python bugs when the whole
+optimizer step became one XLA program (PR 3) and params started streaming
+through quantized collectives (PR 1): a stray host callback fencing the
+gas scan, a dropped donate_argnums doubling HBM, a collective sequence
+that diverges across hosts and hangs the pod, a silent fp32 upcast on a
+bf16 wire.  All of those are *program-shape* properties readable off the
+jaxpr — so they are linted here, statically, at engine init / in CI,
+instead of being discovered on a burning pod.
+
+Entry points:
+  ``audit_engine(engine)``            — full report for a built engine
+  ``ProgramAuditor(cfg).run(targets)``— rule registry over explicit
+                                        targets (tests, CLI fixtures)
+"""
+
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from .findings import AuditReport, Finding, ProgramAuditError
+from .rules import (ArgInfo, AuditTarget, STATIC_RULES,
+                    comm_budget_finding, donation_waste_bytes,
+                    lockstep_expectation_finding, step_wire_bytes)
+from .signature import combine_signatures, lockstep_signature
+
+
+def _tree_bytes(tree) -> int:
+    import jax
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        try:
+            total += int(np.prod(shape, initial=1)) * np.dtype(dtype).itemsize
+        except TypeError:
+            # extended dtypes (PRNG keys): count the key payload
+            total += int(np.prod(shape, initial=1)) * 4
+    return total
+
+
+def _grads_template(engine):
+    """ShapeDtypeStructs of the accumulated-grad tree (the apply
+    program's 4th argument) without running a grad step."""
+    import jax
+    import jax.numpy as jnp
+    grads_half = (engine.config.bf16.enabled
+                  and engine.config.bf16.grads_in_compute_dtype)
+
+    def one(p):
+        dtype = p.dtype
+        if grads_half and jnp.issubdtype(p.dtype, jnp.floating):
+            dtype = engine.compute_dtype
+        return jax.ShapeDtypeStruct(p.shape, dtype)
+
+    return jax.tree.map(one, engine.params)
+
+
+def synthesize_sample_batch(engine) -> Optional[Tuple]:
+    """A ShapeDtypeStruct batch for tracing the grad program, derived
+    from the model's declared shapes (GPT2/BERT-style configs expose
+    n_positions + vocab_size).  None when the model's input contract is
+    unknown — the auditor then audits the apply program only."""
+    import jax
+    mcfg = getattr(engine.module, "config", None)
+    seq = getattr(mcfg, "n_positions", None)
+    if seq is None:
+        seq = getattr(mcfg, "max_position_embeddings", None)
+    if seq is None or getattr(mcfg, "vocab_size", None) is None:
+        return None
+    # the dispatched batch is GLOBAL (micro x dp_world): _shard_batch
+    # places a full cross-host array, and program structure depends on it
+    # (the ZeRO-3 streamed scan only engages when the batch divides the
+    # ZeRO world — a micro-batch-sized probe would audit the fallback
+    # program instead of the one training dispatches)
+    batch = engine.train_micro_batch_size_per_gpu() * engine.world_size
+    return (jax.ShapeDtypeStruct((batch, int(seq)), np.int32),)
+
+
+def engine_targets(engine, sample_batch: Optional[Tuple] = None
+                   ) -> List[AuditTarget]:
+    """Trace the engine's step program(s) abstractly.
+
+    Modular path: the grad program (dispatched gas times per step) and
+    the apply program.  Fused path: the single whole-step program.
+    Donation facts come from the argnum tuples the engine recorded next
+    to its jit calls (`_apply_donate_argnums` / `_fused_donate_argnums`)
+    so the audit reflects what is actually dispatched.
+    """
+    import jax
+    targets: List[AuditTarget] = []
+    if sample_batch is None:
+        sample_batch = synthesize_sample_batch(engine)
+
+    fused_raw = getattr(engine, "_fused_step_raw", None)
+    if engine._fused_step_fn is not None and fused_raw is not None:
+        if sample_batch is not None:
+            gas = engine.gradient_accumulation_steps()
+            stacked = tuple(
+                jax.ShapeDtypeStruct((gas,) + tuple(s.shape), s.dtype)
+                for s in sample_batch)
+            closed = jax.make_jaxpr(fused_raw)(
+                engine.params, engine.opt_state, engine.scaler_state,
+                engine._fused_sent_state, engine._rng, stacked, {})
+            donated = getattr(engine, "_fused_donate_argnums", (0, 1))
+            args = [
+                ArgInfo("params", _tree_bytes(engine.params),
+                        0 in donated, True),
+                ArgInfo("opt_state", _tree_bytes(engine.opt_state),
+                        1 in donated, True),
+                ArgInfo("scaler_state", _tree_bytes(engine.scaler_state),
+                        2 in donated, True),
+                ArgInfo("sentinel_state",
+                        _tree_bytes(engine._fused_sent_state),
+                        3 in donated, True),
+                ArgInfo("batch", _tree_bytes(stacked), False, False),
+            ]
+            targets.append(AuditTarget("fused_step", closed, args))
+        return targets
+
+    if sample_batch is not None:
+        closed = jax.make_jaxpr(
+            lambda p, s, r, *b: engine._loss_and_grads(p, s, r, *b))(
+            engine.params, engine.scaler_state, engine._rng,
+            *sample_batch)
+        args = [
+            ArgInfo("params", _tree_bytes(engine.params), False, False),
+            ArgInfo("scaler_state", _tree_bytes(engine.scaler_state),
+                    False, False),
+            ArgInfo("batch", _tree_bytes(sample_batch), False, False),
+        ]
+        targets.append(AuditTarget("grad_step", closed, args))
+
+    if engine._apply_core is not None:
+        grads = _grads_template(engine)
+        closed = jax.make_jaxpr(
+            lambda p, o, s, g: engine._apply_core(p, o, s, g))(
+            engine.params, engine.opt_state, engine.scaler_state, grads)
+        donated = getattr(engine, "_apply_donate_argnums", (0, 1, 3))
+        args = [
+            ArgInfo("params", _tree_bytes(engine.params),
+                    0 in donated, True),
+            ArgInfo("opt_state", _tree_bytes(engine.opt_state),
+                    1 in donated, True),
+            ArgInfo("scaler_state", _tree_bytes(engine.scaler_state),
+                    2 in donated, True),
+            ArgInfo("grads", _tree_bytes(grads), 3 in donated, True),
+        ]
+        targets.append(AuditTarget("apply_step", closed, args))
+    return targets
+
+
+class ProgramAuditor:
+    """Run the static rule registry over audit targets."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+
+    def run(self, targets: List[AuditTarget],
+            gas: int = 1) -> AuditReport:
+        report = AuditReport(targets=[t.label for t in targets])
+        for target in targets:
+            for _rule_id, rule in STATIC_RULES:
+                report.findings.extend(rule(target, self.cfg))
+        sigs = []
+        contributors = []
+        for target in targets:
+            sig, seq = lockstep_signature(target.closed_jaxpr)
+            sigs.append(sig)
+            # the grad program is dispatched gas times per optimizer
+            # step — its collectives (and wire bytes) repeat in lockstep
+            repeat = gas if target.label == "grad_step" else 1
+            report.collective_sequence.extend(seq * repeat)
+            total, contrib = step_wire_bytes(target.closed_jaxpr)
+            report.wire_bytes_per_step += total * repeat
+            contributors.extend((f"{target.label}:{k}", v * repeat)
+                                for k, v in contrib)
+        report.signature = (combine_signatures(sigs) if sigs else None)
+        report.findings.extend(lockstep_expectation_finding(
+            report.signature, len(report.collective_sequence), self.cfg))
+        contributors.sort(key=lambda kv: -kv[1])
+        # budget is checked against the same gas-weighted per-step total
+        # the report (and bench rows) publish
+        report.findings.extend(comm_budget_finding(
+            report.wire_bytes_per_step, contributors, self.cfg))
+        report.donation_waste_bytes = donation_waste_bytes(targets,
+                                                           self.cfg)
+        return report
+
+
+def verify_multihost_lockstep(report: AuditReport) -> List[Finding]:
+    """On a multihost pod, allgather the signature digests and flag any
+    divergence BEFORE the first collective dispatch can hang it.
+    Single-process: no-op."""
+    import jax
+    if jax.process_count() <= 1 or report.signature is None:
+        return []
+    import hashlib
+    from jax.experimental import multihost_utils
+    digest = np.frombuffer(
+        hashlib.sha256(report.signature.encode()).digest()[:8],
+        dtype=np.int64)
+    all_digests = np.asarray(multihost_utils.process_allgather(digest))
+    if (all_digests == digest.reshape(1, -1)).all():
+        return []
+    return [Finding(
+        rule="lockstep", severity="error",
+        message=(f"collective lockstep signature "
+                 f"{report.signature[:12]} differs across hosts — the "
+                 "pod WOULD deadlock at the first diverged collective"),
+        target="multihost",
+        fix_hint="diff each host's config (CLI --dump-sequence) — "
+                 "every process must trace the identical step program")]
+
+
+def audit_engine(engine, sample_batch: Optional[Tuple] = None,
+                 cfg=None, multihost: bool = True) -> AuditReport:
+    """Full static audit of a built engine.  Never executes the step."""
+    cfg = cfg if cfg is not None else engine.config.analysis_config
+    targets = engine_targets(engine, sample_batch)
+    report = ProgramAuditor(cfg).run(
+        targets, gas=engine.gradient_accumulation_steps())
+    if multihost:
+        report.findings.extend(verify_multihost_lockstep(report))
+    return report
+
+
+def enforce(report: AuditReport, mode: str, logger: Any = None) -> None:
+    """Apply the configured reaction: warn logs every finding, error
+    raises ProgramAuditError when error-severity findings exist."""
+    if mode == "off" or not report.findings:
+        return
+    if logger is not None:
+        for f in report.findings:
+            log = (logger.error if f.severity == "error"
+                   else logger.warning)
+            log(f.format())
+    if mode == "error" and report.has_errors:
+        raise ProgramAuditError(report)

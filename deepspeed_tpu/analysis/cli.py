@@ -1,0 +1,94 @@
+"""CLI: lint a config + model pair on CPU without touching an accelerator.
+
+    python -m deepspeed_tpu.analysis --config ds_config.json \
+        [--model gpt2] [--hidden 64 --layers 2 --heads 4 --seq 64 \
+         --vocab 256] [--json] [--dump-sequence]
+
+Builds the model and engine on the CPU backend, traces the step
+program(s) abstractly, runs every static lint rule plus the lockstep
+signature, prints the findings, and exits nonzero when the config's
+``analysis.mode`` is ``"error"`` and error-severity findings exist — the
+CI contract.  The model defaults to a tiny GPT-2 shape: the lint is
+about PROGRAM STRUCTURE (which the config decides), not model scale.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.analysis",
+        description="Static Program Auditor: lint a DeepSpeed-TPU config "
+                    "+ model pair (host-syncs, donation misses, "
+                    "collective lockstep, dtype hazards, comm budget).")
+    p.add_argument("--config", required=True,
+                   help="DeepSpeed JSON config path")
+    p.add_argument("--model", default="gpt2", choices=("gpt2",),
+                   help="model family to trace (default gpt2)")
+    p.add_argument("--hidden", type=int, default=64)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--seq", type=int, default=64)
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--json", action="store_true",
+                   help="emit the full report as JSON on stdout")
+    p.add_argument("--dump-sequence", action="store_true",
+                   help="print the ordered collective sequence (what "
+                        "the lockstep signature hashes)")
+    return p
+
+
+def main(argv=None) -> int:
+    # lint runs on CPU regardless of what accelerators are attached —
+    # must be decided before jax initializes a backend
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    args = build_parser().parse_args(argv)
+
+    import jax
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.config import AnalysisConfig
+    from deepspeed_tpu.models import GPT2Config, GPT2Model
+    from deepspeed_tpu import constants as C
+    from .auditor import audit_engine
+
+    with open(args.config) as f:
+        raw = json.load(f)
+    analysis_cfg = AnalysisConfig.from_dict(raw.get(C.ANALYSIS))
+
+    # The engine is built with analysis off so a mode:"error" config
+    # still produces a full printed report here (instead of the
+    # constructor raising mid-build); the CLI then applies the mode.
+    engine_raw = dict(raw)
+    engine_raw[C.ANALYSIS] = dict(raw.get(C.ANALYSIS) or {},
+                                  **{C.ANALYSIS_MODE: "off"})
+
+    cfg = GPT2Config(
+        hidden_size=args.hidden, num_layers=args.layers,
+        num_heads=args.heads, n_positions=args.seq, vocab_size=args.vocab,
+        bf16=bool(engine_raw.get("bf16", {}).get("enabled", False)))
+    model = GPT2Model(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    engine, _, _, _ = ds.initialize(model=model, config=engine_raw,
+                                    model_parameters=params)
+
+    report = audit_engine(engine, cfg=analysis_cfg)
+
+    if args.json:
+        print(report.to_json())
+    else:
+        print(report.summary_line())
+        for finding in report.findings:
+            print("  " + finding.format())
+        if args.dump_sequence:
+            for item in report.collective_sequence:
+                print("  seq: " + item)
+        print(f"lockstep signature: {report.signature}")
+    mode = analysis_cfg.mode
+    if mode == "error" and report.has_errors:
+        print("program audit: FAILED (error-severity findings, "
+              "analysis.mode=error)", file=sys.stderr)
+        return 1
+    return 0

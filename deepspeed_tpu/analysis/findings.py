@@ -1,0 +1,117 @@
+"""Structured lint findings + the audit report container.
+
+Every rule emits ``Finding`` records instead of log lines so that CI, the
+engine init summary, bench rows, and the CLI all consume the same data —
+the reference DeepSpeed has no analog (its failure modes surface as hung
+pods and OOMs at runtime; see ISSUE 5 / docs/program_auditor.md).
+"""
+
+import json
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional
+
+SEVERITIES = ("error", "warning", "info")
+
+# rule ids (stable: tests, golden files, and docs key off these)
+RULE_HOST_SYNC = "host_sync"
+RULE_DONATION = "donation"
+RULE_LOCKSTEP = "lockstep"
+RULE_DTYPE_HAZARD = "dtype_hazard"
+RULE_COMM_BUDGET = "comm_budget"
+RULE_RECOMPILE = "recompile"
+
+ALL_RULES = (RULE_HOST_SYNC, RULE_DONATION, RULE_LOCKSTEP,
+             RULE_DTYPE_HAZARD, RULE_COMM_BUDGET, RULE_RECOMPILE)
+
+
+@dataclass
+class Finding:
+    """One lint hit: what rule fired, how bad, where in the program, and
+    what to do about it."""
+    rule: str                 # one of ALL_RULES
+    severity: str             # "error" | "warning" | "info"
+    message: str              # human-readable defect statement
+    target: str = ""          # which traced program ("grad_step", ...)
+    scope: str = ""           # eqn name-stack provenance inside the target
+    fix_hint: str = ""        # one actionable sentence
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"severity {self.severity!r} not in "
+                             f"{SEVERITIES}")
+        if self.rule not in ALL_RULES:
+            raise ValueError(f"unknown rule id {self.rule!r}")
+
+    def format(self) -> str:
+        where = self.target + (f" @ {self.scope}" if self.scope else "")
+        hint = f"  hint: {self.fix_hint}" if self.fix_hint else ""
+        return (f"[{self.severity.upper():7s}] {self.rule}: {self.message}"
+                f" ({where}){hint}")
+
+
+@dataclass
+class AuditReport:
+    """Everything one audit pass learned about the program(s)."""
+    findings: List[Finding] = field(default_factory=list)
+    # collective-lockstep signature of the step program (hex digest) and
+    # the human-readable sequence it hashes
+    signature: Optional[str] = None
+    collective_sequence: List[str] = field(default_factory=list)
+    # trip-count-weighted wire bytes per optimizer step
+    wire_bytes_per_step: int = 0
+    # HBM the donation rule estimates is being wasted (0 when clean)
+    donation_waste_bytes: int = 0
+    targets: List[str] = field(default_factory=list)
+
+    def counts(self) -> Dict[str, int]:
+        out = {s: 0 for s in SEVERITIES}
+        for f in self.findings:
+            out[f.severity] += 1
+        return out
+
+    @property
+    def has_errors(self) -> bool:
+        return any(f.severity == "error" for f in self.findings)
+
+    def summary_line(self) -> str:
+        c = self.counts()
+        sig = (self.signature or "")[:12] or "n/a"
+        return (f"program audit: {c['error']} error(s), "
+                f"{c['warning']} warning(s), {c['info']} info over "
+                f"{len(self.targets)} program(s); "
+                f"wire={self.wire_bytes_per_step} B/step, "
+                f"donation_waste={self.donation_waste_bytes} B, "
+                f"lockstep={sig}")
+
+    def counters(self) -> Dict[str, Any]:
+        """Checkpoint-client-state payload (mirrors the sentinel-counter
+        round-trip: plain JSON-serializable scalars only)."""
+        return {
+            "findings_by_severity": self.counts(),
+            "wire_bytes_per_step": int(self.wire_bytes_per_step),
+            "donation_waste_bytes": int(self.donation_waste_bytes),
+            "lockstep_signature": self.signature,
+        }
+
+    def to_json(self, indent: Optional[int] = 2) -> str:
+        return json.dumps({
+            "findings": [asdict(f) for f in self.findings],
+            "signature": self.signature,
+            "collective_sequence": self.collective_sequence,
+            "wire_bytes_per_step": self.wire_bytes_per_step,
+            "donation_waste_bytes": self.donation_waste_bytes,
+            "targets": self.targets,
+        }, indent=indent)
+
+
+class ProgramAuditError(RuntimeError):
+    """Raised in ``analysis.mode == "error"`` when error-severity findings
+    exist; carries the report for structured handling."""
+
+    def __init__(self, report: AuditReport):
+        self.report = report
+        errors = [f.format() for f in report.findings
+                  if f.severity == "error"]
+        super().__init__(
+            "program audit failed with error-severity findings "
+            "(analysis.mode = \"error\"):\n" + "\n".join(errors))

@@ -1,0 +1,146 @@
+"""Shared jaxpr traversal — ONE sub-jaxpr dispatch for every walker.
+
+Before this module, `profiling/flops_profiler.count_jaxpr_flops` and
+`runtime/comm/low_bandwidth.collective_wire_bytes` each carried their own
+copy of the pjit/scan/cond/while/remat/custom_vjp recursion — and each
+copy had different gaps (the flops walk missed `remat2`, the primitive
+`jax.checkpoint` actually emits, and `shard_map`, so the sparse-gradients
+region counted zero flops; the wire walk never saw `while` cond jaxprs).
+The Program Auditor (analysis/auditor.py) adds six more jaxpr consumers,
+so the dispatch lives here once:
+
+  ``sub_jaxprs(eqn)``  — every sub-jaxpr an equation closes over, tagged
+                         with its role (scan body, while cond/body, cond
+                         branch, generic call) and trip count when known.
+  ``iter_eqns(jaxpr)`` — flat iterator over every equation at every
+                         nesting depth with an ``EqnCtx`` carrying scope
+                         (name-stack provenance), loop depth, and the
+                         static trip-count multiplier.
+
+Dispatch strategy: scan/while/cond are matched by name because their
+params need interpretation (trip counts, branch sets); everything else —
+pjit, closed_call, remat/remat2/checkpoint, custom_{vjp,jvp}_call*,
+shard_map, and any future higher-order primitive — is caught generically
+by scanning ``eqn.params`` for values that ARE jaxprs.  New primitives
+are walked by default instead of silently skipped.
+"""
+
+from typing import Any, Iterator, NamedTuple, Optional, Tuple
+
+# Primitives that repeat their sub-jaxpr a statically-known number of
+# times.  (while is NOT here: its trip count is data-dependent, so
+# callers see trip_count=None and decide their own convention.)
+_LOOP_PRIMS = ("scan",)
+
+
+def as_jaxpr(jaxpr):
+    """Unwrap a ClosedJaxpr (or pass a bare Jaxpr through)."""
+    return getattr(jaxpr, "jaxpr", jaxpr)
+
+
+class SubJaxpr(NamedTuple):
+    """One sub-jaxpr of an equation.
+
+    kind        'scan' | 'while_cond' | 'while_body' | 'branch' | 'call'
+    jaxpr       the UNWRAPPED inner Jaxpr
+    trip_count  static repeat count (scan length) or None
+    """
+    kind: str
+    jaxpr: Any
+    trip_count: Optional[int]
+
+
+def sub_jaxprs(eqn) -> Tuple[SubJaxpr, ...]:
+    """Every sub-jaxpr `eqn` closes over, in deterministic param order."""
+    name = eqn.primitive.name
+    if name == "scan":
+        return (SubJaxpr("scan", as_jaxpr(eqn.params["jaxpr"]),
+                         int(eqn.params["length"])),)
+    if name == "while":
+        subs = []
+        cond = eqn.params.get("cond_jaxpr")
+        if cond is not None:
+            subs.append(SubJaxpr("while_cond", as_jaxpr(cond), None))
+        body = eqn.params.get("body_jaxpr")
+        if body is not None:
+            subs.append(SubJaxpr("while_body", as_jaxpr(body), None))
+        return tuple(subs)
+    if name == "cond":
+        return tuple(SubJaxpr("branch", as_jaxpr(b), None)
+                     for b in eqn.params.get("branches", ()))
+    # Generic: any param value that is (or contains) a jaxpr.  Catches
+    # pjit/closed_call/core_call, remat/remat2/checkpoint,
+    # custom_vjp_call(+_jaxpr)/custom_jvp_call (their call_jaxpr/
+    # fun_jaxpr params), shard_map, and future higher-order primitives.
+    import jax
+    subs = []
+    for key in sorted(eqn.params):
+        for leaf in jax.tree.leaves(
+                eqn.params[key],
+                is_leaf=lambda s: hasattr(s, "jaxpr") or hasattr(s, "eqns")):
+            inner = as_jaxpr(leaf)
+            if hasattr(inner, "eqns"):
+                subs.append(SubJaxpr("call", inner, None))
+    return tuple(subs)
+
+
+def eqn_scope(eqn, prefix: str = "") -> str:
+    """name-scope path of an equation: the enclosing prefix (outer
+    scan/pjit scopes) joined with the eqn's own traced name stack."""
+    stack = str(eqn.source_info.name_stack)
+    if prefix and stack:
+        return f"{prefix}/{stack}"
+    return prefix or stack
+
+
+class EqnCtx(NamedTuple):
+    """One equation with its structural context inside the whole program.
+
+    eqn         the jax core JaxprEqn
+    scope       name-stack provenance path ("" at an unnamed top level)
+    mult        product of enclosing static trip counts (scan lengths) —
+                how many times this eqn runs per program execution
+                (while bodies do not multiply: their count is dynamic)
+    loop_depth  number of enclosing scan/while bodies (0 = top level);
+                anything with loop_depth > 0 is in a hot-loop body
+    branch      True when under a cond branch (may not execute at all)
+    """
+    eqn: Any
+    scope: str
+    mult: int
+    loop_depth: int
+    branch: bool
+
+
+def iter_eqns(jaxpr, _scope: str = "", _mult: int = 1,
+              _loop_depth: int = 0, _branch: bool = False
+              ) -> Iterator[EqnCtx]:
+    """Depth-first iterator over EVERY equation at every nesting level.
+
+    Visits all cond branches and both while jaxprs (lints must see code
+    that MIGHT run); consumers that want max-branch or body-only
+    semantics (the flops counter) recurse themselves via sub_jaxprs.
+    """
+    for eqn in as_jaxpr(jaxpr).eqns:
+        yield EqnCtx(eqn, eqn_scope(eqn, _scope), _mult, _loop_depth,
+                     _branch)
+        for sub in sub_jaxprs(eqn):
+            scope = eqn_scope(eqn, _scope)
+            in_loop = _loop_depth + (
+                1 if sub.kind in ("scan", "while_body", "while_cond") else 0)
+            mult = _mult * (sub.trip_count or 1)
+            yield from iter_eqns(sub.jaxpr, scope, mult, in_loop,
+                                 _branch or sub.kind == "branch")
+
+
+def aval_bytes(v) -> int:
+    """HBM bytes of a jaxpr var/atom's aval (0 for abstract tokens)."""
+    import numpy as np
+    aval = getattr(v, "aval", None)
+    if aval is None or not hasattr(aval, "shape"):
+        return 0
+    try:
+        itemsize = np.dtype(aval.dtype).itemsize
+    except TypeError:
+        return 0
+    return int(np.prod(aval.shape, initial=1)) * itemsize

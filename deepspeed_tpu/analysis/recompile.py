@@ -1,0 +1,100 @@
+"""Recompile guard — rule 6, the one runtime pass of the Program Auditor.
+
+A jitted step function retraces whenever an argument's aval (shape/
+dtype/weak-type) or a static argument changes.  Occasional retraces are
+normal (first call, a final short batch); a retrace STORM — shape-
+polymorphic inputs, a Python scalar flapping between int and float, a
+fresh tuple of static args per step — silently turns every step into a
+multi-second XLA compile.  The engine observes the batch signature of
+every dispatch; once the number of DISTINCT signatures exceeds
+``analysis.max_retraces`` the guard reports which avals changed instead
+of letting the job quietly crawl.
+"""
+
+from typing import Any, Optional, Tuple
+
+from .findings import Finding, RULE_RECOMPILE
+
+
+def batch_signature(tree: Any) -> Tuple:
+    """Hashable aval signature of a batch pytree: per-leaf (shape, dtype)
+    plus the treedef (a changed pytree STRUCTURE also retraces)."""
+    import jax
+    leaves, treedef = jax.tree.flatten(tree)
+    return (str(treedef),) + tuple(
+        (tuple(getattr(x, "shape", ())), str(getattr(x, "dtype", type(x))))
+        for x in leaves)
+
+
+def _diff(a: Tuple, b: Tuple) -> str:
+    if a[0] != b[0]:
+        return "pytree structure changed"
+    for i, (la, lb) in enumerate(zip(a[1:], b[1:])):
+        if la != lb:
+            return (f"leaf {i}: shape/dtype {la[0]}:{la[1]} -> "
+                    f"{lb[0]}:{lb[1]}")
+    return "argument count changed"
+
+
+class RecompileGuard:
+    """Counts distinct step-function trace signatures at runtime.
+
+    Membership is a set (O(1) per dispatch — the guard must stay cheap
+    in exactly the every-step-a-new-shape storm it exists to catch) and
+    the stored set is bounded: past the bound every unseen signature
+    counts as a retrace without being stored.  A repeated old shape may
+    then be over-counted, but a run that far past its budget is already
+    storming and the tally only needs to stay monotonic."""
+
+    def __init__(self, max_retraces: int):
+        self.max_retraces = int(max_retraces)
+        self._sigs: set = set()
+        self._last_sig: Optional[Tuple] = None
+        self._restored = 0  # retraces carried in from a checkpoint
+        self.retraces_seen = 0  # distinct signatures beyond the first
+        self._store_cap = 4 * self.max_retraces + 64
+
+    def observe(self, tree: Any) -> Optional[Finding]:
+        """Record one dispatch; returns a Finding when this dispatch
+        crossed (or is beyond) the retrace budget, else None."""
+        sig = batch_signature(tree)
+        if sig in self._sigs:
+            return None
+        prev = self._last_sig
+        if len(self._sigs) < self._store_cap:
+            self._sigs.add(sig)
+            distinct = len(self._sigs)
+        else:
+            distinct = self.retraces_seen - self._restored + 2
+        self._last_sig = sig
+        self.retraces_seen = self._restored + distinct - 1
+        if self.retraces_seen <= self.max_retraces:
+            return None
+        changed = (_diff(prev, sig) if prev is not None
+                   else "first traced shape after a checkpoint restore")
+        return Finding(
+            rule=RULE_RECOMPILE, severity="error",
+            message=(f"step function retraced {self.retraces_seen} times "
+                     f"(budget {self.max_retraces}) — latest change: "
+                     f"{changed}"),
+            target="train_step",
+            fix_hint=("pad batches to a fixed shape (or a small bucket "
+                      "set) and keep dtypes stable; raise "
+                      "analysis.max_retraces only if the shape set is "
+                      "genuinely that large"))
+
+    # ---- checkpoint round-trip (mirrors the sentinel counters) ------- #
+    def counters(self) -> dict:
+        return {"retraces_seen": self.retraces_seen,
+                "max_retraces": self.max_retraces}
+
+    def load_counters(self, d: Optional[dict]) -> None:
+        """Restore the persisted retrace count.  Signatures themselves
+        are not persisted (a resume retraces once by construction, which
+        is why the count — not the set — is what rides the checkpoint:
+        the budget keeps meaning 'distinct shapes this training run')."""
+        if not d:
+            return
+        self._restored = max(self._restored,
+                             int(d.get("retraces_seen", 0)))
+        self.retraces_seen = max(self.retraces_seen, self._restored)

@@ -445,6 +445,62 @@ class FusedStepConfig:
 
 
 @dataclass
+class AnalysisConfig:
+    """Program Auditor block (docs/program_auditor.md): static jaxpr lint
+    of the traced step programs at engine init, plus the runtime
+    recompile guard.  ``mode`` "off" (default) skips everything; "warn"
+    logs findings; "error" raises ProgramAuditError on error-severity
+    findings (CI posture)."""
+    mode: str = C.ANALYSIS_MODE_DEFAULT
+    comm_budget_mb: Optional[float] = C.ANALYSIS_COMM_BUDGET_MB_DEFAULT
+    max_retraces: int = C.ANALYSIS_MAX_RETRACES_DEFAULT
+    donation_min_mb: float = C.ANALYSIS_DONATION_MIN_MB_DEFAULT
+    dtype_min_elements: int = C.ANALYSIS_DTYPE_MIN_ELEMENTS_DEFAULT
+    expected_signature: Optional[str] = (
+        C.ANALYSIS_EXPECTED_SIGNATURE_DEFAULT)
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode != "off"
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "AnalysisConfig":
+        d = d or {}
+        budget = get_scalar_param(d, C.ANALYSIS_COMM_BUDGET_MB,
+                                  C.ANALYSIS_COMM_BUDGET_MB_DEFAULT)
+        cfg = AnalysisConfig(
+            mode=get_scalar_param(d, C.ANALYSIS_MODE,
+                                  C.ANALYSIS_MODE_DEFAULT),
+            comm_budget_mb=None if budget is None else float(budget),
+            max_retraces=int(get_scalar_param(
+                d, C.ANALYSIS_MAX_RETRACES,
+                C.ANALYSIS_MAX_RETRACES_DEFAULT)),
+            donation_min_mb=float(get_scalar_param(
+                d, C.ANALYSIS_DONATION_MIN_MB,
+                C.ANALYSIS_DONATION_MIN_MB_DEFAULT)),
+            dtype_min_elements=int(get_scalar_param(
+                d, C.ANALYSIS_DTYPE_MIN_ELEMENTS,
+                C.ANALYSIS_DTYPE_MIN_ELEMENTS_DEFAULT)),
+            expected_signature=get_scalar_param(
+                d, C.ANALYSIS_EXPECTED_SIGNATURE,
+                C.ANALYSIS_EXPECTED_SIGNATURE_DEFAULT),
+        )
+        if cfg.mode not in C.ANALYSIS_MODES:
+            raise DeepSpeedConfigError(
+                f"analysis.mode={cfg.mode!r} — supported modes are "
+                f"{list(C.ANALYSIS_MODES)}")
+        if cfg.comm_budget_mb is not None and cfg.comm_budget_mb < 0:
+            raise DeepSpeedConfigError(
+                "analysis.comm_budget_mb must be >= 0, got "
+                f"{cfg.comm_budget_mb}")
+        if cfg.max_retraces < 1:
+            raise DeepSpeedConfigError(
+                f"analysis.max_retraces must be >= 1, got "
+                f"{cfg.max_retraces}")
+        return cfg
+
+
+@dataclass
 class EigenvalueConfig:
     enabled: bool = C.EIGENVALUE_ENABLED_DEFAULT
     verbose: bool = C.EIGENVALUE_VERBOSE_DEFAULT
@@ -866,6 +922,7 @@ class DeepSpeedConfig:
             pd.get(C.TENSORBOARD))
         self.fused_step_config = FusedStepConfig.from_dict(
             pd.get(C.FUSED_STEP))
+        self.analysis_config = AnalysisConfig.from_dict(pd.get(C.ANALYSIS))
         self.eigenvalue_config = EigenvalueConfig.from_dict(pd.get(C.EIGENVALUE))
         self.pld_config = PLDConfig.from_dict(pd.get(C.PROGRESSIVE_LAYER_DROP))
         self.curriculum_config = CurriculumConfig.from_dict(

@@ -147,6 +147,40 @@ FUSED_STEP_ENABLED = "enabled"
 FUSED_STEP_ENABLED_DEFAULT = False
 
 #############################################
+# Program Auditor (TPU-native addition; docs/program_auditor.md)
+#
+# Static jaxpr lint of the traced train-step programs at engine init /
+# in CI: host callbacks in the hot loop, donation misses, collective-
+# lockstep signature drift, fp32 upcasts on half wires, comm-budget
+# breaches, plus a runtime recompile guard.  mode "off" (default) skips
+# everything; "warn" logs findings; "error" raises ProgramAuditError on
+# error-severity findings.
+#############################################
+ANALYSIS = "analysis"
+ANALYSIS_MODE = "mode"
+ANALYSIS_MODE_DEFAULT = "off"
+ANALYSIS_MODES = ("off", "warn", "error")
+# per-step wire-byte budget in MiB (trip-count weighted); None = no lint
+ANALYSIS_COMM_BUDGET_MB = "comm_budget_mb"
+ANALYSIS_COMM_BUDGET_MB_DEFAULT = None
+# distinct step-function trace signatures tolerated before the
+# recompile guard fires
+ANALYSIS_MAX_RETRACES = "max_retraces"
+ANALYSIS_MAX_RETRACES_DEFAULT = 16
+# donation-audit floor: consumed-but-undonated args smaller than this
+# are noise, not HBM leaks
+ANALYSIS_DONATION_MIN_MB = "donation_min_mb"
+ANALYSIS_DONATION_MIN_MB_DEFAULT = 1.0
+# dtype-hazard floor: upcasts on arrays smaller than this are scalars /
+# epilogue math, not wires
+ANALYSIS_DTYPE_MIN_ELEMENTS = "dtype_min_elements"
+ANALYSIS_DTYPE_MIN_ELEMENTS_DEFAULT = 65536
+# pin the collective-lockstep signature (hex prefix ok); mismatch is an
+# error-severity finding
+ANALYSIS_EXPECTED_SIGNATURE = "expected_signature"
+ANALYSIS_EXPECTED_SIGNATURE_DEFAULT = None
+
+#############################################
 # Tensorboard
 #############################################
 TENSORBOARD = "tensorboard"

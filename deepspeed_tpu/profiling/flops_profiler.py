@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import core as jax_core
 
+from ..analysis.jaxpr_walk import as_jaxpr, eqn_scope, sub_jaxprs
 from ..utils.logging import log_dist
 
 
@@ -48,21 +49,15 @@ def _conv_flops(eqn) -> int:
     return out_elems * per_out
 
 
-def _eqn_scope(eqn, prefix: str) -> str:
-    """name-scope path of an equation: the enclosing prefix (outer
-    scan/pjit scopes) joined with the eqn's own traced name stack."""
-    stack = str(eqn.source_info.name_stack)
-    if prefix and stack:
-        return f"{prefix}/{stack}"
-    return prefix or stack
-
-
 def count_jaxpr_flops(jaxpr, breakdown: Optional[Dict[str, int]] = None,
                       scopes: Optional[Dict[str, int]] = None,
                       _prefix: str = "", _mult: int = 1) -> int:
     """Walk a (closed) jaxpr counting matmul/conv MAC-flops plus elementwise
-    ops; recurses through pjit/scan/cond/while/remat sub-jaxprs (scan
-    multiplies by trip count).
+    ops; sub-jaxpr recursion (pjit/scan/cond/while/remat/custom_vjp/
+    shard_map/...) rides the shared dispatcher in analysis/jaxpr_walk.py
+    — scan multiplies by trip count, cond counts its most expensive
+    branch, while counts cond+body once (dynamic trip counts are
+    unknowable statically).
 
     `scopes` (optional) accumulates flops per `jax.named_scope` path —
     the per-module attribution the reference profiler gets from
@@ -71,15 +66,14 @@ def count_jaxpr_flops(jaxpr, breakdown: Optional[Dict[str, int]] = None,
     renders the hierarchy.  Sub-jaxpr equations carry name stacks
     relative to their enclosing scan/pjit, so recursion threads the
     parent scope as a prefix and scan trip counts as a multiplier."""
-    if hasattr(jaxpr, "jaxpr"):
-        jaxpr = jaxpr.jaxpr
+    jaxpr = as_jaxpr(jaxpr)
     total = 0
     breakdown = breakdown if breakdown is not None else {}
 
     def credit(key: str, eqn, f: int) -> None:
         breakdown[key] = breakdown.get(key, 0) + f * _mult
         if scopes is not None:
-            sc = _eqn_scope(eqn, _prefix)
+            sc = eqn_scope(eqn, _prefix)
             scopes[sc] = scopes.get(sc, 0) + f * _mult
 
     for eqn in jaxpr.eqns:
@@ -92,36 +86,20 @@ def count_jaxpr_flops(jaxpr, breakdown: Optional[Dict[str, int]] = None,
             f = _conv_flops(eqn)
             total += f
             credit("conv", eqn, f)
-        elif name == "scan":
-            length = eqn.params["length"]
-            inner = count_jaxpr_flops(
-                eqn.params["jaxpr"], breakdown, scopes,
-                _prefix=_eqn_scope(eqn, _prefix), _mult=_mult * length)
-            total += inner * length
-        elif name in ("pjit", "closed_call", "core_call", "remat",
-                      "checkpoint", "custom_vjp_call", "custom_jvp_call",
-                      "custom_vjp_call_jaxpr"):
-            sub = (eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
-                   or eqn.params.get("fun_jaxpr"))
-            if sub is not None:
-                total += count_jaxpr_flops(
-                    sub, breakdown, scopes,
-                    _prefix=_eqn_scope(eqn, _prefix), _mult=_mult)
-        elif name in ("cond",):
-            branches = eqn.params.get("branches", ())
-            if branches:
-                # count the most expensive branch (what actually runs):
-                # ONE walk per branch into fresh dicts, merge the winner
-                # (a probe-then-credit double walk would compound 2^d on
-                # d-nested conds — the gated 1F1B executor's shape)
-                probes = []
-                for b in branches:
-                    bd: Dict[str, int] = {}
-                    sc: Optional[Dict[str, int]] = (
-                        {} if scopes is not None else None)
-                    probes.append((count_jaxpr_flops(
-                        b, bd, sc, _prefix=_eqn_scope(eqn, _prefix),
-                        _mult=_mult), bd, sc))
+        elif name == "cond":
+            # count the most expensive branch (what actually runs):
+            # ONE walk per branch into fresh dicts, merge the winner
+            # (a probe-then-credit double walk would compound 2^d on
+            # d-nested conds — the gated 1F1B executor's shape)
+            probes = []
+            for sub in sub_jaxprs(eqn):
+                bd: Dict[str, int] = {}
+                sc: Optional[Dict[str, int]] = (
+                    {} if scopes is not None else None)
+                probes.append((count_jaxpr_flops(
+                    sub.jaxpr, bd, sc, _prefix=eqn_scope(eqn, _prefix),
+                    _mult=_mult), bd, sc))
+            if probes:
                 cost, bd, sc = max(probes, key=lambda p: p[0])
                 total += cost
                 for k, v in bd.items():
@@ -129,20 +107,34 @@ def count_jaxpr_flops(jaxpr, breakdown: Optional[Dict[str, int]] = None,
                 if scopes is not None and sc is not None:
                     for k, v in sc.items():
                         scopes[k] = scopes.get(k, 0) + v
-        elif name == "while":
-            body = eqn.params.get("body_jaxpr")
-            if body is not None:
-                total += count_jaxpr_flops(
-                    body, breakdown, scopes,
-                    _prefix=_eqn_scope(eqn, _prefix), _mult=_mult)
         else:
-            # elementwise / reduction: one flop per output element
-            for ov in eqn.outvars:
-                aval = getattr(ov, "aval", None)
-                if aval is not None and hasattr(aval, "shape"):
-                    f = int(np.prod(aval.shape, initial=1))
-                    total += f
-                    credit("elementwise", eqn, f)
+            subs = sub_jaxprs(eqn)
+            if subs:
+                for sub in subs:
+                    if sub.trip_count is not None:  # scan body
+                        inner = count_jaxpr_flops(
+                            sub.jaxpr, breakdown, scopes,
+                            _prefix=eqn_scope(eqn, _prefix),
+                            _mult=_mult * sub.trip_count)
+                        total += inner * sub.trip_count
+                    else:
+                        # generic call (pjit/remat2/custom_vjp/shard_map/
+                        # ...) and while cond+body: counted once —
+                        # unifying onto the shared dispatcher fixed the
+                        # silent zeros for remat2 (what jax.checkpoint
+                        # actually emits), shard_map (the sparse-
+                        # gradients region), and while cond jaxprs
+                        total += count_jaxpr_flops(
+                            sub.jaxpr, breakdown, scopes,
+                            _prefix=eqn_scope(eqn, _prefix), _mult=_mult)
+            else:
+                # elementwise / reduction: one flop per output element
+                for ov in eqn.outvars:
+                    aval = getattr(ov, "aval", None)
+                    if aval is not None and hasattr(aval, "shape"):
+                        f = int(np.prod(aval.shape, initial=1))
+                        total += f
+                        credit("elementwise", eqn, f)
     return total
 
 

@@ -365,36 +365,23 @@ _REDUCE_PRIMS = ("psum_scatter", "reduce_scatter", "all_to_all", "psum")
 
 
 def collective_wire_bytes(jaxpr) -> dict:
-    """Walk a (closed) jaxpr — recursing into scan/remat/shard_map
-    sub-jaxprs — and sum an approximate wire volume per collective
-    family: output bytes for gathers (the payload that landed), operand
-    bytes for reductions/all-to-alls (the payload that left).  Loop trip
-    counts are NOT multiplied in, so use this for same-structure A/B
-    ratios (quantized vs fp32 path), not absolute traffic."""
-    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    """Walk a (closed) jaxpr — recursing into every sub-jaxpr
+    (scan/while/cond/remat/shard_map/custom_vjp bwd...) via the shared
+    dispatcher in analysis/jaxpr_walk.py — and sum an approximate wire
+    volume per collective family: output bytes for gathers (the payload
+    that landed), operand bytes for reductions/all-to-alls (the payload
+    that left).  Loop trip counts are NOT multiplied in, so use this for
+    same-structure A/B ratios (quantized vs fp32 path), not absolute
+    traffic — the Program Auditor's comm-budget lint
+    (analysis/rules.py:step_wire_bytes) does the trip-weighted version."""
+    from ...analysis.jaxpr_walk import aval_bytes, iter_eqns
     out = {"gather_bytes": 0, "reduce_bytes": 0}
-
-    def nbytes(v):
-        aval = v.aval
-        return int(np.prod(aval.shape)) * np.dtype(aval.dtype).itemsize \
-            if hasattr(aval, "shape") else 0
-
-    def walk(jx):
-        for eqn in jx.eqns:
-            name = eqn.primitive.name
-            if name in _GATHER_PRIMS:
-                out["gather_bytes"] += sum(nbytes(v) for v in eqn.outvars)
-            elif name in _REDUCE_PRIMS:
-                out["reduce_bytes"] += sum(
-                    nbytes(v) for v in eqn.invars
-                    if hasattr(v, "aval"))
-            for v in eqn.params.values():
-                for sub in jax.tree.leaves(
-                        v, is_leaf=lambda s: hasattr(s, "jaxpr") or
-                        hasattr(s, "eqns")):
-                    inner = getattr(sub, "jaxpr", sub)
-                    if hasattr(inner, "eqns"):
-                        walk(inner)
-
-    walk(jaxpr)
+    for ctx in iter_eqns(jaxpr):
+        name = ctx.eqn.primitive.name
+        if name in _GATHER_PRIMS:
+            out["gather_bytes"] += sum(aval_bytes(v)
+                                       for v in ctx.eqn.outvars)
+        elif name in _REDUCE_PRIMS:
+            out["reduce_bytes"] += sum(aval_bytes(v)
+                                       for v in ctx.eqn.invars)
     return out

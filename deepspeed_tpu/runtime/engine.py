@@ -408,6 +408,22 @@ class DeepSpeedEngine:
                                    write_interval or self.steps_per_print())
         self._is_train_mode = True
 
+        # ---- program auditor (off by default; docs/program_auditor.md) #
+        # Static jaxpr lint of the step program(s) traced WITHOUT
+        # executing them, a runtime recompile guard, and a one-line
+        # summary at init.  mode "error" fails the build on error-
+        # severity findings; "warn" logs them.
+        self.program_audit = None
+        self._recompile_guard = None
+        self.analysis = self.config.analysis_config
+        if self.analysis.enabled:
+            from ..analysis import RecompileGuard, audit_engine, enforce
+            self._recompile_guard = RecompileGuard(
+                self.analysis.max_retraces)
+            self.program_audit = audit_engine(self)
+            log_dist(self.program_audit.summary_line(), ranks=[0])
+            enforce(self.program_audit, self.analysis.mode, logger)
+
         log_dist(
             f"DeepSpeedEngine: zero_stage={stage} dtype={self.compute_dtype} "
             f"mesh={dict(self.mesh_ctx.mesh.shape)} "
@@ -817,13 +833,16 @@ class DeepSpeedEngine:
         # expected warning is filtered once, on first engine build
         # (_install_donation_warning_filter at top of file).
         _install_donation_warning_filter()
-        # un-jitted apply body reused as the fused program's epilogue
+        # un-jitted apply body reused as the fused program's epilogue;
+        # the donate tuple is recorded for the Program Auditor's donation
+        # rule (analysis/auditor.py) so the audit reflects the dispatch
         self._apply_core = apply_step
+        self._apply_donate_argnums = (0, 1, 3)
         self._apply_fn = jax.jit(
             apply_step,
             out_shardings=(self.param_shardings, self.opt_shardings,
                            replicated, replicated),
-            donate_argnums=(0, 1, 3))
+            donate_argnums=self._apply_donate_argnums)
 
     # ------------------------------------------------------------------ #
     # data placement
@@ -915,6 +934,7 @@ class DeepSpeedEngine:
             kwargs = dict(kwargs)
             kwargs["pld_theta"] = jnp.float32(
                 self.progressive_layer_drop.get_theta())
+        self._observe_retrace((args, kwargs))
         batch = self._shard_batch((args, kwargs))
         args, kwargs = batch
         rng = self._next_rng()
@@ -1100,6 +1120,24 @@ class DeepSpeedEngine:
                 self.global_steps * self.train_batch_size())
             self._summary_writer.add_scalar("Train/Samples/lr", lr,
                                             self.global_steps)
+
+    # ------------------------------------------------------------------ #
+    # program auditor: runtime recompile guard (docs/program_auditor.md)
+    # ------------------------------------------------------------------ #
+    def _observe_retrace(self, tree) -> None:
+        """Feed one dispatch's batch signature to the recompile guard; a
+        budget breach warns or raises per analysis.mode.  A retrace storm
+        (shape-polymorphic batches) otherwise degrades silently — every
+        step pays an XLA compile instead of a dispatch."""
+        if self._recompile_guard is None:
+            return
+        finding = self._recompile_guard.observe(tree)
+        if finding is None:
+            return
+        if self.analysis.mode == "error":
+            from ..analysis import AuditReport, ProgramAuditError
+            raise ProgramAuditError(AuditReport(findings=[finding]))
+        logger.warning(finding.format())
 
     # ------------------------------------------------------------------ #
     # resilience: sentinel + preemption (docs/resilience.md)
@@ -1406,7 +1444,9 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown():
             self.timers(STEP_MICRO_TIMER).start()
         self.tput_timer.start()
-        args = self._shard_stacked_batch(stack_microbatches(batches))
+        stacked = stack_microbatches(batches)
+        self._observe_retrace(stacked)
+        args = self._shard_stacked_batch(stacked)
         rng = self._next_rng()
         (self.params, self.opt_state, self.scaler_state,
          self._fused_sent_state, loss, overflow,
@@ -1556,6 +1596,14 @@ class DeepSpeedEngine:
                 from .fused_step import sentinel_state_to_host
                 sentinel_state_to_host(self._fused_sent_state, self.sentinel)
             client["sentinel"] = self.sentinel.state_dict()
+        if self.program_audit is not None or self._recompile_guard is not None:
+            # audit counters ride client state like the sentinel counters:
+            # a resumed run keeps its findings tally and retrace budget
+            audit = (self.program_audit.counters()
+                     if self.program_audit is not None else {})
+            if self._recompile_guard is not None:
+                audit.update(self._recompile_guard.counters())
+            client["program_audit"] = audit
         res = self.resilience
         atomic = res.atomic_enabled
         if atomic and jax.process_count() > 1 and \
@@ -1713,6 +1761,12 @@ class DeepSpeedEngine:
                     self._fused_pending_flags = []
                     self._fused_sent_state = sentinel_state_from_host(
                         self.sentinel, self.mesh_ctx)
+            if self._recompile_guard is not None and client.get(
+                    "program_audit"):
+                # the retrace tally keeps meaning "distinct shapes this
+                # training run" across a resume (mirrors the sentinel
+                # counter round-trip)
+                self._recompile_guard.load_counters(client["program_audit"])
             if self.quantizer is not None and client.get("quantizer"):
                 self.quantizer.load_state_dict(client["quantizer"])
             if self.curriculum_scheduler is not None and client.get(

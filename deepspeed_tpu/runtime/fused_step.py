@@ -217,9 +217,15 @@ def build_fused_step(engine):
     replicated = engine.mesh_ctx.replicated()
     sent_shardings = jax.tree.map(lambda _: replicated,
                                   engine._fused_sent_state)
+    # The un-jitted body and the donation facts are recorded on the
+    # engine for the Program Auditor (analysis/auditor.py), which traces
+    # this exact program abstractly and audits donation against what is
+    # actually dispatched.
+    engine._fused_step_raw = fused_step
+    engine._fused_donate_argnums = (0, 1)
     return jax.jit(
         fused_step,
         out_shardings=(engine.param_shardings, engine.opt_shardings,
                        replicated, sent_shardings, replicated, replicated,
                        (replicated, replicated)),
-        donate_argnums=(0, 1))
+        donate_argnums=engine._fused_donate_argnums)

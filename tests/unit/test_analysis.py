@@ -1,0 +1,552 @@
+"""Program Auditor (deepspeed_tpu/analysis/, docs/program_auditor.md).
+
+One deliberately-broken fixture per lint rule — host callback in a scan
+body, undonated grad carry, divergent collective order, forced fp32
+upcast, wire-budget blowup, retrace storm — asserting rule id, severity,
+and provenance; plus clean-program zero-findings runs over the gpt2
+modular and fused train steps, the shared jaxpr-walk regression pins
+(remat2/shard_map/while-cond gaps, custom_vjp-bwd wire bytes), the
+golden lockstep signature, the CLI exit-code contract, and the
+checkpoint round-trip of the audit counters.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.analysis import (
+    ArgInfo, AuditTarget, ProgramAuditor, ProgramAuditError,
+    RecompileGuard, RULE_COMM_BUDGET, RULE_DONATION, RULE_DTYPE_HAZARD,
+    RULE_HOST_SYNC, RULE_LOCKSTEP, RULE_RECOMPILE,
+    compare_lockstep, iter_eqns, lockstep_signature, sub_jaxprs)
+from deepspeed_tpu.config import AnalysisConfig, DeepSpeedConfigError
+
+REPO = Path(__file__).resolve().parents[2]
+GOLDEN = REPO / "tests" / "unit" / "golden" / "gpt2_lockstep_signature.json"
+EXAMPLE_CFG = REPO / "docs" / "examples" / "gpt2_analysis.json"
+
+
+def _cfg(**kw) -> AnalysisConfig:
+    return AnalysisConfig.from_dict(dict({"mode": "warn"}, **kw))
+
+
+def _target(fn, *args, label="fixture", args_info=None,
+            **target_kw) -> AuditTarget:
+    return AuditTarget(label, jax.make_jaxpr(fn)(*args),
+                       args=args_info or [], **target_kw)
+
+
+def _findings(target, cfg=None):
+    return ProgramAuditor(cfg or _cfg()).run([target]).findings
+
+
+# --------------------------------------------------------------------- #
+# shared jaxpr walker (satellite: the unified sub-jaxpr dispatch)
+# --------------------------------------------------------------------- #
+def test_sub_jaxprs_dispatch_covers_higher_order_prims():
+    jx = jax.make_jaxpr(
+        jax.grad(lambda x: jax.checkpoint(
+            lambda a: jnp.dot(a, a).sum())(x)))(jnp.ones((4, 4)))
+    names = {c.eqn.primitive.name for c in iter_eqns(jx)}
+    assert "remat2" in names and "dot_general" in names
+
+    def wf(x):
+        return lax.while_loop(lambda c: jnp.dot(c, c).sum() < 100,
+                              lambda c: c + jnp.dot(c, c), x)
+    jw = jax.make_jaxpr(wf)(jnp.ones((4, 4)))
+    eqn = next(e for e in jw.jaxpr.eqns if e.primitive.name == "while")
+    kinds = [s.kind for s in sub_jaxprs(eqn)]
+    assert kinds == ["while_cond", "while_body"]
+    # the cond jaxpr's dot is visible to the flat iterator (the old
+    # flops walk missed while_cond entirely)
+    dots = [c for c in iter_eqns(jw)
+            if c.eqn.primitive.name == "dot_general"]
+    assert len(dots) == 2
+
+
+def test_flops_counts_remat_and_shard_map_regions():
+    """Unification gap fix: jax.checkpoint emits `remat2` (the old
+    dispatch listed only 'remat'/'checkpoint' and counted the region as
+    1 flop/element), and shard_map regions were skipped entirely."""
+    from deepspeed_tpu.profiling.flops_profiler import count_jaxpr_flops
+    n = 32
+    dot_flops = 2 * n * n * n
+
+    plain = jax.make_jaxpr(lambda x: jnp.dot(x, x))(jnp.ones((n, n)))
+    remat = jax.make_jaxpr(
+        jax.checkpoint(lambda x: jnp.dot(x, x)))(jnp.ones((n, n)))
+    bd_plain, bd_remat = {}, {}
+    count_jaxpr_flops(plain, bd_plain)
+    count_jaxpr_flops(remat, bd_remat)
+    assert bd_plain["dot_general"] == dot_flops
+    assert bd_remat["dot_general"] == dot_flops
+
+    mesh = ds.initialize_mesh(data=-1)
+
+    def region(x):
+        return jnp.dot(x, x)
+
+    sm = jax.make_jaxpr(jax.shard_map(
+        region, mesh=mesh.mesh, in_specs=P(), out_specs=P()))(
+        jnp.ones((n, n)))
+    bd_sm = {}
+    count_jaxpr_flops(sm, bd_sm)
+    assert bd_sm.get("dot_general", 0) == dot_flops
+    ds.reset_mesh_context()
+
+
+def test_wire_bytes_counts_custom_vjp_bwd_under_shard_map():
+    """Satellite regression: a two-collective program — custom_vjp whose
+    forward all-gathers and whose backward reduce-scatters, inside
+    shard_map, traced under grad — pins both directions' counted bytes.
+    The sparse-gradients/low-bandwidth paths have exactly this shape."""
+    from deepspeed_tpu.runtime.comm.low_bandwidth import (
+        collective_wire_bytes)
+    mesh = ds.initialize_mesh(data=-1)  # 8 simulated devices
+
+    @jax.custom_vjp
+    def gather(x):
+        return lax.all_gather(x, "data", axis=0, tiled=True)
+
+    def fwd(x):
+        return gather(x), None
+
+    def bwd(_, g):
+        return (lax.psum_scatter(g, "data", scatter_dimension=0,
+                                 tiled=True),)
+
+    gather.defvjp(fwd, bwd)
+
+    def region(x):
+        y = gather(x)
+        return (y * y).sum()
+
+    def loss(x):
+        return jax.shard_map(region, mesh=mesh.mesh, in_specs=P("data"),
+                             out_specs=P(), check_vma=False)(x).sum()
+
+    jx = jax.make_jaxpr(jax.grad(loss))(jnp.ones((8, 4), jnp.float32))
+    wire = collective_wire_bytes(jx)
+    # fwd: all_gather output [8, 4] fp32 inside the region = 128 B —
+    # nested under custom_vjp fun_jaxpr under shard_map
+    assert wire["gather_bytes"] == 8 * 4 * 4
+    # bwd: the custom-vjp reduce_scatter operand [8, 4] fp32 = 128 B
+    # (+ 16 B from the axes=() psum jax's shard_map transpose inserts on
+    # the [1, 4] output — pinned so a walker regression is loud)
+    assert wire["reduce_bytes"] == 8 * 4 * 4 + 16, wire
+    ds.reset_mesh_context()
+
+
+# --------------------------------------------------------------------- #
+# rule fixtures — one deliberately-broken program per rule
+# --------------------------------------------------------------------- #
+def test_host_sync_fires_on_callback_in_scan_body():
+    def body(c, x):
+        with jax.named_scope("hot_region"):
+            jax.debug.print("loss={}", x)
+            return c + x, None
+
+    def f(xs):
+        return lax.scan(body, 0.0, xs)[0]
+
+    target = _target(f, jnp.ones(4), label="grad_step")
+    hits = [f for f in _findings(target) if f.rule == RULE_HOST_SYNC]
+    assert len(hits) == 1
+    assert hits[0].severity == "error"
+    assert "debug_callback" in hits[0].message
+    assert hits[0].target == "grad_step"
+    # name-stack provenance into the scan body survives
+    assert "hot_region" in hits[0].scope
+
+
+def test_host_sync_warns_at_top_level():
+    def f(x):
+        jax.debug.print("x={}", x)
+        return x * 2
+
+    hits = [f for f in _findings(_target(f, jnp.ones(4)))
+            if f.rule == RULE_HOST_SYNC]
+    assert len(hits) == 1 and hits[0].severity == "warning"
+
+
+def test_host_sync_silent_on_clean_scan():
+    def f(xs):
+        return lax.scan(lambda c, x: (c + x, None), 0.0, xs)[0]
+
+    assert not [f for f in _findings(_target(f, jnp.ones(4)))
+                if f.rule == RULE_HOST_SYNC]
+
+
+def test_donation_audit_flags_undonated_consumed_arg():
+    mb = 1024 * 1024
+
+    def f(p, g):
+        return jax.tree.map(lambda a, b: a - b, p, g)
+
+    p = {"w": jnp.ones((512, 512))}  # 1 MiB
+    target = _target(
+        f, p, p, label="apply_step",
+        args_info=[ArgInfo("params", mb, donated=False, consumed=True),
+                   ArgInfo("grads", mb, donated=True, consumed=True)])
+    hits = [f for f in _findings(target) if f.rule == RULE_DONATION]
+    assert len(hits) == 1
+    assert hits[0].severity == "error"
+    assert "params" in hits[0].message and "1.0 MiB" in hits[0].message
+    # donated and sub-floor args stay silent; waste estimate = the miss
+    report = ProgramAuditor(_cfg()).run([target])
+    assert report.donation_waste_bytes == mb
+
+
+def test_lockstep_divergent_collective_order_between_configs():
+    mesh = ds.initialize_mesh(data=-1)
+
+    def order_a(x):
+        g = lax.all_gather(x, "data", axis=0, tiled=True)
+        return lax.psum_scatter(g, "data", scatter_dimension=0,
+                                tiled=True).sum()
+
+    def order_b(x):  # reduces BEFORE gathering — diverges at position 0
+        s = lax.psum(x, "data")
+        g = lax.all_gather(s, "data", axis=0, tiled=True)
+        return g.sum()
+
+    def shmap(f):
+        return jax.make_jaxpr(jax.shard_map(
+            f, mesh=mesh.mesh, in_specs=P("data"), out_specs=P(),
+            check_vma=False))(jnp.ones((8, 4)))
+
+    jx_a, jx_b = shmap(order_a), shmap(order_b)
+    same = compare_lockstep(jx_a, jx_a)
+    assert same is None
+    finding = compare_lockstep(jx_a, jx_b, "host0", "host1")
+    assert finding is not None and finding.rule == RULE_LOCKSTEP
+    assert finding.severity == "error"
+    assert "position 0" in finding.message  # first divergence named
+    # signatures themselves are order-sensitive and stable
+    assert lockstep_signature(jx_a)[0] != lockstep_signature(jx_b)[0]
+    assert lockstep_signature(jx_a)[0] == lockstep_signature(jx_a)[0]
+    ds.reset_mesh_context()
+
+
+def test_lockstep_expected_signature_mismatch_is_error():
+    target = _target(lambda x: x + 1, jnp.ones(4), label="grad_step")
+    report = ProgramAuditor(
+        _cfg(expected_signature="deadbeef")).run([target])
+    hits = [f for f in report.findings if f.rule == RULE_LOCKSTEP]
+    assert len(hits) == 1 and hits[0].severity == "error"
+    # pinning the real combined signature passes clean
+    report2 = ProgramAuditor(
+        _cfg(expected_signature=report.signature)).run(
+        [_target(lambda x: x + 1, jnp.ones(4), label="grad_step")])
+    assert not report2.findings
+
+
+def test_dtype_hazard_forced_fp32_upcast_feeding_matmul():
+    def bad(x):  # bf16 wire upcast then matmul at fp32
+        return jnp.dot(x.astype(jnp.float32), x.astype(jnp.float32))
+
+    def good(x):  # matmul stays bf16; scalar loss upcast is intended
+        return jnp.dot(x, x).sum().astype(jnp.float32)
+
+    cfg = _cfg(dtype_min_elements=1)
+    x = jnp.ones((8, 8), jnp.bfloat16)
+    hits = [f for f in _findings(_target(bad, x), cfg)
+            if f.rule == RULE_DTYPE_HAZARD]
+    assert hits and hits[0].severity == "error"
+    assert "bfloat16" in hits[0].message and "fp32" in hits[0].message
+    assert not [f for f in _findings(_target(good, x), cfg)
+                if f.rule == RULE_DTYPE_HAZARD]
+
+
+def test_dtype_hazard_upcast_wire_into_collective():
+    mesh = ds.initialize_mesh(data=-1)
+
+    def region(x):
+        return lax.all_gather(x.astype(jnp.float32), "data", axis=0,
+                              tiled=True).sum()
+
+    jx = jax.make_jaxpr(jax.shard_map(
+        region, mesh=mesh.mesh, in_specs=P("data"), out_specs=P(),
+        check_vma=False))(jnp.ones((8, 16), jnp.bfloat16))
+    hits = [f for f in _findings(
+        AuditTarget("grad_step", jx), _cfg(dtype_min_elements=1))
+        if f.rule == RULE_DTYPE_HAZARD]
+    assert hits and hits[0].severity == "error"
+    assert "all_gather" in hits[0].message
+    ds.reset_mesh_context()
+
+
+def test_comm_budget_dense_blowup_flagged():
+    mesh = ds.initialize_mesh(data=-1)
+
+    def region(x):
+        return lax.all_gather(x, "data", axis=0, tiled=True).sum()
+
+    jx = jax.make_jaxpr(jax.shard_map(
+        region, mesh=mesh.mesh, in_specs=P("data"), out_specs=P(),
+        check_vma=False))(jnp.ones((8, 1024), jnp.float32))
+    target = AuditTarget("grad_step", jx)
+    # gather moves 8*1024*4 B = 32 KiB; budget of 1 KiB trips
+    hits = [f for f in _findings(target, _cfg(comm_budget_mb=1 / 1024))
+            if f.rule == RULE_COMM_BUDGET]
+    assert len(hits) == 1 and hits[0].severity == "error"
+    assert "all_gather" in hits[0].message  # top contributor named
+    # a budget that fits stays silent; None disables
+    assert not [f for f in _findings(target, _cfg(comm_budget_mb=1.0))
+                if f.rule == RULE_COMM_BUDGET]
+    assert not [f for f in _findings(target, _cfg())
+                if f.rule == RULE_COMM_BUDGET]
+    ds.reset_mesh_context()
+
+
+def test_comm_budget_is_gas_weighted_per_optimizer_step():
+    """The budget must compare against the same gas-weighted per-step
+    total the report (and bench rows) publish: the modular grad program
+    dispatches gas times per optimizer step."""
+    mesh = ds.initialize_mesh(data=-1)
+
+    def region(x):
+        return lax.all_gather(x, "data", axis=0, tiled=True).sum()
+
+    jx = jax.make_jaxpr(jax.shard_map(
+        region, mesh=mesh.mesh, in_specs=P("data"), out_specs=P(),
+        check_vma=False))(jnp.ones((8, 1024), jnp.float32))
+    target = AuditTarget("grad_step", jx)
+    one_dispatch = 8 * 1024 * 4  # 32 KiB
+    # budget sits between 1 dispatch and the gas=8 per-step total
+    cfg = _cfg(comm_budget_mb=(4 * one_dispatch) / (1024 * 1024))
+    report = ProgramAuditor(cfg).run([target], gas=8)
+    assert report.wire_bytes_per_step == 8 * one_dispatch
+    hits = [f for f in report.findings if f.rule == RULE_COMM_BUDGET]
+    assert len(hits) == 1 and hits[0].severity == "error"
+    # at gas=1 the same budget fits
+    assert not [f for f in ProgramAuditor(cfg).run([target]).findings
+                if f.rule == RULE_COMM_BUDGET]
+    ds.reset_mesh_context()
+
+
+def test_step_wire_bytes_counts_max_cond_branch_only():
+    """Only one cond branch executes, so wire volume counts the most
+    expensive branch (the flops counter's semantics) — and ppermute is
+    lockstep-relevant but excluded from wire volume."""
+    from deepspeed_tpu.analysis import step_wire_bytes
+    mesh = ds.initialize_mesh(data=-1)
+
+    def region(pred, x):
+        big = lambda a: lax.all_gather(a, "data", axis=0, tiled=True).sum()
+        small = lambda a: a.sum()
+        return lax.cond(pred, big, small, x)
+
+    jx = jax.make_jaxpr(jax.shard_map(
+        region, mesh=mesh.mesh, in_specs=(P(), P("data")), out_specs=P(),
+        check_vma=False))(jnp.array(True), jnp.ones((8, 64), jnp.float32))
+    total, contributors = step_wire_bytes(jx)
+    assert total == 8 * 64 * 4  # the gather branch, counted once
+    assert len(contributors) == 1
+
+    def perm(x):
+        return lax.ppermute(x, "data",
+                            perm=[(i, (i + 1) % 8) for i in range(8)])
+
+    jp = jax.make_jaxpr(jax.shard_map(
+        perm, mesh=mesh.mesh, in_specs=P("data"), out_specs=P("data"),
+        check_vma=False))(jnp.ones((8, 64), jnp.float32))
+    assert step_wire_bytes(jp)[0] == 0  # ppermute: lockstep-only
+    from deepspeed_tpu.analysis import collective_sequence
+    assert any("ppermute" in s for s in collective_sequence(jp))
+    ds.reset_mesh_context()
+
+
+def test_recompile_guard_retrace_storm():
+    guard = RecompileGuard(max_retraces=2)
+    assert guard.observe((np.zeros((4, 16), np.int32),)) is None
+    assert guard.observe((np.zeros((4, 16), np.int32),)) is None  # cached
+    assert guard.observe((np.zeros((4, 12), np.int32),)) is None  # 1st
+    assert guard.observe((np.zeros((4, 8), np.int32),)) is None   # 2nd
+    finding = guard.observe((np.zeros((4, 4), np.int32),))        # 3rd
+    assert finding is not None and finding.rule == RULE_RECOMPILE
+    assert finding.severity == "error"
+    assert "(4, 8)" in finding.message and "(4, 4)" in finding.message
+    assert guard.retraces_seen == 3
+    # dtype flap is also a retrace
+    g2 = RecompileGuard(max_retraces=1)
+    g2.observe((np.zeros(4, np.int32),))
+    g2.observe((np.zeros(4, np.float32),))
+    f2 = g2.observe((np.zeros(4, np.int64),))
+    assert f2 is not None and "int64" in f2.message
+
+
+# --------------------------------------------------------------------- #
+# clean programs: gpt2 modular + fused train steps audit to zero
+# --------------------------------------------------------------------- #
+def _tiny_engine(extra_config=None, fused=False, bf16=False, gas=1):
+    from deepspeed_tpu.models import GPT2Config, GPT2Model
+    ds.reset_mesh_context()
+    cfg = GPT2Config(vocab_size=64, n_positions=16, hidden_size=32,
+                     num_layers=2, num_heads=4, bf16=bf16,
+                     embd_dropout=0.0, attn_dropout=0.0,
+                     hidden_dropout=0.0)
+    model = GPT2Model(cfg)
+    config = {
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+        "fused_step": {"enabled": fused},
+        "analysis": {"mode": "warn"},
+        "steps_per_print": 10 ** 9,
+    }
+    if bf16:
+        config["bf16"] = {"enabled": True}
+    config.update(extra_config or {})
+    engine, _, _, _ = ds.initialize(
+        model=model, config=config,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)))
+    return engine
+
+
+def test_clean_gpt2_modular_step_zero_findings():
+    engine = _tiny_engine()
+    report = engine.program_audit
+    assert report is not None
+    assert report.findings == [], [f.format() for f in report.findings]
+    assert report.targets == ["grad_step", "apply_step"]
+    assert report.signature is not None
+
+
+def test_clean_gpt2_fused_step_zero_findings():
+    engine = _tiny_engine(fused=True, bf16=True, gas=2)
+    assert engine._fused_step_fn is not None, engine.fused_step_reason
+    report = engine.program_audit
+    assert report.findings == [], [f.format() for f in report.findings]
+    assert report.targets == ["fused_step"]
+
+
+def test_zero3_streaming_audits_clean_with_collectives():
+    """The streamed stage-3 program has REAL explicit collectives; the
+    audit must see them (trip-weighted wire > 0) and still find nothing
+    wrong."""
+    engine = _tiny_engine(extra_config={"zero_optimization": {
+        "stage": 3, "stage3_param_persistence_threshold": 0,
+        "stage3_max_live_parameters": 1,
+        "stage3_prefetch_bucket_size": 0}})
+    report = engine.program_audit
+    assert report.findings == [], [f.format() for f in report.findings]
+    assert report.wire_bytes_per_step > 0
+    assert any("all_gather" in s for s in report.collective_sequence)
+
+
+def test_engine_error_mode_raises_on_retrace_storm():
+    engine = _tiny_engine(extra_config={
+        "analysis": {"mode": "error", "max_retraces": 1}})
+    ids16 = np.zeros((8, 16), np.int32)
+    ids12 = np.zeros((8, 12), np.int32)
+    ids8 = np.zeros((8, 8), np.int32)
+    engine.forward(ids16)
+    engine.backward()
+    engine.step()
+    engine.forward(ids12)  # 1st retrace: within budget
+    engine.backward()
+    engine.step()
+    with pytest.raises(ProgramAuditError) as ei:
+        engine.forward(ids8)  # 2nd retrace: over budget
+    assert "retraced" in str(ei.value)
+
+
+def test_audit_counters_round_trip_through_checkpoint(tmp_path):
+    engine = _tiny_engine(extra_config={
+        "analysis": {"mode": "warn", "max_retraces": 8}})
+    engine.forward(np.zeros((8, 16), np.int32))
+    engine.backward()
+    engine.step()
+    engine.forward(np.zeros((8, 12), np.int32))  # one retrace
+    engine.backward()
+    engine.step()
+    assert engine._recompile_guard.retraces_seen == 1
+    engine.save_checkpoint(str(tmp_path), tag="t1")
+    meta = json.loads(
+        (tmp_path / "t1" / "ds_meta.json").read_text())
+    audit = meta["client_state"]["program_audit"]
+    assert audit["retraces_seen"] == 1
+    assert audit["lockstep_signature"] == engine.program_audit.signature
+    assert "findings_by_severity" in audit
+
+    engine2 = _tiny_engine(extra_config={
+        "analysis": {"mode": "warn", "max_retraces": 8}})
+    engine2.load_checkpoint(str(tmp_path), tag="t1")
+    assert engine2._recompile_guard.retraces_seen >= 1
+
+
+def test_analysis_off_by_default_no_auditor_state():
+    engine = _tiny_engine(extra_config={"analysis": None})
+    assert engine.program_audit is None
+    assert engine._recompile_guard is None
+
+
+def test_analysis_config_validation():
+    assert not AnalysisConfig.from_dict(None).enabled
+    with pytest.raises(DeepSpeedConfigError):
+        AnalysisConfig.from_dict({"mode": "loud"})
+    with pytest.raises(DeepSpeedConfigError):
+        AnalysisConfig.from_dict({"mode": "warn", "max_retraces": 0})
+    with pytest.raises(DeepSpeedConfigError):
+        AnalysisConfig.from_dict({"mode": "warn", "comm_budget_mb": -1})
+
+
+# --------------------------------------------------------------------- #
+# golden lockstep signature + CLI contract (CI satellites)
+# --------------------------------------------------------------------- #
+def test_golden_lockstep_signature_of_default_gpt2_config():
+    """Drift in the default gpt2 config's collective sequence must be an
+    explicit diff of the golden file, not a silent change."""
+    golden = json.loads(GOLDEN.read_text())
+    engine = _tiny_engine()  # stage 2 — the example config's shape
+    report = engine.program_audit
+    assert report.signature == golden["signature"], (
+        "the default gpt2 step program's collective sequence changed — "
+        "if intended, update tests/unit/golden/gpt2_lockstep_signature"
+        f".json (traced {len(report.collective_sequence)} collectives: "
+        f"{report.collective_sequence[:5]}...)")
+    assert len(report.collective_sequence) == golden["collective_count"]
+
+
+def _run_cli(config_path, *extra):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.analysis",
+         "--config", str(config_path), *extra],
+        cwd=str(REPO), capture_output=True, text=True, timeout=300,
+        env=env)
+
+
+def test_cli_warn_mode_exits_zero_on_example_config():
+    out = _run_cli(EXAMPLE_CFG, "--json")
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(
+        out.stdout[out.stdout.index("{\n"):])
+    golden = json.loads(GOLDEN.read_text())
+    assert payload["signature"] == golden["signature"]
+    assert payload["findings"] == []
+
+
+def test_cli_error_mode_exits_nonzero_on_error_findings(tmp_path):
+    bad = dict(json.loads(EXAMPLE_CFG.read_text()))
+    bad["analysis"] = {"mode": "error", "expected_signature": "deadbeef"}
+    cfg_path = tmp_path / "bad.json"
+    cfg_path.write_text(json.dumps(bad))
+    out = _run_cli(cfg_path)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "lockstep" in out.stdout
+    assert "FAILED" in out.stderr

@@ -31,7 +31,12 @@ def test_resilience_package_imports_cleanly():
             "deepspeed_tpu.runtime.resilience.preemption",
             "deepspeed_tpu.runtime.resilience.sentinel",
             "deepspeed_tpu.runtime.resilience.fault_injection",
-            "deepspeed_tpu.runtime.fused_step")
+            "deepspeed_tpu.runtime.fused_step",
+            # program auditor: lazily imported by the engine (only when
+            # the analysis block is on) and by the CLI entry point
+            "deepspeed_tpu.analysis",
+            "deepspeed_tpu.analysis.cli",
+            "deepspeed_tpu.analysis.__main__")
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     out = subprocess.run(
