@@ -299,10 +299,18 @@ def _program_audit_fields(engine):
     try:
         from deepspeed_tpu.analysis import audit_engine
         report = audit_engine(engine, multihost=False)
+        lb = report.predicted_step_time_lb_s
         return {
             "lockstep_signature": (report.signature or "")[:16],
             "wire_bytes_per_step": report.wire_bytes_per_step,
             "audit_findings": report.counts(),
+            # schedule provenance (docs/program_auditor.md, round 10):
+            # predicted-vs-measured rides every row, so a perf PR's
+            # claim is checkable against the static model
+            "overlap_efficiency": round(report.overlap_efficiency, 4),
+            "peak_hbm_bytes": report.peak_hbm_bytes,
+            "predicted_step_time_lb": (round(lb, 6)
+                                       if lb is not None else None),
         }
     except Exception as e:  # noqa: BLE001 — provenance is best-effort
         return {"lockstep_signature": f"audit-failed: {e}"[:80]}

@@ -10,11 +10,16 @@ low-bandwidth wire-byte accounting.
 from .auditor import (ProgramAuditor, audit_engine, engine_targets,
                       enforce, synthesize_sample_batch,
                       verify_multihost_lockstep)
+from .cost_model import build_step_time_model, program_io_bytes
 from .findings import (ALL_RULES, AuditReport, Finding, ProgramAuditError,
                        RULE_COMM_BUDGET, RULE_DONATION, RULE_DTYPE_HAZARD,
-                       RULE_HOST_SYNC, RULE_LOCKSTEP, RULE_RECOMPILE)
+                       RULE_HBM_BUDGET, RULE_HOST_SYNC, RULE_LOCKSTEP,
+                       RULE_OVERLAP, RULE_RECOMPILE)
 from .jaxpr_walk import (EqnCtx, SubJaxpr, as_jaxpr, aval_bytes,
                          eqn_scope, iter_eqns, sub_jaxprs)
+from .liveness import LivenessReport, estimate_liveness
+from .overlap import (CollectiveOverlap, analyze_overlap,
+                      overlap_efficiency, summarize_overlap)
 from .recompile import RecompileGuard, batch_signature
 from .rules import (ArgInfo, AuditTarget, STATIC_RULES, compare_lockstep,
                     lockstep_expectation_finding, step_wire_bytes)
@@ -23,15 +28,21 @@ from .signature import (collective_sequence, combine_signatures,
                         signature_of_sequence)
 
 __all__ = [
-    "ALL_RULES", "ArgInfo", "AuditReport", "AuditTarget", "EqnCtx",
-    "Finding", "ProgramAuditError", "ProgramAuditor", "RecompileGuard",
+    "ALL_RULES", "ArgInfo", "AuditReport", "AuditTarget",
+    "CollectiveOverlap", "EqnCtx", "Finding", "LivenessReport",
+    "ProgramAuditError", "ProgramAuditor", "RecompileGuard",
     "RULE_COMM_BUDGET", "RULE_DONATION", "RULE_DTYPE_HAZARD",
-    "RULE_HOST_SYNC", "RULE_LOCKSTEP", "RULE_RECOMPILE", "STATIC_RULES",
-    "SubJaxpr", "as_jaxpr", "audit_engine", "aval_bytes",
-    "batch_signature", "collective_sequence", "combine_signatures",
+    "RULE_HBM_BUDGET", "RULE_HOST_SYNC", "RULE_LOCKSTEP", "RULE_OVERLAP",
+    "RULE_RECOMPILE", "STATIC_RULES",
+    "SubJaxpr", "analyze_overlap", "as_jaxpr", "audit_engine",
+    "aval_bytes",
+    "batch_signature", "build_step_time_model", "collective_sequence",
+    "combine_signatures",
     "compare_lockstep", "engine_targets", "enforce", "eqn_scope",
-    "first_divergence", "iter_eqns", "lockstep_expectation_finding",
-    "lockstep_signature",
+    "estimate_liveness", "first_divergence", "iter_eqns",
+    "lockstep_expectation_finding", "lockstep_signature",
+    "overlap_efficiency", "program_io_bytes",
     "signature_of_sequence", "step_wire_bytes", "sub_jaxprs",
-    "synthesize_sample_batch", "verify_multihost_lockstep",
+    "summarize_overlap", "synthesize_sample_batch",
+    "verify_multihost_lockstep",
 ]

@@ -20,7 +20,11 @@ from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
+from .cost_model import build_step_time_model, program_io_bytes
 from .findings import AuditReport, Finding, ProgramAuditError
+from .liveness import estimate_liveness, hbm_budget_finding
+from .overlap import (analyze_overlap, overlap_efficiency,
+                      overlap_rule_findings, summarize_overlap)
 from .rules import (ArgInfo, AuditTarget, STATIC_RULES,
                     comm_budget_finding, donation_waste_bytes,
                     lockstep_expectation_finding, step_wire_bytes)
@@ -41,6 +45,40 @@ def _tree_bytes(tree) -> int:
             # extended dtypes (PRNG keys): count the key payload
             total += int(np.prod(shape, initial=1)) * 4
     return total
+
+
+def _leaf_count(tree) -> int:
+    import jax
+    return len(jax.tree.leaves(tree))
+
+
+def _expand_invars(arg_trees, donated_labels):
+    """Flattened per-invar (donated, label) lists for a traced call:
+    make_jaxpr flattens the arguments in order, so each argument
+    subtree's flags expand across its leaf count."""
+    donated, labels = [], []
+    for tree, (is_donated, label) in zip(arg_trees, donated_labels):
+        n = _leaf_count(tree)
+        donated.extend([is_donated] * n)
+        labels.extend([f"{label}[{k}]" for k in range(n)])
+    return donated, labels
+
+
+def _engine_scan_info(engine) -> dict:
+    """Scan-structure provenance recorded at build time: the fused gas
+    scan (runtime/fused_step.py) and the streamed-ZeRO-3 layer plan
+    (runtime/zero/stage3_streaming.py, populated during tracing)."""
+    info = dict(getattr(engine, "_fused_scan_info", None) or {})
+    stream = getattr(engine, "_zero3_stream", None)
+    plan = getattr(stream, "last_plan", None)
+    if plan is not None:
+        info["zero3_streaming"] = {
+            "layers_per_step": plan.layers_per_step,
+            "prefetch": plan.prefetch,
+            "num_layers": plan.num_layers,
+            "params_per_layer": plan.params_per_layer,
+        }
+    return info
 
 
 def _grads_template(engine):
@@ -119,7 +157,18 @@ def engine_targets(engine, sample_batch: Optional[Tuple] = None
                         3 in donated, True),
                 ArgInfo("batch", _tree_bytes(stacked), False, False),
             ]
-            targets.append(AuditTarget("fused_step", closed, args))
+            arg_trees = (engine.params, engine.opt_state,
+                         engine.scaler_state, engine._fused_sent_state,
+                         engine._rng, stacked, {})
+            donated_invars, labels = _expand_invars(arg_trees, [
+                (0 in donated, "params"), (1 in donated, "opt_state"),
+                (2 in donated, "scaler_state"),
+                (3 in donated, "sentinel_state"),
+                (False, "rng"), (False, "batch"), (False, "kwargs")])
+            targets.append(AuditTarget(
+                "fused_step", closed, args,
+                donated_invars=donated_invars, invar_labels=labels,
+                scan_info=_engine_scan_info(engine)))
         return targets
 
     if sample_batch is not None:
@@ -133,7 +182,17 @@ def engine_targets(engine, sample_batch: Optional[Tuple] = None
                     False, False),
             ArgInfo("batch", _tree_bytes(sample_batch), False, False),
         ]
-        targets.append(AuditTarget("grad_step", closed, args))
+        donated_invars, labels = _expand_invars(
+            (engine.params, engine.scaler_state, engine._rng,
+             list(sample_batch)),
+            [(False, "params"), (False, "scaler_state"),
+             (False, "rng"), (False, "batch")])
+        # opt_state sits in HBM while the grad program runs
+        targets.append(AuditTarget(
+            "grad_step", closed, args,
+            donated_invars=donated_invars, invar_labels=labels,
+            resident_extra_bytes=_tree_bytes(engine.opt_state),
+            scan_info=_engine_scan_info(engine)))
 
     if engine._apply_core is not None:
         grads = _grads_template(engine)
@@ -150,7 +209,15 @@ def engine_targets(engine, sample_batch: Optional[Tuple] = None
                     2 in donated, True),
             ArgInfo("grads", _tree_bytes(grads), 3 in donated, True),
         ]
-        targets.append(AuditTarget("apply_step", closed, args))
+        donated_invars, labels = _expand_invars(
+            (engine.params, engine.opt_state, engine.scaler_state,
+             grads),
+            [(0 in donated, "params"), (1 in donated, "opt_state"),
+             (2 in donated, "scaler_state"), (3 in donated, "grads")])
+        targets.append(AuditTarget(
+            "apply_step", closed, args,
+            donated_invars=donated_invars, invar_labels=labels,
+            scan_info=_engine_scan_info(engine)))
     return targets
 
 
@@ -168,6 +235,11 @@ class ProgramAuditor:
                 report.findings.extend(rule(target, self.cfg))
         sigs = []
         contributors = []
+        all_records = []
+        total_flops = 0
+        io_bytes = 0
+        peak_liveness = None
+        from ..profiling.flops_profiler import count_jaxpr_flops
         for target in targets:
             sig, seq = lockstep_signature(target.closed_jaxpr)
             sigs.append(sig)
@@ -179,6 +251,20 @@ class ProgramAuditor:
             report.wire_bytes_per_step += total * repeat
             contributors.extend((f"{target.label}:{k}", v * repeat)
                                 for k, v in contrib)
+            # ---- schedule-level analyses -------------------------- #
+            records = analyze_overlap(target.closed_jaxpr, self.cfg,
+                                      target_label=target.label)
+            report.findings.extend(overlap_rule_findings(
+                records, self.cfg, target.scan_info))
+            all_records.extend(records * repeat)
+            total_flops += count_jaxpr_flops(target.closed_jaxpr) * repeat
+            io_bytes += program_io_bytes(target.closed_jaxpr) * repeat
+            liveness = estimate_liveness(
+                target.closed_jaxpr, target.donated_invars,
+                target.invar_labels, target.resident_extra_bytes)
+            if (peak_liveness is None or
+                    liveness.total_bytes > peak_liveness[1].total_bytes):
+                peak_liveness = (target.label, liveness)
         report.signature = (combine_signatures(sigs) if sigs else None)
         report.findings.extend(lockstep_expectation_finding(
             report.signature, len(report.collective_sequence), self.cfg))
@@ -189,6 +275,24 @@ class ProgramAuditor:
             report.wire_bytes_per_step, contributors, self.cfg))
         report.donation_waste_bytes = donation_waste_bytes(targets,
                                                            self.cfg)
+        # peak HBM = the worst single program (programs run one at a
+        # time; each target already counts its resident-but-unreferenced
+        # engine state)
+        report.overlap_efficiency = overlap_efficiency(all_records)
+        report.overlap = summarize_overlap(all_records)
+        if peak_liveness is not None:
+            label, liveness = peak_liveness
+            report.peak_hbm_bytes = liveness.total_bytes
+            report.peak_hbm_contributors = list(liveness.contributors)
+            if liveness.resident_extra_bytes > 0:
+                report.peak_hbm_contributors.append(
+                    ("<resident engine state>",
+                     liveness.resident_extra_bytes))
+            report.findings.extend(hbm_budget_finding(
+                liveness.total_bytes, label,
+                report.peak_hbm_contributors, self.cfg))
+        report.step_time = build_step_time_model(
+            total_flops, io_bytes, all_records, self.cfg)
         return report
 
 

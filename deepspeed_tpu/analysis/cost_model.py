@@ -1,0 +1,78 @@
+"""Static step-time model — a roofline lower bound for the traced step.
+
+Every future perf PR ships with predicted-vs-measured provenance
+(ROADMAP items 1 and 3): bench rows embed this lower bound next to the
+lockstep signature and wire bytes, so "the fused step should be ~X ms"
+is a number computed from the program, not a hope.  Three terms, each a
+genuine lower bound:
+
+  compute   total jaxpr flops (profiling/flops_profiler walk, scan trip
+            counts multiplied in) at the configured peak
+  memory    program I/O bytes — every input read and output written at
+            least once, whatever XLA fuses in between — at HBM bandwidth
+  comm      overlap-adjusted wire time: the hidden fraction of each
+            collective (analysis/overlap.py) rides under compute, the
+            exposed remainder is added on top
+
+    t_lb = max(compute, memory, hidden_comm) + exposed_comm
+
+The model is deliberately optimistic (true lower bound): measured step
+time below it means the model's hardware constants are wrong; measured
+far above it bounds how much the schedule is leaving on the table.
+"""
+
+from typing import Any, Dict, List
+
+from .jaxpr_walk import as_jaxpr, aval_bytes
+from .overlap import CollectiveOverlap
+
+
+def program_io_bytes(closed_jaxpr) -> int:
+    """Bytes the program must move through HBM at least once: every
+    input read, every output written."""
+    jx = as_jaxpr(closed_jaxpr)
+    return (sum(aval_bytes(v) for v in jx.invars)
+            + sum(aval_bytes(v) for v in jx.constvars)
+            + sum(aval_bytes(v) for v in jx.outvars))
+
+
+def build_step_time_model(total_flops: int, io_bytes: int,
+                          records: List[CollectiveOverlap],
+                          cfg) -> Dict[str, Any]:
+    """Combine the three roofline terms into the report payload.
+
+    ``records`` must already be the per-OPTIMIZER-STEP set (the auditor
+    repeats the modular grad program's records gas times, matching the
+    wire-byte accounting)."""
+    peak_flops_s = cfg.hw_peak_tflops * 1e12
+    hbm_bw = cfg.hw_hbm_gbps * 1e9
+    wire_bw = cfg.hw_ici_gbps * 1e9
+
+    t_compute = total_flops / peak_flops_s
+    t_memory = io_bytes / hbm_bw
+    hidden_bytes = sum(r.wire_bytes * r.mult * r.hidden_fraction
+                       for r in records)
+    exposed_bytes = sum(r.wire_bytes * r.mult * (1.0 - r.hidden_fraction)
+                        for r in records)
+    t_hidden = hidden_bytes / wire_bw
+    t_exposed = exposed_bytes / wire_bw
+
+    terms = {"compute": t_compute, "memory": t_memory,
+             "hidden_comm": t_hidden}
+    bound = max(terms, key=terms.get)
+    t_lb = terms[bound] + t_exposed
+    return {
+        "flops_per_step": int(total_flops),
+        "io_bytes_per_step": int(io_bytes),
+        "wire_bytes_hidden": int(hidden_bytes),
+        "wire_bytes_exposed": int(exposed_bytes),
+        "t_compute_s": t_compute,
+        "t_memory_s": t_memory,
+        "t_comm_hidden_s": t_hidden,
+        "t_comm_exposed_s": t_exposed,
+        "bound": bound,
+        "predicted_step_time_lb_s": t_lb,
+        "hw": {"peak_tflops": cfg.hw_peak_tflops,
+               "hbm_gbps": cfg.hw_hbm_gbps,
+               "ici_gbps": cfg.hw_ici_gbps},
+    }

@@ -19,9 +19,13 @@ RULE_LOCKSTEP = "lockstep"
 RULE_DTYPE_HAZARD = "dtype_hazard"
 RULE_COMM_BUDGET = "comm_budget"
 RULE_RECOMPILE = "recompile"
+# schedule-level rules (overlap / liveness / step-time; ISSUE 6)
+RULE_OVERLAP = "overlap"
+RULE_HBM_BUDGET = "hbm_budget"
 
 ALL_RULES = (RULE_HOST_SYNC, RULE_DONATION, RULE_LOCKSTEP,
-             RULE_DTYPE_HAZARD, RULE_COMM_BUDGET, RULE_RECOMPILE)
+             RULE_DTYPE_HAZARD, RULE_COMM_BUDGET, RULE_RECOMPILE,
+             RULE_OVERLAP, RULE_HBM_BUDGET)
 
 
 @dataclass
@@ -62,6 +66,18 @@ class AuditReport:
     # HBM the donation rule estimates is being wasted (0 when clean)
     donation_waste_bytes: int = 0
     targets: List[str] = field(default_factory=list)
+    # ---- schedule-level analyses (overlap / liveness / step-time) ---- #
+    # bytes-weighted fraction of collective wire time hidden under
+    # independent compute (1.0 when there are no explicit collectives)
+    overlap_efficiency: float = 1.0
+    # per-collective overlap records + summary (analysis/overlap.py)
+    overlap: Dict[str, Any] = field(default_factory=dict)
+    # donation-aware static peak HBM estimate across targets, with the
+    # top live-buffer contributors at the peak point
+    peak_hbm_bytes: int = 0
+    peak_hbm_contributors: List[Any] = field(default_factory=list)
+    # static step-time lower bound (analysis/cost_model.py)
+    step_time: Dict[str, Any] = field(default_factory=dict)
 
     def counts(self) -> Dict[str, int]:
         out = {s: 0 for s in SEVERITIES}
@@ -73,14 +89,23 @@ class AuditReport:
     def has_errors(self) -> bool:
         return any(f.severity == "error" for f in self.findings)
 
+    @property
+    def predicted_step_time_lb_s(self) -> Optional[float]:
+        return self.step_time.get("predicted_step_time_lb_s")
+
     def summary_line(self) -> str:
         c = self.counts()
         sig = (self.signature or "")[:12] or "n/a"
+        lb = self.predicted_step_time_lb_s
+        lb_ms = f"{lb * 1e3:.2f}" if lb is not None else "n/a"
         return (f"program audit: {c['error']} error(s), "
                 f"{c['warning']} warning(s), {c['info']} info over "
                 f"{len(self.targets)} program(s); "
                 f"wire={self.wire_bytes_per_step} B/step, "
                 f"donation_waste={self.donation_waste_bytes} B, "
+                f"overlap={self.overlap_efficiency:.2f}, "
+                f"peak_hbm={self.peak_hbm_bytes / (1024 * 1024):.1f} MiB, "
+                f"step_lb={lb_ms} ms, "
                 f"lockstep={sig}")
 
     def counters(self) -> Dict[str, Any]:
@@ -91,6 +116,9 @@ class AuditReport:
             "wire_bytes_per_step": int(self.wire_bytes_per_step),
             "donation_waste_bytes": int(self.donation_waste_bytes),
             "lockstep_signature": self.signature,
+            "overlap_efficiency": float(self.overlap_efficiency),
+            "peak_hbm_bytes": int(self.peak_hbm_bytes),
+            "predicted_step_time_lb_s": self.predicted_step_time_lb_s,
         }
 
     def to_json(self, indent: Optional[int] = 2) -> str:
@@ -101,6 +129,12 @@ class AuditReport:
             "wire_bytes_per_step": self.wire_bytes_per_step,
             "donation_waste_bytes": self.donation_waste_bytes,
             "targets": self.targets,
+            "overlap_efficiency": self.overlap_efficiency,
+            "overlap": self.overlap,
+            "peak_hbm_bytes": self.peak_hbm_bytes,
+            "peak_hbm_contributors": [
+                list(c) for c in self.peak_hbm_contributors],
+            "step_time": self.step_time,
         }, indent=indent)
 
 

@@ -1,0 +1,186 @@
+"""Donation-aware static HBM liveness — peak memory of a traced program
+before it ever touches hardware.
+
+ZeRO-Infinity-style memory planning (arXiv:2104.07857) starts from a
+model of what is resident when; the gpt2_large OOM repaired in PR 1 was
+exactly the class of bug a static liveness pass catches on CPU.  The
+estimator walks the top-level equation list once, tracking the live set
+over aval byte sizes:
+
+  - non-donated program inputs stay live for the whole program (the
+    caller keeps the buffer); donated inputs die at their last use;
+  - at each equation, outputs whose (shape, dtype) match a
+    simultaneously-dying releasable buffer are assumed aliased (XLA's
+    input/output aliasing for donated args and scan carries) — they add
+    no transient allocation;
+  - sub-jaxprs (scan bodies, remat regions, shard_map regions)
+    contribute their internal transient peak — the streamed-ZeRO-3
+    gathered layer group materializes INSIDE the layer scan body, and
+    must count;
+  - the report names the top live buffers at the peak point, so an
+    over-budget finding says WHAT is pinning HBM, not just how much.
+
+This is an estimate of the program as written: XLA fusion can only
+shrink it (fused intermediates never materialize), so the figure is a
+safe planning ceiling for ``analysis.hbm_budget_mb``.
+"""
+
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from .findings import Finding, RULE_HBM_BUDGET
+from .jaxpr_walk import as_jaxpr, aval_bytes, eqn_scope, sub_jaxprs
+
+_TOP_CONTRIBUTORS = 8
+
+
+@dataclass
+class LivenessReport:
+    """Static peak-HBM estimate of one traced program."""
+    peak_bytes: int = 0
+    # (buffer label, bytes) of the largest live buffers at the peak
+    contributors: List[Tuple[str, int]] = field(default_factory=list)
+    peak_scope: str = ""
+    # engine state resident during this program but not among its args
+    # (the modular grad program runs while opt_state sits in HBM)
+    resident_extra_bytes: int = 0
+
+    @property
+    def total_bytes(self) -> int:
+        return self.peak_bytes + self.resident_extra_bytes
+
+
+def _alias_key(v):
+    aval = getattr(v, "aval", None)
+    if aval is None or not hasattr(aval, "shape"):
+        return None
+    return (tuple(aval.shape), str(getattr(aval, "dtype", "?")))
+
+
+def _inner_extra(jx) -> int:
+    """Transient peak of values defined INSIDE a sub-jaxpr (its inputs
+    are views of outer buffers, already counted by the caller) — the
+    same walker with the frame's inputs registered at zero cost."""
+    return estimate_liveness(jx, _count_invars=False).peak_bytes
+
+
+def estimate_liveness(closed_jaxpr,
+                      donated_invars: Optional[List[bool]] = None,
+                      invar_labels: Optional[List[str]] = None,
+                      resident_extra_bytes: int = 0,
+                      _count_invars: bool = True) -> LivenessReport:
+    """Peak live bytes of one traced program, donation-aware.
+
+    With ``_count_invars=False`` (sub-jaxpr frames) the inputs and
+    consts are registered at zero bytes and non-releasable: they are
+    views of outer buffers the caller already counts, must never alias
+    this frame's outputs, and never free — the walk then measures only
+    the frame's internally-defined transient peak."""
+    jx = as_jaxpr(closed_jaxpr)
+    eqns = list(jx.eqns)
+    invars = list(jx.invars)
+    consts = list(jx.constvars)
+    n_in = len(invars)
+    donated = list(donated_invars or [False] * n_in)
+    donated += [False] * (n_in - len(donated))
+    labels = list(invar_labels or [])
+    labels += [f"arg{k}" for k in range(len(labels), n_in)]
+
+    last_use: Dict[int, int] = {}
+    for i, eqn in enumerate(eqns):
+        for v in eqn.invars:
+            last_use[id(v)] = i
+    for v in jx.outvars:
+        last_use[id(v)] = len(eqns)
+
+    # live registry: id -> (bytes, label, releasable)
+    live: Dict[int, Tuple[int, str, bool]] = {}
+    for k, v in enumerate(invars):
+        if _count_invars:
+            live[id(v)] = (aval_bytes(v), labels[k], bool(donated[k]))
+        else:
+            live[id(v)] = (0, labels[k], False)
+    for k, v in enumerate(consts):
+        live[id(v)] = ((aval_bytes(v), f"const{k}", True)
+                       if _count_invars else (0, f"const{k}", False))
+    live_total = sum(b for b, _, _ in live.values())
+
+    report = LivenessReport(resident_extra_bytes=resident_extra_bytes)
+
+    def snapshot(extra: int, extra_label: str, scope: str,
+                 candidate: int) -> None:
+        if candidate <= report.peak_bytes:
+            return
+        report.peak_bytes = candidate
+        top = sorted(((lbl, b) for b, lbl, _ in live.values() if b > 0),
+                     key=lambda kv: -kv[1])[:_TOP_CONTRIBUTORS]
+        if extra > 0:
+            top = sorted(top + [(extra_label, extra)],
+                         key=lambda kv: -kv[1])[:_TOP_CONTRIBUTORS]
+        report.contributors = top
+        report.peak_scope = scope
+
+    snapshot(0, "", "<entry>", live_total)
+
+    for i, eqn in enumerate(eqns):
+        scope = eqn_scope(eqn, "") or "<top>"
+        sub_peak = max((_inner_extra(s.jaxpr) for s in sub_jaxprs(eqn)),
+                       default=0)
+        # releasable buffers dying at this equation can alias outputs of
+        # the same shape/dtype (donated args, scan carries)
+        dying_keys = Counter()
+        dying_ids = set()
+        for v in eqn.invars:
+            ent = live.get(id(v))
+            if (ent is not None and ent[2] and last_use.get(id(v)) == i
+                    and id(v) not in dying_ids):
+                key = _alias_key(v)
+                if key is not None:
+                    dying_keys[key] += 1
+                dying_ids.add(id(v))
+        alloc = 0
+        avail = Counter(dying_keys)
+        for ov in eqn.outvars:
+            b = aval_bytes(ov)
+            key = _alias_key(ov)
+            if key is not None and avail[key] > 0:
+                avail[key] -= 1
+            else:
+                alloc += b
+        label = f"{eqn.primitive.name}@{scope}"
+        snapshot(sub_peak, f"{label} internals", scope,
+                 live_total + alloc + sub_peak)
+        for ov in eqn.outvars:
+            b = aval_bytes(ov)
+            live[id(ov)] = (b, label, True)
+            live_total += b
+        for vid in dying_ids:
+            live_total -= live.pop(vid)[0]
+        for ov in eqn.outvars:
+            if id(ov) in live and id(ov) not in last_use:
+                live_total -= live.pop(id(ov))[0]
+    return report
+
+
+def hbm_budget_finding(peak_bytes: int, target_label: str,
+                       contributors: List[Tuple[str, int]],
+                       cfg) -> List[Finding]:
+    """Error finding when the static peak exceeds
+    ``analysis.hbm_budget_mb`` — named contributors, caught on CPU."""
+    if cfg.hbm_budget_mb is None:
+        return []
+    budget = int(cfg.hbm_budget_mb * 1024 * 1024)
+    if peak_bytes <= budget:
+        return []
+    top = "; ".join(f"{k}={v} B" for k, v in contributors[:3])
+    return [Finding(
+        rule=RULE_HBM_BUDGET, severity="error",
+        message=(f"static peak HBM estimate {peak_bytes} B exceeds the "
+                 f"{cfg.hbm_budget_mb} MiB budget ({budget} B) — top "
+                 f"live buffers: {top}"),
+        target=target_label,
+        fix_hint=("donate the consumed state args, stream params "
+                  "(zero stage 3 + max_live), remat activations, or "
+                  "raise analysis.hbm_budget_mb if the growth is "
+                  "intended"))]

@@ -1,0 +1,263 @@
+"""Overlap analysis — is each collective hidden under compute, or is it
+serialized on the critical path?
+
+T3 (arXiv:2401.16677) shows compute/collective overlap is a property of
+the program graph, not of the runtime: a collective whose first consumer
+follows immediately has nothing to hide behind, no matter how clever the
+scheduler, while a collective whose result is carried to the next scan
+iteration (the double-buffered prefetch shape, ROADMAP item 1) has the
+whole iteration's compute as slack.  Both facts are readable off the
+traced jaxpr, so the streamed-ZeRO-3 prefetch can be *verified
+statically* and gated in CI before it ever touches hardware.
+
+For every explicit collective (the same wire-moving surface the comm
+budget accounts) this module computes:
+
+  distance         equations between issue and first consume at the
+                   collective's nesting level (transparent shape-only
+                   ops extend the wire, they don't consume it)
+  slack_flops      flop-weighted independent work inside that window —
+                   everything between issue and first consume is
+                   provably independent of the collective's result
+  carried          the result escapes the enclosing body (scan carry /
+                   region output) instead of being consumed in-body:
+                   the double-buffer property, verified
+  hidden_fraction  min(1, slack_time / wire_time) under the configured
+                   hardware model — how much of the wire the scheduler
+                   CAN hide, which upper-bounds what it will
+
+A collective inside a scan/while body whose hidden fraction falls below
+``analysis.overlap_min_hidden_fraction`` is serialized on the hot loop's
+critical path — a warning finding (error with
+``analysis.require_overlap``, the CI posture once prefetch lands).
+Top-level collectives are recorded (they feed ``overlap_efficiency`` and
+the step-time model) but not flagged: the dispatch boundary serializes
+them anyway.
+"""
+
+from dataclasses import asdict, dataclass
+from typing import Any, Dict, List
+
+from .findings import Finding, RULE_OVERLAP
+from .jaxpr_walk import as_jaxpr, aval_bytes, eqn_scope, sub_jaxprs
+from .rules import _WIRE_GATHER_PRIMS, _WIRE_REDUCE_PRIMS
+
+_WIRE_PRIMS = _WIRE_GATHER_PRIMS + _WIRE_REDUCE_PRIMS
+
+# shape-only ops a collective result flows through unchanged — following
+# the dtype-hazard rule's provenance convention, plus the convert a
+# quantized gather's dequant epilogue emits and the `name` tag
+# checkpoint_name wraps the streamed gathers in
+_TRANSPARENT_PRIMS = ("reshape", "transpose", "broadcast_in_dim",
+                      "squeeze", "rev", "slice", "copy",
+                      "convert_element_type", "name")
+
+
+@dataclass
+class CollectiveOverlap:
+    """One collective equation's schedule facts."""
+    prim: str
+    target: str             # traced program ("grad_step", ...)
+    scope: str              # name-stack provenance
+    loop_depth: int         # enclosing scan/while bodies (0 = top level)
+    mult: int               # static trip-count multiplier
+    wire_bytes: int         # one issue's wire (gather: out, reduce: in)
+    distance_eqns: int      # eqns between issue and first consume
+    slack_flops: int        # independent flops inside the window
+    carried: bool           # escapes the body (double-buffered prefetch)
+    wire_time_s: float
+    hidden_fraction: float  # min(1, slack_time / wire_time)
+    serialized: bool        # on the critical path (per configured floor)
+
+
+def _eqn_wire_bytes(eqn) -> int:
+    name = eqn.primitive.name
+    if name in _WIRE_GATHER_PRIMS:
+        return sum(aval_bytes(v) for v in eqn.outvars)
+    return sum(aval_bytes(v) for v in eqn.invars)
+
+
+class _Chase:
+    """One collective result being chased toward its first consumer —
+    possibly across call-kind sub-jaxpr boundaries (a custom_vjp gather's
+    own jaxpr ends AT the gather; consumption happens in the caller)."""
+
+    __slots__ = ("rec", "tracked")
+
+    def __init__(self, rec: CollectiveOverlap, tracked: set):
+        self.rec = rec
+        self.tracked = tracked
+
+
+def _finalize(rec: CollectiveOverlap, cfg, carried: bool) -> None:
+    peak_flops_s = cfg.hw_peak_tflops * 1e12
+    wire_time = (rec.wire_bytes / (cfg.hw_ici_gbps * 1e9)
+                 if cfg.hw_ici_gbps > 0 else 0.0)
+    slack_time = (rec.slack_flops / peak_flops_s
+                  if peak_flops_s > 0 else 0.0)
+    rec.carried = carried
+    rec.wire_time_s = wire_time
+    rec.hidden_fraction = (1.0 if wire_time <= 0.0
+                           else min(1.0, slack_time / wire_time))
+    # a carried result is consumed next iteration, under this
+    # iteration's remaining compute — the double-buffer property
+    rec.serialized = ((not carried) and
+                      rec.hidden_fraction < cfg.overlap_min_hidden_fraction)
+
+
+def _analyze(jaxpr, cfg, target_label, _scope, _mult, _loop_depth):
+    """Walk one jaxpr level.  Returns (records, escaped) where escaped
+    chases reached this jaxpr's outvars unconsumed, as
+    (chase, outvar_positions) pairs for the caller to continue."""
+    from ..profiling.flops_profiler import eqn_flops
+    jx = as_jaxpr(jaxpr)
+    records: List[CollectiveOverlap] = []
+    eqns = list(jx.eqns)
+    active: List[_Chase] = []
+
+    for i, eqn in enumerate(eqns):
+        scope = eqn_scope(eqn, _scope)
+        started_here: List[_Chase] = []
+        for sub in sub_jaxprs(eqn):
+            is_loop = sub.kind in ("scan", "while_body", "while_cond")
+            sub_records, sub_escaped = _analyze(
+                sub.jaxpr, cfg, target_label, scope,
+                _mult * (sub.trip_count or 1),
+                _loop_depth + (1 if is_loop else 0))
+            records.extend(sub_records)
+            outs = list(eqn.outvars)
+            sub_outs = list(as_jaxpr(sub.jaxpr).outvars)
+            for chase, positions in sub_escaped:
+                if is_loop:
+                    # escaping a scan/while body = the result rides the
+                    # carry into the next iteration: double-buffered
+                    _finalize(chase.rec, cfg, carried=True)
+                elif len(outs) == len(sub_outs):
+                    # call-kind boundary (pjit/remat/custom_vjp/
+                    # shard_map/branch): 1:1 outvar mapping — keep
+                    # chasing in this frame from the call site on
+                    chase.tracked = {id(outs[p]) for p in positions
+                                     if p < len(outs)}
+                    started_here.append(chase)
+                else:
+                    # unknown outvar mapping: classify with the slack
+                    # accumulated so far
+                    _finalize(chase.rec, cfg, carried=False)
+        # consumption checks against everything issued BEFORE this eqn
+        still_active: List[_Chase] = []
+        flops = None  # computed once per eqn, shared across chases
+        for chase in active:
+            touches = any(id(v) in chase.tracked for v in eqn.invars)
+            if touches and eqn.primitive.name in _TRANSPARENT_PRIMS:
+                chase.tracked.update(id(v) for v in eqn.outvars)
+                still_active.append(chase)
+            elif touches:
+                _finalize(chase.rec, cfg, carried=False)
+            else:
+                # per-issue slack: eqn_flops already trip-weights its
+                # own inner scans, which repeat per issue — the
+                # enclosing mult does not (it repeats the ISSUE too)
+                if flops is None:
+                    flops = eqn_flops(eqn)
+                chase.rec.distance_eqns += 1
+                chase.rec.slack_flops += flops
+                still_active.append(chase)
+        active = still_active + started_here
+        if eqn.primitive.name in _WIRE_PRIMS:
+            rec = CollectiveOverlap(
+                prim=eqn.primitive.name, target=target_label,
+                scope=scope, loop_depth=_loop_depth, mult=_mult,
+                wire_bytes=_eqn_wire_bytes(eqn), distance_eqns=0,
+                slack_flops=0, carried=False, wire_time_s=0.0,
+                hidden_fraction=0.0, serialized=False)
+            records.append(rec)
+            active.append(_Chase(rec, {id(v) for v in eqn.outvars}))
+
+    outvar_pos = {}
+    for p, v in enumerate(jx.outvars):
+        outvar_pos.setdefault(id(v), []).append(p)
+    escaped = []
+    for chase in active:
+        positions = [p for vid in chase.tracked
+                     for p in outvar_pos.get(vid, [])]
+        if positions:
+            escaped.append((chase, positions))
+        else:
+            # result is dead at this level (dce leftovers): classify
+            # with the slack accumulated
+            _finalize(chase.rec, cfg, carried=False)
+    return records, escaped
+
+
+def analyze_overlap(jaxpr, cfg, target_label: str = ""
+                    ) -> List[CollectiveOverlap]:
+    """Walk a traced program and classify every wire-moving collective."""
+    records, escaped = _analyze(jaxpr, cfg, target_label, "", 1, 0)
+    for chase, _positions in escaped:
+        # reached the program outputs: the dispatch boundary is the
+        # consumer; everything after issue was slack
+        _finalize(chase.rec, cfg, carried=False)
+    return records
+
+
+def overlap_efficiency(records: List[CollectiveOverlap]) -> float:
+    """Bytes-weighted hidden fraction across every collective issue
+    (trip counts multiplied in).  1.0 when no explicit collectives —
+    there is nothing to serialize."""
+    total = sum(r.wire_bytes * r.mult for r in records)
+    if total <= 0:
+        return 1.0
+    hidden = sum(r.wire_bytes * r.mult * r.hidden_fraction
+                 for r in records)
+    return hidden / total
+
+
+def summarize_overlap(records: List[CollectiveOverlap]) -> Dict[str, Any]:
+    """Report payload: aggregate counts + the per-collective records."""
+    return {
+        "n_collectives": len(records),
+        "n_serialized_hot_loop": sum(
+            1 for r in records if r.serialized and r.loop_depth > 0),
+        "n_serialized_top_level": sum(
+            1 for r in records if r.serialized and r.loop_depth == 0),
+        "n_carried": sum(1 for r in records if r.carried),
+        "records": [asdict(r) for r in records],
+    }
+
+
+def overlap_rule_findings(records: List[CollectiveOverlap], cfg,
+                          scan_info: Dict[str, Any] = None
+                          ) -> List[Finding]:
+    """One finding per serialized collective inside a hot-loop body.
+
+    The streamed-ZeRO-3 layer scan currently gathers layer i's weights
+    on the critical path (the gather's first consumer is layer i's own
+    matmul) — exactly what ROADMAP item 1's double-buffered prefetch
+    fixes, and what this rule gates in CI once ``require_overlap`` is
+    set."""
+    out: List[Finding] = []
+    severity = "error" if cfg.require_overlap else "warning"
+    plan = (scan_info or {}).get("zero3_streaming")
+    for r in records:
+        if not (r.serialized and r.loop_depth > 0):
+            continue
+        plan_note = ""
+        if plan is not None and r.prim in _WIRE_GATHER_PRIMS:
+            plan_note = (f" (streamed ZeRO-3 plan: groups of "
+                         f"{plan['layers_per_step']}, "
+                         f"prefetch={plan['prefetch']})")
+        out.append(Finding(
+            rule=RULE_OVERLAP, severity=severity,
+            message=(f"collective `{r.prim}` ({r.wire_bytes} B x{r.mult}) "
+                     "is serialized on a hot-loop critical path: first "
+                     f"consumer is {r.distance_eqns} eqn(s) away with "
+                     f"{r.slack_flops} independent flops — only "
+                     f"{r.hidden_fraction * 100:.0f}% of its "
+                     f"{r.wire_time_s * 1e6:.1f} us wire time can hide"
+                     + plan_note),
+            target=r.target, scope=r.scope,
+            fix_hint=("issue the gather for iteration i+1 under "
+                      "iteration i's compute (double-buffered carry "
+                      "prefetch, ROADMAP item 1), or shrink the wire "
+                      "(qwZ/hpZ) until the slack covers it")))
+    return out

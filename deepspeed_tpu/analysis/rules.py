@@ -35,6 +35,16 @@ class AuditTarget:
     label: str                      # "grad_step" | "apply_step" | ...
     closed_jaxpr: Any
     args: List[ArgInfo] = field(default_factory=list)
+    # per-flattened-invar donation flags + labels (the liveness
+    # estimator's aliasing facts); None = conservative all-False
+    donated_invars: Optional[List[bool]] = None
+    invar_labels: Optional[List[str]] = None
+    # engine state resident during this program but not among its args
+    # (the modular grad program runs while opt_state sits in HBM)
+    resident_extra_bytes: int = 0
+    # scan-structure provenance the engine records at build time (gas
+    # scan length, streamed-ZeRO-3 plan) — named in overlap findings
+    scan_info: dict = field(default_factory=dict)
 
 
 # --------------------------------------------------------------------- #

@@ -458,6 +458,13 @@ class AnalysisConfig:
     dtype_min_elements: int = C.ANALYSIS_DTYPE_MIN_ELEMENTS_DEFAULT
     expected_signature: Optional[str] = (
         C.ANALYSIS_EXPECTED_SIGNATURE_DEFAULT)
+    hbm_budget_mb: Optional[float] = C.ANALYSIS_HBM_BUDGET_MB_DEFAULT
+    require_overlap: bool = C.ANALYSIS_REQUIRE_OVERLAP_DEFAULT
+    overlap_min_hidden_fraction: float = (
+        C.ANALYSIS_OVERLAP_MIN_HIDDEN_DEFAULT)
+    hw_peak_tflops: float = C.ANALYSIS_HW_PEAK_TFLOPS_DEFAULT
+    hw_hbm_gbps: float = C.ANALYSIS_HW_HBM_GBPS_DEFAULT
+    hw_ici_gbps: float = C.ANALYSIS_HW_ICI_GBPS_DEFAULT
 
     @property
     def enabled(self) -> bool:
@@ -468,6 +475,8 @@ class AnalysisConfig:
         d = d or {}
         budget = get_scalar_param(d, C.ANALYSIS_COMM_BUDGET_MB,
                                   C.ANALYSIS_COMM_BUDGET_MB_DEFAULT)
+        hbm_budget = get_scalar_param(d, C.ANALYSIS_HBM_BUDGET_MB,
+                                      C.ANALYSIS_HBM_BUDGET_MB_DEFAULT)
         cfg = AnalysisConfig(
             mode=get_scalar_param(d, C.ANALYSIS_MODE,
                                   C.ANALYSIS_MODE_DEFAULT),
@@ -484,6 +493,22 @@ class AnalysisConfig:
             expected_signature=get_scalar_param(
                 d, C.ANALYSIS_EXPECTED_SIGNATURE,
                 C.ANALYSIS_EXPECTED_SIGNATURE_DEFAULT),
+            hbm_budget_mb=None if hbm_budget is None else float(hbm_budget),
+            require_overlap=bool(get_scalar_param(
+                d, C.ANALYSIS_REQUIRE_OVERLAP,
+                C.ANALYSIS_REQUIRE_OVERLAP_DEFAULT)),
+            overlap_min_hidden_fraction=float(get_scalar_param(
+                d, C.ANALYSIS_OVERLAP_MIN_HIDDEN,
+                C.ANALYSIS_OVERLAP_MIN_HIDDEN_DEFAULT)),
+            hw_peak_tflops=float(get_scalar_param(
+                d, C.ANALYSIS_HW_PEAK_TFLOPS,
+                C.ANALYSIS_HW_PEAK_TFLOPS_DEFAULT)),
+            hw_hbm_gbps=float(get_scalar_param(
+                d, C.ANALYSIS_HW_HBM_GBPS,
+                C.ANALYSIS_HW_HBM_GBPS_DEFAULT)),
+            hw_ici_gbps=float(get_scalar_param(
+                d, C.ANALYSIS_HW_ICI_GBPS,
+                C.ANALYSIS_HW_ICI_GBPS_DEFAULT)),
         )
         if cfg.mode not in C.ANALYSIS_MODES:
             raise DeepSpeedConfigError(
@@ -497,6 +522,20 @@ class AnalysisConfig:
             raise DeepSpeedConfigError(
                 f"analysis.max_retraces must be >= 1, got "
                 f"{cfg.max_retraces}")
+        if cfg.hbm_budget_mb is not None and cfg.hbm_budget_mb < 0:
+            raise DeepSpeedConfigError(
+                "analysis.hbm_budget_mb must be >= 0, got "
+                f"{cfg.hbm_budget_mb}")
+        if not 0.0 < cfg.overlap_min_hidden_fraction <= 1.0:
+            raise DeepSpeedConfigError(
+                "analysis.overlap_min_hidden_fraction must be in (0, 1], "
+                f"got {cfg.overlap_min_hidden_fraction}")
+        for knob, val in (("hw_peak_tflops", cfg.hw_peak_tflops),
+                          ("hw_hbm_gbps", cfg.hw_hbm_gbps),
+                          ("hw_ici_gbps", cfg.hw_ici_gbps)):
+            if val <= 0:
+                raise DeepSpeedConfigError(
+                    f"analysis.{knob} must be > 0, got {val}")
         return cfg
 
 

@@ -179,6 +179,28 @@ ANALYSIS_DTYPE_MIN_ELEMENTS_DEFAULT = 65536
 # error-severity finding
 ANALYSIS_EXPECTED_SIGNATURE = "expected_signature"
 ANALYSIS_EXPECTED_SIGNATURE_DEFAULT = None
+# Schedule Auditor (overlap / liveness / step-time; docs/program_auditor.md)
+#
+# static peak-HBM budget in MiB (donation-aware liveness estimate);
+# None = report only, no lint
+ANALYSIS_HBM_BUDGET_MB = "hbm_budget_mb"
+ANALYSIS_HBM_BUDGET_MB_DEFAULT = None
+# escalate serialized-collective-in-hot-loop overlap findings from
+# warning to error (the CI gate for the double-buffered prefetch work)
+ANALYSIS_REQUIRE_OVERLAP = "require_overlap"
+ANALYSIS_REQUIRE_OVERLAP_DEFAULT = False
+# a collective counts as overlapped when the flop-weighted slack between
+# issue and first consume hides at least this fraction of its wire time
+ANALYSIS_OVERLAP_MIN_HIDDEN = "overlap_min_hidden_fraction"
+ANALYSIS_OVERLAP_MIN_HIDDEN_DEFAULT = 0.5
+# hardware model for the static step-time lower bound (defaults: one
+# TPU v5e chip — bf16 peak, HBM bandwidth, per-chip ICI bandwidth)
+ANALYSIS_HW_PEAK_TFLOPS = "hw_peak_tflops"
+ANALYSIS_HW_PEAK_TFLOPS_DEFAULT = 197.0
+ANALYSIS_HW_HBM_GBPS = "hw_hbm_gbps"
+ANALYSIS_HW_HBM_GBPS_DEFAULT = 819.0
+ANALYSIS_HW_ICI_GBPS = "hw_ici_gbps"
+ANALYSIS_HW_ICI_GBPS_DEFAULT = 90.0
 
 #############################################
 # Tensorboard

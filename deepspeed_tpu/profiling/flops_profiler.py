@@ -138,6 +138,33 @@ def count_jaxpr_flops(jaxpr, breakdown: Optional[Dict[str, int]] = None,
     return total
 
 
+def eqn_flops(eqn) -> int:
+    """Static flops of ONE equation, sub-jaxprs included: matmuls/convs
+    exactly, scan bodies trip-weighted, cond at its most expensive
+    branch, elementwise as one flop per output element.  This is the
+    unit the Schedule Auditor's overlap analysis weighs slack windows
+    with (analysis/overlap.py) and the step-time model sums
+    (analysis/cost_model.py)."""
+    name = eqn.primitive.name
+    if name == "dot_general":
+        return _dot_flops(eqn)
+    if name == "conv_general_dilated":
+        return _conv_flops(eqn)
+    subs = sub_jaxprs(eqn)
+    if subs:
+        if name == "cond":
+            return max((count_jaxpr_flops(s.jaxpr) for s in subs),
+                       default=0)
+        return sum(count_jaxpr_flops(s.jaxpr) * (s.trip_count or 1)
+                   for s in subs)
+    total = 0
+    for ov in eqn.outvars:
+        aval = getattr(ov, "aval", None)
+        if aval is not None and hasattr(aval, "shape"):
+            total += int(np.prod(aval.shape, initial=1))
+    return total
+
+
 def get_model_profile(fn: Callable, args: Tuple = (), kwargs=None,
                       params: Any = None, as_string: bool = False):
     """(flops, macs, params) of one call of `fn` (reference

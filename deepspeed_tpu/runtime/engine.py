@@ -415,12 +415,17 @@ class DeepSpeedEngine:
         # severity findings; "warn" logs them.
         self.program_audit = None
         self._recompile_guard = None
+        # static step-time lower bound (analysis/cost_model.py) — bench
+        # rows and monitors read this for predicted-vs-measured rows
+        self.predicted_step_time_lb_s = None
         self.analysis = self.config.analysis_config
         if self.analysis.enabled:
             from ..analysis import RecompileGuard, audit_engine, enforce
             self._recompile_guard = RecompileGuard(
                 self.analysis.max_retraces)
             self.program_audit = audit_engine(self)
+            self.predicted_step_time_lb_s = (
+                self.program_audit.predicted_step_time_lb_s)
             log_dist(self.program_audit.summary_line(), ranks=[0])
             enforce(self.program_audit, self.analysis.mode, logger)
 

@@ -217,12 +217,13 @@ def build_fused_step(engine):
     replicated = engine.mesh_ctx.replicated()
     sent_shardings = jax.tree.map(lambda _: replicated,
                                   engine._fused_sent_state)
-    # The un-jitted body and the donation facts are recorded on the
-    # engine for the Program Auditor (analysis/auditor.py), which traces
-    # this exact program abstractly and audits donation against what is
-    # actually dispatched.
+    # The un-jitted body, the donation facts, and the scan structure are
+    # recorded on the engine for the Program Auditor (analysis/
+    # auditor.py), which traces this exact program abstractly and audits
+    # donation + schedule against what is actually dispatched.
     engine._fused_step_raw = fused_step
     engine._fused_donate_argnums = (0, 1)
+    engine._fused_scan_info = {"gas_scan_length": gas}
     return jax.jit(
         fused_step,
         out_shardings=(engine.param_shardings, engine.opt_shardings,

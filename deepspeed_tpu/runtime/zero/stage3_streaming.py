@@ -226,6 +226,10 @@ class Zero3StreamContext:
                     getattr(low_bandwidth, "enabled", False) else None)
         self.param_manual = self.manual
         self.param_axis_sizes = dict(self.axis_sizes)
+        # last StreamPlan actually applied by scan() — set during
+        # tracing, so the Schedule Auditor (analysis/auditor.py) can
+        # name the streamed scan's structure in overlap findings
+        self.last_plan: Optional[StreamPlan] = None
         if self.lbc is not None and self.lbc.hpz_group_size > 1:
             hpz = resolve_hpz_axes(self.axis_sizes,
                                    self.lbc.hpz_group_size)
@@ -364,6 +368,7 @@ class Zero3StreamContext:
             return carry
 
         plan = self.plan_for(stacked_params)
+        self.last_plan = plan
         if not self._plan_logged:
             lb = ""
             if self.lbc is not None:

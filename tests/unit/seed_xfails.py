@@ -1,0 +1,20 @@
+"""Shared markers for the triaged pre-existing seed failures
+(ledger: docs/COVERAGE.md "Known failures").
+
+One definition so that when the underlying fix lands, deleting the
+marker here surfaces every silently-skipped test at once — a stale
+per-file copy would keep its tests skipped after the bug is gone.
+"""
+
+import pytest
+
+# The gated 1F1B executor's stage-index lowering emits a PartitionId
+# instruction that XLA-CPU's SPMD partitioner rejects (UNIMPLEMENTED:
+# "PartitionId instruction is not supported for SPMD partitioning").
+# Deterministic compile-time error on this backend, so run=False; the
+# real fix (stage ids as a sharded operand, or full-manual meshes) is a
+# pipeline-executor PR of its own.
+PARTITION_ID_XFAIL = pytest.mark.xfail(
+    reason="XLA-CPU SPMD partitioner rejects the gated 1F1B executor's "
+           "PartitionId lowering (pre-existing seed failure, "
+           "docs/COVERAGE.md)", run=False)

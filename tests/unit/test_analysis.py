@@ -28,13 +28,18 @@ import deepspeed_tpu as ds
 from deepspeed_tpu.analysis import (
     ArgInfo, AuditTarget, ProgramAuditor, ProgramAuditError,
     RecompileGuard, RULE_COMM_BUDGET, RULE_DONATION, RULE_DTYPE_HAZARD,
-    RULE_HOST_SYNC, RULE_LOCKSTEP, RULE_RECOMPILE,
-    compare_lockstep, iter_eqns, lockstep_signature, sub_jaxprs)
+    RULE_HBM_BUDGET, RULE_HOST_SYNC, RULE_LOCKSTEP, RULE_OVERLAP,
+    RULE_RECOMPILE, analyze_overlap, compare_lockstep, estimate_liveness,
+    iter_eqns, lockstep_signature, overlap_efficiency, sub_jaxprs)
 from deepspeed_tpu.config import AnalysisConfig, DeepSpeedConfigError
 
 REPO = Path(__file__).resolve().parents[2]
 GOLDEN = REPO / "tests" / "unit" / "golden" / "gpt2_lockstep_signature.json"
+GOLDEN_STREAM = (REPO / "tests" / "unit" / "golden" /
+                 "gpt2_zero3_stream_schedule.json")
 EXAMPLE_CFG = REPO / "docs" / "examples" / "gpt2_analysis.json"
+EXAMPLE_STREAM_CFG = (REPO / "docs" / "examples" /
+                      "gpt2_zero3_stream_analysis.json")
 
 
 def _cfg(**kw) -> AnalysisConfig:
@@ -388,6 +393,168 @@ def test_recompile_guard_retrace_storm():
 
 
 # --------------------------------------------------------------------- #
+# schedule rules (ISSUE 6): overlap + HBM liveness fixtures
+# --------------------------------------------------------------------- #
+def _serialized_gather_scan_jaxpr(mesh):
+    """A layer scan that gathers each layer's weights ON the critical
+    path (first consumer is the very next matmul) — the shape of the
+    current streamed-ZeRO-3 schedule."""
+    def region(x, w):
+        def body(c, wi):
+            full = lax.all_gather(wi, "data", axis=0, tiled=True)
+            return c @ full, None
+        c, _ = lax.scan(body, x, w)
+        return c
+
+    return jax.make_jaxpr(jax.shard_map(
+        region, mesh=mesh.mesh, in_specs=(P(), P(None, "data")),
+        out_specs=P(), check_vma=False))(
+        jnp.ones((16, 64)), jnp.ones((4, 64, 64)))
+
+
+def test_overlap_serialized_gather_in_scan_flagged():
+    mesh = ds.initialize_mesh(data=-1)
+    jx = _serialized_gather_scan_jaxpr(mesh)
+    target = AuditTarget("grad_step", jx)
+    hits = [f for f in _findings(target) if f.rule == RULE_OVERLAP]
+    assert len(hits) == 1
+    assert hits[0].severity == "warning"  # error once require_overlap
+    assert "all_gather" in hits[0].message
+    assert "critical path" in hits[0].message
+    assert hits[0].target == "grad_step"
+    # analysis.require_overlap escalates to error (the prefetch CI gate)
+    hits_err = [f for f in _findings(target, _cfg(require_overlap=True))
+                if f.rule == RULE_OVERLAP]
+    assert hits_err and hits_err[0].severity == "error"
+    # the record carries the schedule facts
+    recs = analyze_overlap(jx, _cfg(), "grad_step")
+    gathers = [r for r in recs if r.prim == "all_gather"]
+    assert len(gathers) == 1
+    r = gathers[0]
+    assert r.serialized and not r.carried
+    assert r.loop_depth == 1 and r.mult == 4  # inside the 4-layer scan
+    assert r.distance_eqns == 0 and r.slack_flops == 0
+    report = ProgramAuditor(_cfg()).run([target])
+    assert report.overlap_efficiency < 0.5
+    ds.reset_mesh_context()
+
+
+def test_overlap_carried_gather_verifies_double_buffer():
+    """The double-buffered prefetch shape (ROADMAP item 1): layer i+1's
+    gather is issued into the scan carry under layer i's compute — the
+    overlap rule must verify it statically and stay silent."""
+    mesh = ds.initialize_mesh(data=-1)
+
+    def region(x, w):
+        def body(carry, wi):
+            c, pref = carry
+            nxt = lax.all_gather(wi, "data", axis=0, tiled=True)
+            return (c @ pref, nxt), None
+        first = lax.all_gather(w[0], "data", axis=0, tiled=True)
+        (c, _), _ = lax.scan(body, (x, first), w)
+        return c
+
+    jx = jax.make_jaxpr(jax.shard_map(
+        region, mesh=mesh.mesh, in_specs=(P(), P(None, "data")),
+        out_specs=P(), check_vma=False))(
+        jnp.ones((16, 64)), jnp.ones((4, 64, 64)))
+    assert not [f for f in _findings(AuditTarget("grad_step", jx))
+                if f.rule == RULE_OVERLAP]
+    recs = analyze_overlap(jx, _cfg(), "grad_step")
+    in_loop = [r for r in recs if r.prim == "all_gather"
+               and r.loop_depth == 1]
+    assert in_loop and all(r.carried and not r.serialized
+                           for r in in_loop)
+    ds.reset_mesh_context()
+
+
+def test_overlap_top_level_collective_not_flagged():
+    """A one-shot top-level gather is serialized by the dispatch anyway
+    — recorded (it feeds overlap_efficiency and the step-time model) but
+    never a finding."""
+    mesh = ds.initialize_mesh(data=-1)
+
+    def region(x):
+        return lax.all_gather(x, "data", axis=0, tiled=True).sum()
+
+    jx = jax.make_jaxpr(jax.shard_map(
+        region, mesh=mesh.mesh, in_specs=P("data"), out_specs=P(),
+        check_vma=False))(jnp.ones((8, 64), jnp.float32))
+    assert not [f for f in _findings(AuditTarget("grad_step", jx))
+                if f.rule == RULE_OVERLAP]
+    recs = analyze_overlap(jx, _cfg(), "grad_step")
+    assert len(recs) == 1 and recs[0].loop_depth == 0
+    assert overlap_efficiency([]) == 1.0
+    ds.reset_mesh_context()
+
+
+def test_hbm_budget_undonated_blowup_over_budget():
+    """An undonated param/grad update doubles its HBM; the liveness
+    estimator sees it and the hbm_budget rule names the contributors."""
+    def f(p, g):
+        return jax.tree.map(lambda a, b: a - 0.1 * b, p, g)
+
+    p = {"w": jnp.ones((512, 512))}  # 1 MiB
+    jx = jax.make_jaxpr(f)(p, p)
+    undonated = estimate_liveness(jx, [False, False],
+                                  ["params[0]", "grads[0]"])
+    donated = estimate_liveness(jx, [True, True],
+                                ["params[0]", "grads[0]"])
+    mb = 1024 * 1024
+    assert undonated.peak_bytes == 3 * mb  # params + grads + new params
+    assert donated.peak_bytes == 2 * mb    # output aliases a dying input
+    assert any("params[0]" in k for k, _ in undonated.contributors)
+
+    target = AuditTarget("apply_step", jx,
+                         donated_invars=[False, False],
+                         invar_labels=["params[0]", "grads[0]"])
+    hits = [f for f in _findings(target, _cfg(hbm_budget_mb=2.5))
+            if f.rule == RULE_HBM_BUDGET]
+    assert len(hits) == 1 and hits[0].severity == "error"
+    assert "params[0]" in hits[0].message
+    # a budget that fits stays silent; None disables the lint
+    assert not [f for f in _findings(target, _cfg(hbm_budget_mb=4.0))
+                if f.rule == RULE_HBM_BUDGET]
+    assert not [f for f in _findings(target, _cfg())
+                if f.rule == RULE_HBM_BUDGET]
+
+
+def test_liveness_counts_scan_body_internals():
+    """The streamed gather materializes the full layer INSIDE the scan
+    body — the estimator must count the body's transient peak, not just
+    the top-level live set."""
+    def f(xs):
+        def body(c, x):
+            big = jnp.tile(x, (64, 1))        # transient [64, 256]
+            return c + big.sum(), None
+        return lax.scan(body, 0.0, xs)[0]
+
+    jx = jax.make_jaxpr(f)(jnp.ones((4, 256), jnp.float32))
+    rep = estimate_liveness(jx)
+    assert rep.peak_bytes >= 64 * 256 * 4  # the body transient counts
+
+
+def test_step_time_model_fields_and_bound():
+    mesh = ds.initialize_mesh(data=-1)
+    jx = _serialized_gather_scan_jaxpr(mesh)
+    report = ProgramAuditor(_cfg()).run([AuditTarget("grad_step", jx)])
+    st = report.step_time
+    assert st["predicted_step_time_lb_s"] > 0
+    assert st["bound"] in ("compute", "memory", "hidden_comm")
+    assert st["flops_per_step"] > 0 and st["io_bytes_per_step"] > 0
+    # serialized wire is exposed: the lower bound must include it
+    assert st["wire_bytes_exposed"] > 0
+    assert (st["predicted_step_time_lb_s"]
+            >= st["t_comm_exposed_s"] > 0)
+    # gas weighting: the modular grad program dispatches gas times
+    report4 = ProgramAuditor(_cfg()).run(
+        [AuditTarget("grad_step", jx)], gas=4)
+    assert (report4.step_time["flops_per_step"]
+            == 4 * st["flops_per_step"])
+    ds.reset_mesh_context()
+
+
+# --------------------------------------------------------------------- #
 # clean programs: gpt2 modular + fused train steps audit to zero
 # --------------------------------------------------------------------- #
 def _tiny_engine(extra_config=None, fused=False, bf16=False, gas=1):
@@ -433,18 +600,76 @@ def test_clean_gpt2_fused_step_zero_findings():
     assert report.targets == ["fused_step"]
 
 
-def test_zero3_streaming_audits_clean_with_collectives():
+def test_zero3_streaming_gather_on_critical_path_pinned():
     """The streamed stage-3 program has REAL explicit collectives; the
-    audit must see them (trip-weighted wire > 0) and still find nothing
-    wrong."""
+    audit must see them (trip-weighted wire > 0), and the overlap rule
+    must flag the current gather-on-critical-path schedule — the pinned
+    CI gate ROADMAP item 1's double-buffered prefetch will flip (and
+    re-pin to zero findings)."""
     engine = _tiny_engine(extra_config={"zero_optimization": {
         "stage": 3, "stage3_param_persistence_threshold": 0,
         "stage3_max_live_parameters": 1,
         "stage3_prefetch_bucket_size": 0}})
     report = engine.program_audit
-    assert report.findings == [], [f.format() for f in report.findings]
     assert report.wire_bytes_per_step > 0
     assert any("all_gather" in s for s in report.collective_sequence)
+    # every finding is the overlap rule (the other five rules stay
+    # clean) and at least one names a hot-loop serialized gather with
+    # the streamed plan's provenance
+    assert report.findings, "streamed gathers should be flagged"
+    assert all(f.rule == RULE_OVERLAP and f.severity == "warning"
+               for f in report.findings), [
+        f.format() for f in report.findings]
+    gather_hits = [f for f in report.findings
+                   if "all_gather" in f.message]
+    assert gather_hits
+    assert any("streamed ZeRO-3 plan" in f.message for f in gather_hits)
+    assert report.overlap["n_serialized_hot_loop"] > 0
+    assert report.overlap_efficiency < 1.0
+
+
+def test_peak_hbm_default_gpt2_within_sanity_band():
+    """The donation-aware static peak for the default gpt2 config must
+    sit in a sane band: at least the resident state (params + Adam
+    moments live through the grad program), at most a small multiple of
+    state + activations (the estimator is pre-fusion, so it may
+    overcount transients — but never by orders of magnitude)."""
+    import jax as _jax
+    engine = _tiny_engine()
+    report = engine.program_audit
+    param_bytes = sum(
+        int(np.prod(l.shape)) * l.dtype.itemsize
+        for l in _jax.tree.leaves(engine.params))
+    state_bytes = param_bytes + sum(
+        int(np.prod(l.shape)) * l.dtype.itemsize
+        for l in _jax.tree.leaves(engine.opt_state)
+        if hasattr(l, "shape"))
+    assert report.peak_hbm_bytes >= state_bytes
+    assert report.peak_hbm_bytes <= 50 * state_bytes, (
+        report.peak_hbm_bytes, state_bytes,
+        report.peak_hbm_contributors)
+    assert report.peak_hbm_contributors
+    # engine exposes the static step-time bound for bench/monitors
+    assert engine.predicted_step_time_lb_s == (
+        report.step_time["predicted_step_time_lb_s"])
+    assert engine.predicted_step_time_lb_s > 0
+
+
+def test_bench_rows_embed_schedule_provenance():
+    """Flagship bench rows must carry overlap_efficiency,
+    peak_hbm_bytes, and predicted_step_time_lb next to the lockstep
+    signature and wire bytes (acceptance criterion, ISSUE 6)."""
+    sys.path.insert(0, str(REPO))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    engine = _tiny_engine()
+    fields = bench._program_audit_fields(engine)
+    assert "lockstep_signature" in fields
+    assert fields["overlap_efficiency"] == 1.0  # no explicit collectives
+    assert fields["peak_hbm_bytes"] > 0
+    assert fields["predicted_step_time_lb"] > 0
 
 
 def test_engine_error_mode_raises_on_retrace_storm():
@@ -550,3 +775,104 @@ def test_cli_error_mode_exits_nonzero_on_error_findings(tmp_path):
     assert out.returncode == 1, out.stdout + out.stderr
     assert "lockstep" in out.stdout
     assert "FAILED" in out.stderr
+
+
+def test_cli_error_mode_hbm_budget_exits_nonzero(tmp_path, capsys):
+    """Acceptance criterion (ISSUE 6): an over-budget
+    analysis.hbm_budget_mb run exits nonzero via the CLI in error
+    mode, naming the live buffers.  Runs cli.main in-process — its
+    return value IS the process exit code (__main__ sys.exits it); the
+    true subprocess path is pinned by the neighboring CLI tests."""
+    from deepspeed_tpu.analysis.cli import main as cli_main
+    bad = dict(json.loads(EXAMPLE_CFG.read_text()))
+    bad["analysis"] = {"mode": "error", "hbm_budget_mb": 0.001}
+    cfg_path = tmp_path / "hbm.json"
+    cfg_path.write_text(json.dumps(bad))
+    ds.reset_mesh_context()
+    rc = cli_main(["--config", str(cfg_path)])
+    out = capsys.readouterr()
+    assert rc == 1, out.out + out.err
+    assert "hbm_budget" in out.out
+    assert "FAILED" in out.err
+
+
+# --------------------------------------------------------------------- #
+# CI gate (satellite, ISSUE 6): every docs/examples config must lint
+# clean under --mode error — a schedule regression (serialized gather
+# escalated via require_overlap, budget breach, signature drift) fails
+# the suite here before it can burn a pod.  The same gate runs as a
+# workflow step (.github/workflows/tier1.yml) via the real CLI.
+# --------------------------------------------------------------------- #
+def test_ci_gate_examples_error_mode(capsys):
+    from deepspeed_tpu.analysis.cli import main as cli_main
+    examples = sorted((REPO / "docs" / "examples").glob("*.json"))
+    assert EXAMPLE_CFG in examples and EXAMPLE_STREAM_CFG in examples
+    golden_stream = json.loads(GOLDEN_STREAM.read_text())
+    for cfg_path in examples:
+        ds.reset_mesh_context()
+        rc = cli_main(["--config", str(cfg_path), "--mode", "error",
+                       "--json"])
+        stdout = capsys.readouterr().out
+        assert rc == 0, (
+            f"{cfg_path.name} failed the error-mode analysis gate:\n"
+            + stdout)
+        payload = json.loads(stdout[stdout.index("{\n"):])
+        errors = [f for f in payload["findings"]
+                  if f["severity"] == "error"]
+        assert errors == [], f"{cfg_path.name}: {errors}"
+        if cfg_path == EXAMPLE_STREAM_CFG:
+            # the streamed config's schedule is pinned by its golden:
+            # signature, collective count, and the serialized-gather
+            # overlap verdict (regenerate with --update-golden)
+            assert payload["signature"] == golden_stream["signature"]
+            assert (len(payload["collective_sequence"])
+                    == golden_stream["collective_count"])
+            ov = golden_stream["overlap"]
+            assert (payload["overlap"]["n_serialized_hot_loop"]
+                    == ov["n_serialized_hot_loop"])
+            assert payload["overlap"]["n_serialized_hot_loop"] > 0
+            assert abs(payload["overlap_efficiency"]
+                       - ov["overlap_efficiency"]) < 0.1
+            # the gather-on-critical-path findings ride as warnings
+            # until require_overlap flips them to errors
+            assert any(f["rule"] == "overlap"
+                       and "all_gather" in f["message"]
+                       for f in payload["findings"])
+
+
+@pytest.mark.slow
+def test_cli_update_golden_regenerates_checked_in_files(tmp_path):
+    """--update-golden must reproduce the checked-in goldens exactly —
+    the files are CLI output, never hand-edited."""
+    env_dir = str(tmp_path / "golden")
+    for cfg_path, golden_path, extra in (
+            (EXAMPLE_CFG, GOLDEN, ()),
+            (EXAMPLE_STREAM_CFG, GOLDEN_STREAM, ("--devices", "8"))):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["DS_ANALYSIS_GOLDEN_DIR"] = env_dir
+        out = subprocess.run(
+            [sys.executable, "-m", "deepspeed_tpu.analysis",
+             "--config", str(cfg_path), "--update-golden", *extra],
+            cwd=str(REPO), capture_output=True, text=True, timeout=300,
+            env=env)
+        assert out.returncode == 0, out.stdout + out.stderr
+        regenerated = json.loads(
+            (Path(env_dir) / golden_path.name).read_text())
+        assert regenerated == json.loads(golden_path.read_text()), (
+            f"{golden_path.name} drifted from CLI output — regenerate "
+            "with --update-golden")
+
+
+def test_cli_update_golden_unknown_config_errors(tmp_path):
+    from deepspeed_tpu.analysis.cli import GOLDEN_MAP, _golden_payload
+    assert "gpt2_analysis.json" in GOLDEN_MAP
+    assert "gpt2_zero3_stream_analysis.json" in GOLDEN_MAP
+    # payload shape for the lockstep golden matches the checked-in file
+    from deepspeed_tpu.analysis import AuditReport
+    rep = AuditReport(signature="ab" * 32)
+    payload = _golden_payload("gpt2_lockstep_signature.json", rep)
+    assert set(payload) == {"_comment", "signature", "collective_count"}
+    payload2 = _golden_payload("gpt2_zero3_stream_schedule.json", rep)
+    assert set(payload2) == {"_comment", "signature", "collective_count",
+                             "overlap"}

@@ -254,7 +254,12 @@ def test_checkpoint_equivalence():
                                rtol=1e-6)
     g1 = jax.grad(loss_plain)(w)
     g2 = jax.grad(loss_ckpt)(w)
-    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-6)
+    # remat guarantees mathematical, not bitwise, equality: the
+    # recomputed forward fuses differently (fma/reassociation), so the
+    # backward drifts O(1e-5) relative on the CPU backend (seed ledger,
+    # docs/COVERAGE.md).  1e-4 still catches a wrong-residual bug, which
+    # shows up orders of magnitude larger.
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-4)
     assert ac.is_configured()
     ac.reset()
 
