@@ -19,10 +19,13 @@ backend init is retried with backoff; ANY failure still emits a single
 diagnostic JSON line instead of a bare traceback.
 
 Ladder: `python bench.py --config
-{gpt2|gpt2_gas4|gpt2_gas4_fused|bert_z2|bert_s512|decode|moe|gpt_moe|
+{gpt2|gpt2_gas4|gpt2_gas4_fused|gpt2_zero3_stream|
+gpt2_zero3_stream_carried|bert_z2|bert_s512|decode|moe|gpt_moe|
 longseq|sparse_longseq|offload|infinity}` selects other BASELINE.md anchor
 points; default is the flagship gpt2.  The gas4 pair A/Bs the fused
-whole-step program (1 dispatch/step) against the modular loop (2N).
+whole-step program (1 dispatch/step) against the modular loop (2N); the
+zero3_stream pair A/Bs the carried double-buffer prefetch against
+serialized at-use gathers (needs a >1-chip ZeRO world).
 DS_BENCH_ITERS overrides the timing iteration count (CI smoke).
 DS_BENCH_WALL_BUDGET caps total bench wall-clock (default 1500 s): the
 watchdog emits the (stale-marked) result JSON and exits 0 before a driver
@@ -470,6 +473,106 @@ def bench_gpt2_gas4():
 
 def bench_gpt2_gas4_fused():
     return _bench_gpt2_gas(fused=True)
+
+
+def _bench_gpt2_zero3_stream(carried, batch=8):
+    """Streamed-ZeRO-3 A/B (ISSUE 7): the carried double-buffer prefetch
+    (stage3_prefetch_mode=carried — layer i+1's gather issued into the
+    scan carry under layer i's compute, backward re-gather likewise)
+    against the serialized at-use gather baseline, same model/precision
+    and the SAME group size (2 layers/gather — max_live is set per mode
+    so both plans land on g=2; the carried row legitimately holds two
+    groups live, that IS the double buffer), so the measured delta
+    isolates the prefetch, not a gather-granularity change.  Every row
+    embeds overlap_efficiency / peak_hbm_bytes / predicted_step_time_lb
+    from the static Schedule Auditor, so the measured delta is
+    attributable against the model's prediction.  Requires a >1-device
+    ZeRO world — on a single chip the streamed region cannot engage and
+    the row fails loudly (the watchdog's stale-marking path) rather
+    than publishing a non-streamed number."""
+    import jax
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT2Config, GPT2Model
+
+    seq = 1024
+    mesh = ds.initialize_mesh(data=-1)
+    zero_world = mesh.data_parallel_world_size
+    if zero_world < 2:
+        raise RuntimeError(
+            "gpt2_zero3_stream needs a >1-device ZeRO world (explicit "
+            f"streaming is a no-op on {zero_world} device) — run on a "
+            "multichip host")
+    cfg = GPT2Config(n_positions=seq, bf16=True)
+    model = GPT2Model(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    per_layer = sum(int(np.prod(l.shape[1:]))
+                    for l in jax.tree.leaves(params["h"]))
+    config = {
+        "train_micro_batch_size_per_gpu": max(1, batch // zero_world),
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW",
+                      "params": {"lr": 6e-4, "weight_decay": 0.1}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {
+            "stage": 3,
+            "stage3_param_persistence_threshold": 0,
+            # both modes plan groups of 2 layers (carried halves its
+            # budget for the prefetched group: 4x/2 -> 2 layers; off
+            # takes 2x directly) so the A/B holds gather granularity
+            # fixed and varies only the schedule
+            "stage3_max_live_parameters": (4 * per_layer if carried
+                                           else 2 * per_layer),
+            "stage3_prefetch_bucket_size": (2 * per_layer if carried
+                                            else 0),
+            "stage3_prefetch_mode": "carried" if carried else "off",
+        },
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config,
+                                    model_parameters=params, mesh=mesh)
+    rng = np.random.RandomState(0)
+    global_batch = max(1, batch // zero_world) * zero_world
+    ids = rng.randint(0, cfg.vocab_size,
+                      size=(global_batch, seq)).astype(np.int32)
+
+    def step():
+        loss = engine.forward(ids)
+        engine.backward(loss)
+        engine.step()
+        return loss
+
+    dt, final_loss, n = _time_steps(step)
+    plan = engine._zero3_stream.last_plan
+    if plan is None or (carried and plan.mode != "carried"):
+        raise RuntimeError(
+            f"zero3_stream row fell back to plan={plan} — the streamed "
+            "region did not engage" +
+            (" the carried prefetch" if carried else ""))
+    tokens_per_sec = n * global_batch * seq / dt
+    tflops = tokens_per_sec * cfg.flops_per_token() / 1e12
+    peak = _peak_tflops()
+    kind = "carried" if carried else "serialized"
+    return {
+        "metric": f"gpt2_124m_zero3_stream_{kind}_train_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tflops / REFERENCE_TFLOPS, 3),
+        "tflops_per_chip": round(tflops / zero_world, 2),
+        "mfu": round(tflops / (peak * zero_world), 4),
+        "final_loss": round(final_loss, 4),
+        "zero_world": zero_world,
+        "stream_plan": {"layers_per_step": plan.layers_per_step,
+                        "prefetch": plan.prefetch, "mode": plan.mode},
+        **_program_audit_fields(engine),
+    }
+
+
+def bench_gpt2_zero3_stream():
+    return _bench_gpt2_zero3_stream(carried=False)
+
+
+def bench_gpt2_zero3_stream_carried():
+    return _bench_gpt2_zero3_stream(carried=True)
 
 
 def bench_smoke():
@@ -1003,6 +1106,8 @@ def bench_gpt2_large():
 BENCHES = {"gpt2": bench_gpt2, "smoke": bench_smoke,
            "gpt2_gas4": bench_gpt2_gas4,
            "gpt2_gas4_fused": bench_gpt2_gas4_fused,
+           "gpt2_zero3_stream": bench_gpt2_zero3_stream,
+           "gpt2_zero3_stream_carried": bench_gpt2_zero3_stream_carried,
            "gpt2_b16": bench_gpt2_b16, "gpt2_b32": bench_gpt2_b32,
            "gpt2_medium": bench_gpt2_medium, "gpt2_large": bench_gpt2_large,
            "bert_z2": bench_bert_z2, "bert_s512": bench_bert_s512,
@@ -1017,6 +1122,10 @@ METRIC_NAMES = {  # error-path metric must match the success-path name
                   "tokens/s"),
     "gpt2_gas4_fused": ("gpt2_124m_gas4_fused_train_tokens_per_sec_1chip",
                         "tokens/s"),
+    "gpt2_zero3_stream": ("gpt2_124m_zero3_stream_serialized_train_tokens"
+                          "_per_sec", "tokens/s"),
+    "gpt2_zero3_stream_carried": ("gpt2_124m_zero3_stream_carried_train_"
+                                  "tokens_per_sec", "tokens/s"),
     "gpt2_b16": ("gpt2_124m_b16_train_tokens_per_sec_1chip", "tokens/s"),
     "gpt2_b32": ("gpt2_124m_b32_train_tokens_per_sec_1chip", "tokens/s"),
     "gpt2_medium": ("gpt2_355m_train_tokens_per_sec_1chip", "tokens/s"),

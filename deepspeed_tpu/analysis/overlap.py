@@ -15,10 +15,15 @@ budget accounts) this module computes:
 
   distance         equations between issue and first consume at the
                    collective's nesting level (transparent shape-only
-                   ops extend the wire, they don't consume it)
+                   ops and payload-preserving elementwise epilogues —
+                   a quantized gather's dequant — extend the wire, they
+                   don't consume it)
   slack_flops      flop-weighted independent work inside that window —
                    everything between issue and first consume is
-                   provably independent of the collective's result
+                   provably independent of the collective's result.
+                   A carried collective's window is the FULL iteration
+                   (its result is consumed next time around), so its
+                   slack is bounded below by one body's flops
   carried          the result escapes the enclosing body (scan carry /
                    region output) instead of being consumed in-body:
                    the double-buffer property, verified
@@ -51,6 +56,32 @@ _WIRE_PRIMS = _WIRE_GATHER_PRIMS + _WIRE_REDUCE_PRIMS
 _TRANSPARENT_PRIMS = ("reshape", "transpose", "broadcast_in_dim",
                       "squeeze", "rev", "slice", "copy",
                       "convert_element_type", "name")
+
+# payload-preserving elementwise ops: when the output keeps the tracked
+# operand's shape, the wire flows THROUGH (a quantized gather's dequant
+# `payload * scales`, a bias add) rather than being consumed — the
+# compute the collective is actually waiting for is the contraction /
+# loop boundary further on.  Shape equality is the gate: a reduction or
+# contraction changes shape and still counts as the first consumer.
+_ELEMENTWISE_FLOWTHROUGH = ("mul", "add", "sub", "div", "max", "min")
+
+
+def _flows_through(eqn, tracked: set) -> bool:
+    name = eqn.primitive.name
+    if name in _TRANSPARENT_PRIMS:
+        return True
+    if name not in _ELEMENTWISE_FLOWTHROUGH or len(eqn.outvars) != 1:
+        return False
+    out_aval = getattr(eqn.outvars[0], "aval", None)
+    if out_aval is None or not hasattr(out_aval, "shape"):
+        return False
+    for v in eqn.invars:
+        if id(v) in tracked:
+            aval = getattr(v, "aval", None)
+            if (aval is not None and hasattr(aval, "shape")
+                    and tuple(aval.shape) == tuple(out_aval.shape)):
+                return True
+    return False
 
 
 @dataclass
@@ -127,10 +158,23 @@ def _analyze(jaxpr, cfg, target_label, _scope, _mult, _loop_depth):
             records.extend(sub_records)
             outs = list(eqn.outvars)
             sub_outs = list(as_jaxpr(sub.jaxpr).outvars)
+            body_flops = None  # one body iteration, computed lazily
             for chase, positions in sub_escaped:
                 if is_loop:
                     # escaping a scan/while body = the result rides the
-                    # carry into the next iteration: double-buffered
+                    # carry into the next iteration: double-buffered.
+                    # The schedule window of a carried collective is the
+                    # FULL iteration — everything the wire does not feed
+                    # (it feeds nothing in-body, it escaped) can hide it,
+                    # regardless of where partial eval placed the issue
+                    # in the body's eqn order — so the slack is bounded
+                    # below by one body's flops.
+                    if body_flops is None:
+                        from ..profiling.flops_profiler import (
+                            count_jaxpr_flops)
+                        body_flops = count_jaxpr_flops(sub.jaxpr)
+                    chase.rec.slack_flops = max(chase.rec.slack_flops,
+                                                body_flops)
                     _finalize(chase.rec, cfg, carried=True)
                 elif len(outs) == len(sub_outs):
                     # call-kind boundary (pjit/remat/custom_vjp/
@@ -148,7 +192,7 @@ def _analyze(jaxpr, cfg, target_label, _scope, _mult, _loop_depth):
         flops = None  # computed once per eqn, shared across chases
         for chase in active:
             touches = any(id(v) in chase.tracked for v in eqn.invars)
-            if touches and eqn.primitive.name in _TRANSPARENT_PRIMS:
+            if touches and _flows_through(eqn, chase.tracked):
                 chase.tracked.update(id(v) for v in eqn.outvars)
                 still_active.append(chase)
             elif touches:
@@ -228,16 +272,37 @@ def summarize_overlap(records: List[CollectiveOverlap]) -> Dict[str, Any]:
 def overlap_rule_findings(records: List[CollectiveOverlap], cfg,
                           scan_info: Dict[str, Any] = None
                           ) -> List[Finding]:
-    """One finding per serialized collective inside a hot-loop body.
+    """One finding per serialized collective inside a hot-loop body,
+    plus a warning when the streamed-ZeRO-3 plan FORFEITED a requested
+    prefetch (the fallback would otherwise be silent).
 
-    The streamed-ZeRO-3 layer scan currently gathers layer i's weights
-    on the critical path (the gather's first consumer is layer i's own
-    matmul) — exactly what ROADMAP item 1's double-buffered prefetch
-    fixes, and what this rule gates in CI once ``require_overlap`` is
-    set."""
+    With ``stage3_prefetch_mode: carried`` (the default) the streamed
+    layer scan issues group i+1's gather into the scan carry under
+    group i's compute — in both directions — so its hot-loop gathers
+    classify as ``carried`` and this rule stays silent; the serialized
+    shape survives in ``unrolled``/``off`` modes and is what
+    ``require_overlap`` gates in CI."""
     out: List[Finding] = []
     severity = "error" if cfg.require_overlap else "warning"
     plan = (scan_info or {}).get("zero3_streaming")
+    hot_gathers = any(r.loop_depth > 0 and r.prim in _WIRE_GATHER_PRIMS
+                      for r in records)
+    if plan is not None and plan.get("forfeited") and hot_gathers:
+        out.append(Finding(
+            rule=RULE_OVERLAP, severity="warning",
+            message=("streamed ZeRO-3 prefetch was FORFEITED: "
+                     f"{plan['forfeited']} — the layer gathers run "
+                     "serialized at use"),
+            target=next(r.target for r in records
+                        if r.loop_depth > 0
+                        and r.prim in _WIRE_GATHER_PRIMS),
+            # the forfeit reason itself names the failed constraint (and,
+            # for the unrolled even-group case, that carried mode lifts
+            # it) — the hint covers the budget levers common to all modes
+            fix_hint=("raise stage3_max_live_parameters / "
+                      "stage3_prefetch_bucket_size until a double-buffer "
+                      "budget fits — the finding names the constraint "
+                      "that failed")))
     for r in records:
         if not (r.serialized and r.loop_depth > 0):
             continue
@@ -245,7 +310,8 @@ def overlap_rule_findings(records: List[CollectiveOverlap], cfg,
         if plan is not None and r.prim in _WIRE_GATHER_PRIMS:
             plan_note = (f" (streamed ZeRO-3 plan: groups of "
                          f"{plan['layers_per_step']}, "
-                         f"prefetch={plan['prefetch']})")
+                         f"prefetch={plan['prefetch']}, "
+                         f"mode={plan.get('mode', 'off')})")
         out.append(Finding(
             rule=RULE_OVERLAP, severity=severity,
             message=(f"collective `{r.prim}` ({r.wire_bytes} B x{r.mult}) "
@@ -257,7 +323,8 @@ def overlap_rule_findings(records: List[CollectiveOverlap], cfg,
                      + plan_note),
             target=r.target, scope=r.scope,
             fix_hint=("issue the gather for iteration i+1 under "
-                      "iteration i's compute (double-buffered carry "
-                      "prefetch, ROADMAP item 1), or shrink the wire "
-                      "(qwZ/hpZ) until the slack covers it")))
+                      "iteration i's compute (stage3_prefetch_mode="
+                      "carried, the double-buffered carry prefetch), or "
+                      "shrink the wire (qwZ/hpZ) until the slack "
+                      "covers it")))
     return out

@@ -193,6 +193,15 @@ class ZeroLowBandwidthConfig:
         return cfg
 
 
+def _validated_prefetch_mode(mode: str) -> str:
+    if mode not in C.ZERO_OPTIMIZATION_PREFETCH_MODES:
+        raise DeepSpeedConfigError(
+            f"zero_optimization.{C.ZERO_OPTIMIZATION_PREFETCH_MODE}="
+            f"{mode!r} — supported modes are "
+            f"{list(C.ZERO_OPTIMIZATION_PREFETCH_MODES)}")
+    return mode
+
+
 @dataclass
 class ZeroConfig:
     """Reference: deepspeed/runtime/zero/config.py:18 (DeepSpeedZeroConfig)."""
@@ -209,6 +218,7 @@ class ZeroConfig:
     max_live_parameters: int = C.ZERO_OPTIMIZATION_MAX_LIVE_PARAMETERS_DEFAULT
     max_reuse_distance: int = C.ZERO_OPTIMIZATION_MAX_REUSE_DISTANCE_DEFAULT
     prefetch_bucket_size: int = C.ZERO_OPTIMIZATION_PREFETCH_BUCKET_SIZE_DEFAULT
+    prefetch_mode: str = C.ZERO_OPTIMIZATION_PREFETCH_MODE_DEFAULT
     param_persistence_threshold: int = (
         C.ZERO_OPTIMIZATION_PARAM_PERSISTENCE_THRESHOLD_DEFAULT)
     gather_fp16_weights_on_model_save: bool = (
@@ -284,6 +294,9 @@ class ZeroConfig:
             prefetch_bucket_size=int(get_scalar_param(
                 d, C.ZERO_OPTIMIZATION_PREFETCH_BUCKET_SIZE,
                 C.ZERO_OPTIMIZATION_PREFETCH_BUCKET_SIZE_DEFAULT)),
+            prefetch_mode=_validated_prefetch_mode(get_scalar_param(
+                d, C.ZERO_OPTIMIZATION_PREFETCH_MODE,
+                C.ZERO_OPTIMIZATION_PREFETCH_MODE_DEFAULT)),
             param_persistence_threshold=int(get_scalar_param(
                 d, C.ZERO_OPTIMIZATION_PARAM_PERSISTENCE_THRESHOLD,
                 C.ZERO_OPTIMIZATION_PARAM_PERSISTENCE_THRESHOLD_DEFAULT)),

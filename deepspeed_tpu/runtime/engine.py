@@ -186,7 +186,8 @@ class DeepSpeedEngine:
                 self.config.zero_config.max_live_parameters,
                 self.config.zero_config.prefetch_bucket_size,
                 self.config.zero_config.param_persistence_threshold,
-                low_bandwidth=lbc if lbc.enabled else None)
+                low_bandwidth=lbc if lbc.enabled else None,
+                prefetch_mode=self.config.zero_config.prefetch_mode)
             model.install_zero3_streaming(self._zero3_stream)
         elif lbc.enabled and stage >= 3:
             logger.warning(

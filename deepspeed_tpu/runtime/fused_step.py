@@ -23,8 +23,11 @@ Structure of the emitted program::
 
 The scan body IS the engine's existing grad program (``_loss_and_grads`` —
 including the sparse-gradients shard_map region and the ZeRO-3 streamed
-layer scan, which simply nests: scan-in-scan), and the epilogue IS the
-engine's existing apply program (``_apply_core``), so the fused path is
+layer scan, which simply nests: scan-in-scan, or scan-in-scan-in-scan
+with the carried double-buffer prefetch of zero/stage3_streaming.py,
+whose hand-written VJP guarantees gathered layer groups never stack as
+residuals of THIS outer scan either), and the epilogue IS the engine's
+existing apply program (``_apply_core``), so the fused path is
 numerically the modular path with the host removed from the middle.
 
 The engine builds this only when ``fused_step.enabled`` is set AND no

@@ -18,12 +18,19 @@ over the ZeRO ("data","expert") axes:
     stage3.py:460 ``release_sub_module``;
   - the group size is chosen so ``layers_per_step × params_per_layer ≤
     stage3_max_live_parameters`` — max-live honored by construction;
-  - with prefetch enabled (``stage3_prefetch_bucket_size > 0``) the scan
-    carries a double buffer: the gather for group ``i+1`` is issued before
-    group ``i``'s compute, so XLA's latency-hiding scheduler overlaps
-    communication with the MXU work — the role of PrefetchCoordinator's
-    trace-based lookahead, without needing a trace (the scan order IS the
-    trace);
+  - with prefetch enabled (``stage3_prefetch_bucket_size`` covering a
+    group) the scan carries a double buffer: the gather for group ``i+1``
+    is ISSUED into the scan carry before group ``i``'s compute and
+    consumed one iteration later (``stage3_prefetch_mode: carried``, the
+    default), so the gather's issue→first-consume distance spans a full
+    group of MXU work — overlap as a *program-graph property* (T3,
+    arXiv:2401.16677) that the Schedule Auditor verifies statically,
+    rather than a scheduling opportunity XLA may or may not take.  The
+    backward re-gather sweep is double-buffered the same way.  This is
+    the role of PrefetchCoordinator's trace-based lookahead, without
+    needing a trace (the scan order IS the trace);
+    ``stage3_prefetch_mode: unrolled`` keeps the legacy unroll-2 body
+    (overlap left to XLA's latency-hiding scheduler);
   - the backward of a tiled all-gather over the ZeRO axes is a
     psum-scatter — run in fp32 regardless of compute dtype
     (_all_gather_f32grad): layer gradients leave the region already
@@ -37,14 +44,18 @@ declarative TP.
 Scan-in-scan (fused whole-step program, runtime/fused_step.py): the fused
 train step wraps this layer scan in an OUTER ``lax.scan`` over the
 microbatch axis.  No special casing is needed here, but the composition
-leans on an invariant of this file: the ``zero3_gathered`` checkpoint-name
-policy (see ``gather_group``) is what keeps the outer scan's VJP from
-stacking per-microbatch gathered groups as residuals — without it the
-fused program would save gas × (full unsharded model) and defeat max_live
-across microbatches, not just within one.  Tested by
-test_fused_step.py::test_fused_zero3_streaming_parity.
+leans on an invariant of this file: gathered layer groups are NEVER saved
+as residuals.  In ``carried`` mode that is structural — the hand-written
+VJP's residuals are the group-boundary activation carries plus the
+sharded inputs, and the backward re-gathers (``_build_carried_stream``);
+in ``unrolled``/``off`` modes the ``zero3_gathered`` checkpoint-name
+policy (see ``gather_group``) does the same job through the remat
+machinery.  Without the invariant the fused program would save gas ×
+(full unsharded model) and defeat max_live across microbatches, not just
+within one.  Tested by test_fused_step.py::test_fused_zero3_streaming_parity.
 """
 
+import logging
 from dataclasses import dataclass
 from functools import partial
 from typing import Any, Optional
@@ -57,22 +68,35 @@ from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec
 
+from ...constants import ZERO_OPTIMIZATION_PREFETCH_MODES as PREFETCH_MODES
 from ...parallel.mesh import MeshContext, ZERO_AXES
 from ...utils.logging import log_dist
 from ..comm.low_bandwidth import (f32_psum_scatter, largest_divisor_at_most,
                                   low_bandwidth_all_gather,
-                                  quantized_gather_saves_bytes)
+                                  quantized_gather_saves_bytes,
+                                  quantized_psum_scatter)
 from .partition import (filter_spec_axes, resolve_hpz_axes,
                         zero_partition_spec)
 
 
 @dataclass(frozen=True)
 class StreamPlan:
-    """How the layer stack is grouped and prefetched."""
+    """How the layer stack is grouped and prefetched.
+
+    ``mode`` is the prefetch structure actually applied: ``carried`` is
+    the double-buffered scan carry (gather for group i+1 issued under
+    group i's compute, in both the forward and the backward re-gather
+    sweep), ``unrolled`` is the legacy unroll-2 loop body (XLA's
+    latency-hiding scheduler must find the overlap), ``off`` gathers
+    each group at use.  ``forfeited`` records WHY a requested prefetch
+    degraded to ``off`` (surfaced by the Schedule Auditor's overlap
+    report and logged once at trace time)."""
     layers_per_step: int
     prefetch: bool
     num_layers: int
     params_per_layer: int
+    mode: str = "off"
+    forfeited: Optional[str] = None
 
     @property
     def live_parameters(self) -> int:
@@ -83,33 +107,80 @@ class StreamPlan:
 
 def plan_layer_streaming(num_layers: int, params_per_layer: int,
                          max_live_parameters: int,
-                         prefetch_bucket_size: int) -> StreamPlan:
+                         prefetch_bucket_size: int,
+                         prefetch_mode: str = "carried") -> StreamPlan:
     """Consume the stage-3 knobs into a concrete (group, prefetch) plan.
 
     ``stage3_max_live_parameters`` bounds the gathered set (reference
     zero/config.py ``max_live_parameters``); ``stage3_prefetch_bucket_size``
-    enables lookahead when it covers at least one more layer group.
+    enables lookahead when it covers at least one more layer group;
+    ``stage3_prefetch_mode`` picks the prefetch program structure:
+
+      carried   (default) the gather for group i+1 rides the scan carry —
+                issue→first-consume spans a full group of MXU work, and
+                the only constraint is >= 2 groups (any divisor group
+                count works);
+      unrolled  the legacy unroll-2 loop body — needs an EVEN group
+                count (otherwise prefetch would cost double the gathers
+                for zero overlap) and leaves the overlap to XLA's
+                latency-hiding scheduler;
+      off       gather at use, no lookahead.
     """
+    if prefetch_mode not in PREFETCH_MODES:
+        raise ValueError(
+            f"stage3_prefetch_mode={prefetch_mode!r} — supported modes are "
+            f"{list(PREFETCH_MODES)}")
     base_budget = max(1, int(max_live_parameters) // max(
         1, params_per_layer))
-    want_prefetch = (int(prefetch_bucket_size) >= params_per_layer and
-                     base_budget >= 2)
+    # a bucket smaller than one layer group is the documented prefetch
+    # OFF switch (no forfeit); a bucket that ASKS for prefetch which the
+    # live-parameter budget then cannot honor is a loud forfeit below
+    wants = (prefetch_mode != "off" and
+             int(prefetch_bucket_size) >= params_per_layer)
+    want_prefetch = wants and base_budget >= 2
+    forfeited = None
+    if wants and not want_prefetch:
+        forfeited = (
+            f"stage3_max_live_parameters holds {base_budget} layer(s) — "
+            "a double buffer needs at least 2 (current + prefetched "
+            "group)")
     if want_prefetch:
-        # live set holds current + prefetched group, and the unroll-2
-        # execution needs an EVEN number of groups — pick the largest group
-        # size satisfying both; otherwise prefetch would silently cost
-        # double the gathers for zero overlap
+        # live set holds current + prefetched group
         budget = base_budget // 2
-        candidates = [g for g in range(1, budget + 1)
-                      if num_layers % g == 0 and (num_layers // g) % 2 == 0
-                      and num_layers // g >= 2]
-        if candidates:
-            return StreamPlan(layers_per_step=max(candidates), prefetch=True,
-                              num_layers=num_layers,
-                              params_per_layer=params_per_layer)
+        if prefetch_mode == "carried":
+            candidates = [g for g in range(1, budget + 1)
+                          if num_layers % g == 0 and num_layers // g >= 2]
+            if candidates:
+                return StreamPlan(layers_per_step=max(candidates),
+                                  prefetch=True, num_layers=num_layers,
+                                  params_per_layer=params_per_layer,
+                                  mode="carried")
+            forfeited = (
+                f"{num_layers} layer(s) cannot form >= 2 groups within "
+                f"the double-buffer budget of {budget} group(s)")
+        else:
+            # the unroll-2 execution needs an EVEN number of groups —
+            # otherwise prefetch would silently cost double the gathers
+            # for zero overlap
+            candidates = [g for g in range(1, budget + 1)
+                          if num_layers % g == 0 and
+                          (num_layers // g) % 2 == 0
+                          and num_layers // g >= 2]
+            if candidates:
+                return StreamPlan(layers_per_step=max(candidates),
+                                  prefetch=True, num_layers=num_layers,
+                                  params_per_layer=params_per_layer,
+                                  mode="unrolled")
+            forfeited = (
+                f"no group size with an EVEN group count divides "
+                f"{num_layers} layers within the double-buffer budget of "
+                f"{budget} group(s) (unrolled prefetch pairs groups; "
+                f"stage3_prefetch_mode=carried has no such constraint)")
     g = largest_divisor_at_most(num_layers, base_budget)
     return StreamPlan(layers_per_step=g, prefetch=False,
-                      num_layers=num_layers, params_per_layer=params_per_layer)
+                      num_layers=num_layers,
+                      params_per_layer=params_per_layer, mode="off",
+                      forfeited=forfeited)
 
 
 def _jaxpr_has_pallas(jaxpr) -> bool:
@@ -197,6 +268,197 @@ def _ag_bwd(axes, dim, _, g):
 _all_gather_f32grad.defvjp(_ag_fwd, _ag_bwd)
 
 
+def _index_tree(tree, i):
+    """Dynamic per-group slice of a ``[steps, ...]``-stacked pytree."""
+    return jax.tree.map(
+        lambda l: lax.dynamic_index_in_dim(l, i, keepdims=False), tree)
+
+
+def _body_closes_over_tracers(body) -> bool:
+    """True when the user body (or a callable it closes over, two levels
+    deep) captures live JAX tracers.  NO streaming mode differentiates
+    such a body — shard_map cannot transpose captured tracers
+    (NotImplementedError in off/unrolled), and the carried custom_vjp
+    differentiates only its explicit inputs (UnexpectedTracerError) —
+    both failures surface deep inside grad with no hint at the cause,
+    so scan() detects the capture up front and logs the actionable
+    diagnosis: thread those values through ``stacked_params`` /
+    ``extra_xs``.  (Forward-only use still works: the captured value
+    rides the region as a replicated const.)"""
+    seen = set()
+
+    def has_tracer(v):
+        try:
+            return any(isinstance(l, jax.core.Tracer)
+                       for l in jax.tree.leaves(v))
+        except Exception:  # noqa: BLE001 — exotic leaves: assume clean
+            return False
+
+    def check(fn, depth):
+        if depth > 2 or not callable(fn) or id(fn) in seen:
+            return False
+        seen.add(id(fn))
+        fn = getattr(fn, "__func__", fn)  # unwrap bound methods
+        for cell in getattr(fn, "__closure__", None) or ():
+            try:
+                v = cell.cell_contents
+            except ValueError:  # empty cell
+                continue
+            if isinstance(v, jax.core.Tracer) or has_tracer(v):
+                return True
+            if callable(v) and check(v, depth + 1):
+                return True
+        return False
+
+    return check(body, 0)
+
+
+def _build_carried_stream(steps: int, gather_group, run_group,
+                          scatter_grads):
+    """Carried double-buffer executor with a hand-scheduled VJP.
+
+    Program structure (``stage3_prefetch_mode: carried``)::
+
+        forward:  full(0) = gather(group 0)                 # prologue
+                  scan i = 0 .. S-2, carry (act, full(i)):
+                      issue gather(group i+1)  -> next carry
+                      act = compute(act, full(i))           # a FULL group
+                                                            # of MXU slack
+                  act = compute(act, full(S-1))             # epilogue
+        backward: re-gather(S-1), issue re-gather(S-2)      # prologue
+                  reverse scan i = S-2 .. 1, carry (cot, full(i)):
+                      issue re-gather(group i-1) -> next carry
+                      cot = vjp(compute)(cot) @ full(i)
+                  cot = vjp(compute)(cot) @ full(0)         # epilogue
+
+    Why a custom VJP instead of the ``zero3_gathered`` checkpoint-name
+    policy alone: a gathered buffer riding a ``lax.scan`` carry is a
+    *body input* of every step, and scan partial evaluation demands body
+    inputs as stacked residuals — the name policy only prunes values
+    produced INSIDE the rematerialized body, so the naive carried scan
+    saves ``steps x group`` = the full unsharded model and defeats
+    ``stage3_max_live_parameters`` outright (verified: the stacked
+    ``[S, full]`` residual appears in the grad jaxpr).  Hand-writing the
+    VJP extends the policy's intent across the carry: gathered buffers
+    are dropped from residuals entirely and RE-GATHERED in the backward
+    (the reference's backward re-fetch, stage3.py:546
+    PreBackwardFunction) — and the re-gathers get their own carried
+    double buffer, so the backward's wire hides under the backward's
+    compute exactly like the forward's.
+
+    The residuals saved are the per-group INPUT activation carries (the
+    forward scan's ys) plus the sharded inputs; each group's internal
+    activations are rematerialized inside its backward step (``jax.vjp``
+    re-runs ``run_group`` from the saved carry).  That is one extra
+    forward pass of the layer stack per step — the deliberate trade for
+    taking BOTH directions' gathers off the critical path while keeping
+    peak gathered memory at ``2 x layers_per_step x params_per_layer``
+    (models running remat anyway, e.g. ``activation_checkpointing``,
+    were already paying it).
+
+    ``steps`` must be >= 2 (the plan guarantees it in carried mode).
+    ``gather_group(shards) -> full``, ``run_group(act, full, extras) ->
+    act`` and ``scatter_grads(g_full) -> g_shards`` (the exact transpose
+    of ``gather_group``'s wire, qwZ/qgZ aware) come from the enclosing
+    :meth:`Zero3StreamContext.scan` trace.
+    """
+
+    if steps < 2:
+        raise ValueError(
+            f"carried prefetch needs >= 2 layer groups, got {steps} — "
+            "plan_layer_streaming should have forfeited to mode=off")
+
+    # a list of leaves is a pytree: _index_tree slices shard groups too
+    _group_shards = _index_tree
+
+    def _forward(c0, params_g, extras_g):
+        first = gather_group(_group_shards(params_g, 0))
+
+        def fbody(carry, i):
+            c, cur = carry
+            # issue i+1's gather BEFORE group i's compute: the result is
+            # consumed next iteration (carried), so its wire has the
+            # whole group's MXU work as slack
+            nxt = gather_group(_group_shards(params_g, i + 1))
+            c_out = run_group(c, cur, _index_tree(extras_g, i))
+            return (c_out, nxt), c
+
+        (c_pen, last), c_ins = lax.scan(
+            fbody, (c0, first), jnp.arange(steps - 1))
+        c_fin = run_group(c_pen, last, _index_tree(extras_g, steps - 1))
+        return c_fin, (c_pen, c_ins)
+
+    @jax.custom_vjp
+    def carried(c0, params_g, extras_g):
+        return _forward(c0, params_g, extras_g)[0]
+
+    def carried_fwd(c0, params_g, extras_g):
+        c_fin, (c_pen, c_ins) = _forward(c0, params_g, extras_g)
+        # residuals: group-boundary activation carries (c_ins[0] IS c0)
+        # + the SHARDED inputs — never a gathered buffer
+        return c_fin, (c_pen, c_ins, params_g, extras_g)
+
+    def carried_bwd(res, g_out):
+        c_pen, c_ins, params_g, extras_g = res
+        ex_leaves = jax.tree.leaves(extras_g)
+        ex_tree = jax.tree.structure(extras_g)
+        is_float = [jnp.issubdtype(l.dtype, jnp.inexact)
+                    for l in ex_leaves]
+
+        def float_only(g_ex):
+            return [l for l, f in zip(jax.tree.leaves(g_ex), is_float)
+                    if f]
+
+        def group_vjp(c_in, full, ex_i, g_c):
+            _, vjp_fn = jax.vjp(run_group, c_in, full, ex_i)
+            return vjp_fn(g_c)
+
+        # group S-1: backward re-fetch, with S-2's re-gather issued
+        # BEFORE the transposed compute (the backward's own prologue
+        # double buffer)
+        full_last = gather_group(_group_shards(params_g, steps - 1))
+        full_prev = gather_group(_group_shards(params_g, steps - 2))
+        g_c, g_full, g_ex = group_vjp(
+            c_pen, full_last, _index_tree(extras_g, steps - 1), g_out)
+        g_sh_last = scatter_grads(g_full)
+        g_ex_last = float_only(g_ex)
+
+        def bbody(carry, i):
+            g_c, cur = carry
+            nxt = gather_group(_group_shards(params_g, i - 1))
+            g_c, g_full, g_ex = group_vjp(
+                _index_tree(c_ins, i), cur, _index_tree(extras_g, i), g_c)
+            return (g_c, nxt), (scatter_grads(g_full), float_only(g_ex))
+
+        (g_c, cur0), (g_sh_mid, g_ex_mid) = lax.scan(
+            bbody, (g_c, full_prev), jnp.arange(1, steps - 1),
+            reverse=True)
+
+        # group 0: consumes the last carried re-gather
+        g_c0, g_full, g_ex = group_vjp(
+            _index_tree(c_ins, 0), cur0, _index_tree(extras_g, 0), g_c)
+        g_sh0 = scatter_grads(g_full)
+        g_ex0 = float_only(g_ex)
+
+        g_params = [jnp.concatenate([a[None], mid, b[None]], axis=0)
+                    for a, mid, b in zip(g_sh0, g_sh_mid, g_sh_last)]
+        out_ex, fi = [], 0
+        for leaf, f in zip(ex_leaves, is_float):
+            if f:
+                out_ex.append(jnp.concatenate(
+                    [g_ex0[fi][None], g_ex_mid[fi], g_ex_last[fi][None]],
+                    axis=0))
+                fi += 1
+            else:
+                # integer / PRNG-key extras take the conventional float0
+                # cotangent
+                out_ex.append(np.zeros(jnp.shape(leaf), jax.dtypes.float0))
+        return g_c0, g_params, jax.tree.unflatten(ex_tree, out_ex)
+
+    carried.defvjp(carried_fwd, carried_bwd)
+    return carried
+
+
 class Zero3StreamContext:
     """Installable streaming executor for stacked-layer models.
 
@@ -209,10 +471,13 @@ class Zero3StreamContext:
     def __init__(self, mesh_ctx: MeshContext, max_live_parameters: int,
                  prefetch_bucket_size: int,
                  persistence_threshold: int = 0,
-                 low_bandwidth=None):
+                 low_bandwidth=None, prefetch_mode: str = "carried"):
+        # validation lives at the config boundary (config.py) and in
+        # plan_layer_streaming (the public planner); no third copy here
         self.ctx = mesh_ctx
         self.max_live_parameters = int(max_live_parameters)
         self.prefetch_bucket_size = int(prefetch_bucket_size)
+        self.prefetch_mode = prefetch_mode
         self.persistence_threshold = int(persistence_threshold)
         self.axis_sizes = {a: mesh_ctx.axis_size(a) for a in ZERO_AXES}
         self.manual = frozenset(
@@ -344,7 +609,30 @@ class Zero3StreamContext:
             int(np.prod(l.shape[1:])) for l in leaves)
         return plan_layer_streaming(num_layers, per_layer,
                                     self.max_live_parameters,
-                                    self.prefetch_bucket_size)
+                                    self.prefetch_bucket_size,
+                                    self.prefetch_mode)
+
+    def _leaf_transpose_plan(self, local_shape, dtype, dims):
+        """Static transpose schedule of ``gather_group``'s wire for one
+        leaf: ``[(dim, axes, qgz_bits), ...]`` in FORWARD gather order.
+        The quantization decision replays ``_gather_leaf``'s per-step
+        ``_leaf_wire_bits`` on the simulated intermediate shapes, so the
+        carried backward's hand-applied scatter moves exactly the bytes
+        ``low_bandwidth_all_gather``'s own transpose would (qgZ
+        quantized reduce-scatter when configured and paying, the fp32
+        promote-reduce-demote otherwise)."""
+        shape = list(local_shape)
+        plan = []
+        for dim, axes in dims:
+            leaf = jax.ShapeDtypeStruct(tuple(shape), dtype)
+            _qwz, qgz = self._leaf_wire_bits(leaf, dim + 1)
+            # the transpose wire depends only on qgz: both _lbag_bwd
+            # (qwz path) and _ag_bwd (dense path) fall back to
+            # f32_psum_scatter when qgz == 0
+            plan.append((dim + 1, tuple(axes), qgz))
+            world = int(np.prod([self.param_axis_sizes[a] for a in axes]))
+            shape[dim + 1] *= world
+        return plan
 
     # ------------------------------------------------------------------ #
     def scan(self, body, init_carry, stacked_params: Any, extra_xs: Any,
@@ -368,6 +656,19 @@ class Zero3StreamContext:
             return carry
 
         plan = self.plan_for(stacked_params)
+        if not self._plan_logged and _body_closes_over_tracers(body):
+            # no streaming mode can DIFFERENTIATE a body that captures
+            # traced values (shard_map cannot transpose captured
+            # tracers; the carried custom_vjp differentiates only its
+            # explicit inputs) — both failures are opaque deep inside
+            # grad, so name the fix up front.  Forward-only use works.
+            log_dist(
+                "ZeRO-3 streaming: the scan body closes over traced "
+                "values — gradients cannot flow to them through the "
+                "streamed region (expect UnexpectedTracerError / "
+                "NotImplementedError under grad); thread those values "
+                "through stacked_params/extra_xs instead",
+                ranks=[0], level=logging.WARNING)
         self.last_plan = plan
         if not self._plan_logged:
             lb = ""
@@ -381,9 +682,17 @@ class Zero3StreamContext:
                       f"qgz={self.lbc.qgz_bits}b hpz={hpz}")
             log_dist(
                 f"ZeRO-3 streaming: {plan.num_layers} layers in groups of "
-                f"{plan.layers_per_step}, prefetch={plan.prefetch}, "
-                f"live<= {plan.live_parameters:,} params "
-                f"(max_live={self.max_live_parameters:,}){lb}", ranks=[0])
+                f"{plan.layers_per_step}, prefetch={plan.prefetch} "
+                f"(mode={plan.mode}), live<= {plan.live_parameters:,} "
+                f"params (max_live={self.max_live_parameters:,}){lb}",
+                ranks=[0])
+            if plan.forfeited:
+                log_dist(
+                    f"ZeRO-3 streaming: prefetch FORFEITED — "
+                    f"{plan.forfeited}; falling back to serialized "
+                    f"at-use gathers ({plan.num_layers} layers in groups "
+                    f"of {plan.layers_per_step})",
+                    ranks=[0], level=logging.WARNING)
             self._plan_logged = True
 
         mesh = self.ctx.mesh
@@ -483,32 +792,80 @@ class Zero3StreamContext:
                 carry, _ = body(carry, (layer,) + tuple(extras_j))
             return carry
 
-        def step(c, xs):
-            shards, extras_g = xs
-            full = gather_group(shards)
-            return run_group(c, full, extras_g), None
+        if plan.mode == "carried":
+            # Carried double-buffer prefetch (_build_carried_stream): the
+            # gather for group i+1 rides the scan carry, issued under
+            # group i's compute, and the hand-written VJP re-gathers in a
+            # reverse scan with its own carried double buffer — gathered
+            # buffers never become scan residuals (the naive carried
+            # structure would stack the full unsharded model; see the
+            # builder's docstring), preserving StreamPlan.live_parameters'
+            # 2x bound.  The transpose schedule below replays the exact
+            # qwZ/qgZ wire decisions _gather_leaf makes, from the LOCAL
+            # (in-region) shard shapes.
+            block = self.lbc.block_size if self.lbc is not None else 0
 
-        # Save every intermediate EXCEPT the gathered params: activations
-        # are stored as usual (no recompute tax), only the all-gathers rerun
-        # in backward.
-        step = jax.checkpoint(
-            step, policy=jax.checkpoint_policies.save_anything_except_these_names(
-                "zero3_gathered"))
+            def local_group_shape(k):
+                shape = [g] + list(p_leaves[k].shape[1:])
+                for d, axes in gathers[k]:
+                    world = int(np.prod(
+                        [self.param_axis_sizes[a] for a in axes]))
+                    shape[d + 1] //= world
+                return shape
 
-        # Prefetch = unroll-2 over groups: the two gathers in the unrolled
-        # loop body are independent of each other's compute, so XLA
-        # schedules gather(i+1) alongside compute(i) — the
-        # PrefetchCoordinator's lookahead (stage3.py:169) as a loop
-        # structure.  (A carried double buffer would re-introduce the full
-        # gathered stack as a scan residual.)  The plan guarantees an even
-        # group count whenever prefetch is on.
-        unroll = 2 if plan.prefetch else 1
+            transpose_plans = [
+                self._leaf_transpose_plan(
+                    local_group_shape(k),
+                    jnp.float32 if widen[k] else leaf_dtypes[k],
+                    gathers[k])
+                for k in range(len(p_leaves))]
 
-        def region_fn(carry, params_grouped, extras_grouped):
-            carry, _ = lax.scan(
-                step, carry, (params_grouped, extras_grouped),
-                unroll=unroll)
-            return carry
+            def scatter_grads(g_full):
+                out = []
+                for gk, plan_k, w in zip(g_full, transpose_plans, widen):
+                    if w:  # transpose of gather_group's cast-back to dt
+                        gk = gk.astype(jnp.float32)
+                    for d, axes, qgz in reversed(plan_k):
+                        gk = (quantized_psum_scatter(gk, axes, d,
+                                                     bits=qgz, block=block)
+                              if qgz else f32_psum_scatter(gk, axes, d))
+                    out.append(gk)
+                return out
+
+            carried = _build_carried_stream(steps, gather_group,
+                                            run_group, scatter_grads)
+
+            def region_fn(carry, params_grouped, extras_grouped):
+                return carried(carry, params_grouped, extras_grouped)
+        else:
+            def step(c, xs):
+                shards, extras_g = xs
+                full = gather_group(shards)
+                return run_group(c, full, extras_g), None
+
+            # Save every intermediate EXCEPT the gathered params:
+            # activations are stored as usual (no recompute tax), only the
+            # all-gathers rerun in backward.
+            step = jax.checkpoint(
+                step,
+                policy=jax.checkpoint_policies.
+                save_anything_except_these_names("zero3_gathered"))
+
+            # Unrolled prefetch = unroll-2 over groups: the two gathers in
+            # the unrolled loop body are independent of each other's
+            # compute, so XLA's latency-hiding scheduler MAY hoist
+            # gather(i+1) alongside compute(i) — the PrefetchCoordinator's
+            # lookahead (stage3.py:169) as a loop structure, but only as a
+            # scheduling opportunity, not a program property (the carried
+            # mode makes it structural).  The plan guarantees an even
+            # group count whenever unrolled prefetch is on.
+            unroll = 2 if plan.prefetch else 1
+
+            def region_fn(carry, params_grouped, extras_grouped):
+                carry, _ = lax.scan(
+                    step, carry, (params_grouped, extras_grouped),
+                    unroll=unroll)
+                return carry
 
         # check_vma SCOPED (advisor r3): pallas_call outputs carry no
         # varying-mesh-axes metadata, so the vma analysis rejects any

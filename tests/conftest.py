@@ -182,6 +182,12 @@ _SLOW_PREFIXES = (
     "test_sharded_checkpoint.py::test_two_process_distributed_training",
     "test_sparse_attention.py::test_gpt2_with_sparse_attention_trains",
     "test_training_dynamics.py::test_engine_pld_injected_into_gpt2",
+    "test_zero3_streaming.py::test_carried_hpz_parity",
+    "test_zero3_streaming.py::test_carried_low_bandwidth_parity",
+    # prefix covers the fp32 parametrization and bf16 (the fast lane
+    # keeps the carried cells that matter: the fused scan-in-scan
+    # parity, the overlap-gate pin, and the liveness pin)
+    "test_zero3_streaming.py::test_carried_mode_parity",
     "test_zero3_streaming.py::test_streaming_matches_baseline",
     "test_zero3_streaming.py::test_streaming_with_tensor_parallel",
     "test_zero3_streaming.py::test_zero3_bf16_streams_on_cpu",

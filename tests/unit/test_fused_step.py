@@ -132,15 +132,24 @@ def test_fused_matches_modular_fp16_overflow_skip():
     assert_tree_close(e_mod.opt_state, e_fus.opt_state, 1e-4)
 
 
-def test_fused_zero3_streaming_parity():
+@pytest.mark.parametrize("stream_cfg", [
+    pytest.param({"stage3_max_live_parameters": 10_000,
+                  "stage3_prefetch_bucket_size": 0}, id="at_use"),
+    # carried double-buffer prefetch nested INSIDE the fused gas scan
+    # (scan-in-scan-in-scan): the hand-written VJP's residuals are the
+    # group-boundary carries, so the outer scan never stacks gathered
+    # groups across microbatches (ISSUE 7)
+    pytest.param({"stage3_max_live_parameters": 100_000,
+                  "stage3_prefetch_bucket_size": 100_000,
+                  "stage3_prefetch_mode": "carried"}, id="carried"),
+])
+def test_fused_zero3_streaming_parity(stream_cfg):
     """Scan-in-scan: the fused program's microbatch scan wraps the ZeRO-3
-    streamed layer scan (shard_map gather-at-use) without changes."""
+    streamed layer scan (at-use or carried prefetch) without changes."""
     from deepspeed_tpu.models import GPT2Config, GPT2Model
 
     batch, seq, gas, steps = 8, 16, 2, 2
-    zero3 = {"zero_optimization": {"stage": 3,
-                                   "stage3_max_live_parameters": 10_000,
-                                   "stage3_prefetch_bucket_size": 0}}
+    zero3 = {"zero_optimization": dict({"stage": 3}, **stream_cfg)}
 
     def build(fused):
         ds.reset_mesh_context()
@@ -180,6 +189,10 @@ def test_fused_zero3_streaming_parity():
     e_fus = build(True)
     assert e_fus._fused_step_fn is not None, e_fus.fused_step_reason
     l_fus = run_fused(e_fus, batches, gas=gas)
+    if stream_cfg.get("stage3_prefetch_mode") == "carried":
+        # the plan is recorded when the fused program traces the scan
+        assert e_fus._zero3_stream.last_plan.mode == "carried"
+        assert e_fus._zero3_stream.last_plan.prefetch
     np.testing.assert_allclose(l_mod, l_fus, rtol=2e-4)
     assert_tree_close(e_mod.params, e_fus.params, 2e-5)
 

@@ -55,9 +55,98 @@ def test_plan_degenerate():
     # (same live set as one giant group, but the gathers overlap compute)
     plan = plan_layer_streaming(4, 10, 10 ** 9, 10 ** 9)
     assert plan.layers_per_step == 2 and plan.prefetch
-    # odd group counts never reach execution with prefetch on
+    # carried mode has NO even-group-count constraint: 18 layers at a
+    # 6-group budget take groups of 6 (3 groups) — the larger group size
+    # the unrolled mode was forfeiting
     plan = plan_layer_streaming(18, 100, 1300, 100)
+    assert plan.prefetch and plan.mode == "carried"
+    assert plan.layers_per_step == 6
+    # unrolled mode keeps the even constraint (18//6 = 3 is odd -> g=3)
+    plan = plan_layer_streaming(18, 100, 1300, 100,
+                                prefetch_mode="unrolled")
     assert not plan.prefetch or (18 // plan.layers_per_step) % 2 == 0
+
+
+def test_plan_prefetch_modes():
+    # off: never prefetches even with room to spare
+    plan = plan_layer_streaming(8, 100, 10 ** 9, 10 ** 9,
+                                prefetch_mode="off")
+    assert not plan.prefetch and plan.mode == "off"
+    assert plan.forfeited is None  # off was requested, nothing forfeited
+    # unrolled on an odd prime layer count FORFEITS prefetch and says why
+    plan = plan_layer_streaming(7, 100, 10 ** 9, 10 ** 9,
+                                prefetch_mode="unrolled")
+    assert not plan.prefetch and plan.mode == "off"
+    assert plan.forfeited is not None and "EVEN" in plan.forfeited
+    assert "carried" in plan.forfeited  # names the fix
+    # carried handles the same shape: groups of 1, 7 carried steps
+    plan = plan_layer_streaming(7, 100, 10 ** 9, 10 ** 9)
+    assert plan.prefetch and plan.mode == "carried"
+    assert plan.layers_per_step == 1
+    # carried cannot form 2 groups from a single layer: forfeits loudly
+    plan = plan_layer_streaming(1, 100, 10 ** 9, 10 ** 9)
+    assert not plan.prefetch and plan.forfeited is not None
+    # a bucket that asks for prefetch which max_live cannot double-buffer
+    # is a forfeit too (bucket < one layer stays the silent off switch)
+    plan = plan_layer_streaming(8, 100, 150, prefetch_bucket_size=100)
+    assert not plan.prefetch and plan.forfeited is not None
+    assert "double buffer" in plan.forfeited
+    plan = plan_layer_streaming(8, 100, 150, prefetch_bucket_size=50)
+    assert not plan.prefetch and plan.forfeited is None
+    with pytest.raises(ValueError, match="stage3_prefetch_mode"):
+        plan_layer_streaming(8, 100, 400, 100, prefetch_mode="eager")
+
+
+def test_body_closing_over_tracers_is_diagnosed(monkeypatch):
+    """NO streaming mode can differentiate a body that captures traced
+    values (shard_map cannot transpose captured tracers; the carried
+    custom_vjp differentiates only explicit inputs) — scan() must log
+    the actionable diagnosis up front instead of leaving the user with
+    a bare NotImplementedError / UnexpectedTracerError from deep inside
+    grad.  A clean body stays carried and silent.  (The repo logger
+    sets propagate=False, so capture the log_dist call itself.)"""
+    import jax.numpy as jnp
+    from deepspeed_tpu.runtime.zero import stage3_streaming as s3
+    from deepspeed_tpu.runtime.zero.stage3_streaming import (
+        Zero3StreamContext, _body_closes_over_tracers)
+
+    logged = []
+    monkeypatch.setattr(
+        s3, "log_dist", lambda msg, *a, **k: logged.append(str(msg)))
+    ds.reset_mesh_context()
+    ds.initialize_mesh(data=-1)
+    ctx = ds.get_mesh_context()
+    stream = Zero3StreamContext(ctx, 10 ** 9, 10 ** 9)
+    stacked = jnp.asarray(np.random.RandomState(0).randn(4, 8, 8),
+                          jnp.float32) * 0.1
+    x = jnp.ones((8, 8), jnp.float32)
+
+    def loss(params, tied):
+        def body(c, xs):
+            return jnp.tanh(c @ xs[0]["w"] * tied), None  # tied: captured
+
+        return stream.scan(body, x, {"w": params}, ()).sum()
+
+    with pytest.raises(Exception):  # the pre-existing grad failure
+        jax.jit(jax.grad(loss, argnums=(0, 1)))(stacked, jnp.float32(0.7))
+    assert any("closes over traced values" in m for m in logged), logged
+    logged.clear()
+
+    # a clean body (everything threaded through the scan) stays carried
+    # and does not warn
+    stream2 = Zero3StreamContext(ctx, 10 ** 9, 10 ** 9)
+
+    def clean_loss(params):
+        def body(c, xs):
+            return jnp.tanh(c @ xs[0]["w"]), None
+
+        return stream2.scan(body, x, {"w": params}, ()).sum()
+
+    jax.jit(jax.grad(clean_loss))(stacked)
+    assert stream2.last_plan.mode == "carried"
+    assert not any("closes over traced values" in m for m in logged)
+    assert not _body_closes_over_tracers(lambda c, xs: (c, None))
+    ds.reset_mesh_context()
 
 
 def _train(zero_cfg: dict, tp: int = 1, steps: int = 3, num_layers: int = 4):
@@ -222,6 +311,119 @@ def test_zero3_bf16_streams_on_cpu():
         engine.step()
         losses.append(float(loss))
     assert losses[-1] < losses[0]
+
+
+def _train_tiny(zero_cfg, bf16=False, num_layers=5, steps=2,
+                mesh_axes=None, seed_ids=1):
+    """Fast trainer for the prefetch-mode parity matrix: tiny model, two
+    steps, losses + final params.  Modes are compared against each other
+    (same gather/quantization structure), so tolerances stay tight."""
+    ds.reset_mesh_context()
+    mesh = ds.initialize_mesh(**(mesh_axes or {"data": -1}))
+    cfg = GPT2Config(vocab_size=64, n_positions=16, hidden_size=32,
+                     num_layers=num_layers, num_heads=4, bf16=bf16,
+                     embd_dropout=0.0, attn_dropout=0.0, hidden_dropout=0.0)
+    model = GPT2Model(cfg)
+    conf = {
+        "train_micro_batch_size_per_gpu": 8 // mesh.data_parallel_world_size
+        if mesh.data_parallel_world_size <= 8 else 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": zero_cfg,
+        "steps_per_print": 10 ** 9,
+    }
+    if bf16:
+        conf["bf16"] = {"enabled": True}
+    engine, _, _, _ = ds.initialize(
+        model=model, config=conf,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)),
+        mesh=mesh, rng=jax.random.PRNGKey(7))
+    ids = np.asarray(jax.random.randint(jax.random.PRNGKey(seed_ids),
+                                        (8, 16), 0, 64), np.int32)
+    losses = []
+    for _ in range(steps):
+        loss = engine.forward(ids)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    final = jax.tree.map(np.asarray, engine.params)
+    plan = engine._zero3_stream.last_plan
+    ds.reset_mesh_context()
+    return losses, final, plan
+
+
+def _mode_cfg(mode, extra=None):
+    cfg = {"stage": 3, "stage3_param_persistence_threshold": 0,
+           "stage3_max_live_parameters": 2 * 12832,
+           "stage3_prefetch_bucket_size": 2 * 12832,
+           "stage3_prefetch_mode": mode}
+    cfg.update(extra or {})
+    return cfg
+
+
+@pytest.mark.parametrize("mode", ["carried", "unrolled"])
+def test_carried_mode_parity_fp32(mode):
+    """Prefetch-mode parity (ISSUE 7): the carried double-buffer program
+    and the unrolled program must train identically to the at-use
+    gather-per-group program — 5 layers, an ODD group count only the
+    carried structure can prefetch."""
+    l_off, p_off, plan_off = _train_tiny(_mode_cfg("off"))
+    assert plan_off.mode == "off" and not plan_off.prefetch
+    l_m, p_m, plan_m = _train_tiny(_mode_cfg(mode))
+    if mode == "carried":
+        assert plan_m.mode == "carried" and plan_m.prefetch
+        assert plan_m.num_layers // plan_m.layers_per_step == 5
+    np.testing.assert_allclose(l_m, l_off, rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(p_m), jax.tree.leaves(p_off)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+
+
+def test_carried_mode_parity_bf16():
+    l_off, p_off, _ = _train_tiny(_mode_cfg("off"), bf16=True)
+    l_car, p_car, plan = _train_tiny(_mode_cfg("carried"), bf16=True)
+    assert plan.mode == "carried"
+    # bf16 rounds differently under the two program structures (XLA
+    # fuses the carried and at-use bodies differently); the tolerance
+    # admits half-precision noise, nothing structural
+    np.testing.assert_allclose(l_car, l_off, rtol=2e-4)
+    # Adam normalizes bf16-rounded grads into O(lr) updates — a sign
+    # flip on a near-zero gradient element diverges by 2 x lr x steps =
+    # 4e-3 worst case — so params get an Adam-noise-ceiling atol while
+    # the losses above carry the tight parity signal; a structural bug
+    # would diff at O(1)
+    for a, b in zip(jax.tree.leaves(p_car), jax.tree.leaves(p_off)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=5e-2, atol=5e-3)
+    assert l_car[-1] < l_car[0]  # still actually training
+
+
+def test_carried_low_bandwidth_parity():
+    """Carried prefetch composes with the qwZ quantized wire: both modes
+    quantize identically (same blockwise layout, straight-through
+    backward), so the trajectories match tightly."""
+    lb = {"low_bandwidth": {"enabled": True, "qwz_bits": 8}}
+    l_off, p_off, _ = _train_tiny(_mode_cfg("off", lb))
+    l_car, p_car, plan = _train_tiny(_mode_cfg("carried", lb))
+    assert plan.mode == "carried"
+    np.testing.assert_allclose(l_car, l_off, rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(p_car), jax.tree.leaves(p_off)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+
+
+def test_carried_hpz_parity():
+    """Carried prefetch composes with the hpZ sub-mesh fast path: the
+    hot-loop gathers stay confined to the secondary axes in both modes,
+    and the trajectories match."""
+    lb = {"low_bandwidth": {"enabled": True, "hpz_group_size": 2}}
+    mesh_axes = {"data": 4, "expert": 2}
+    l_off, p_off, _ = _train_tiny(_mode_cfg("off", lb),
+                                  mesh_axes=mesh_axes)
+    l_car, p_car, plan = _train_tiny(_mode_cfg("carried", lb),
+                                     mesh_axes=mesh_axes)
+    assert plan.mode == "carried"
+    np.testing.assert_allclose(l_car, l_off, rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(p_car), jax.tree.leaves(p_off)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
 
 
 def test_stream_context_low_bandwidth_wiring():
