@@ -83,24 +83,45 @@ class OffloadParamConfig:
     buffer_size: int = C.OFFLOAD_PARAM_BUFFER_SIZE_DEFAULT
     max_in_cpu: int = C.OFFLOAD_PARAM_MAX_IN_CPU_DEFAULT
     pin_memory: bool = C.OFFLOAD_PARAM_PIN_MEMORY_DEFAULT
+    prefetch_depth: int = C.OFFLOAD_PARAM_PREFETCH_DEPTH_DEFAULT
 
     @staticmethod
     def from_dict(d: Optional[Dict[str, Any]]) -> Optional["OffloadParamConfig"]:
         if d is None:
             return None
+        buffer_count = int(get_scalar_param(
+            d, C.OFFLOAD_PARAM_BUFFER_COUNT,
+            C.OFFLOAD_PARAM_BUFFER_COUNT_DEFAULT))
+        prefetch_depth = int(get_scalar_param(
+            d, C.OFFLOAD_PARAM_PREFETCH_DEPTH,
+            C.OFFLOAD_PARAM_PREFETCH_DEPTH_DEFAULT))
+        if prefetch_depth < 0:
+            raise DeepSpeedConfigError(
+                f"offload_param.{C.OFFLOAD_PARAM_PREFETCH_DEPTH}="
+                f"{prefetch_depth} — must be >= 0 (< 2 disables NVMe "
+                "prefetch, 2 is the double buffer)")
+        # the streaming window clamps to >= 2 slots (infinity.py), so the
+        # depth bound checks against the same clamp
+        if prefetch_depth > max(2, buffer_count):
+            raise DeepSpeedConfigError(
+                f"offload_param.{C.OFFLOAD_PARAM_PREFETCH_DEPTH}="
+                f"{prefetch_depth} exceeds "
+                f"{C.OFFLOAD_PARAM_BUFFER_COUNT}={buffer_count} — every "
+                "in-flight swap-in pins one window buffer; raise "
+                "buffer_count or lower the depth")
         return OffloadParamConfig(
             device=get_scalar_param(d, C.OFFLOAD_PARAM_DEVICE,
                                     C.OFFLOAD_PARAM_DEVICE_DEFAULT),
             nvme_path=get_scalar_param(d, C.OFFLOAD_PARAM_NVME_PATH,
                                        C.OFFLOAD_PARAM_NVME_PATH_DEFAULT),
-            buffer_count=int(get_scalar_param(d, C.OFFLOAD_PARAM_BUFFER_COUNT,
-                                              C.OFFLOAD_PARAM_BUFFER_COUNT_DEFAULT)),
+            buffer_count=buffer_count,
             buffer_size=int(get_scalar_param(d, C.OFFLOAD_PARAM_BUFFER_SIZE,
                                              C.OFFLOAD_PARAM_BUFFER_SIZE_DEFAULT)),
             max_in_cpu=int(get_scalar_param(d, C.OFFLOAD_PARAM_MAX_IN_CPU,
                                             C.OFFLOAD_PARAM_MAX_IN_CPU_DEFAULT)),
             pin_memory=get_scalar_param(d, C.OFFLOAD_PARAM_PIN_MEMORY,
                                         C.OFFLOAD_PARAM_PIN_MEMORY_DEFAULT),
+            prefetch_depth=prefetch_depth,
         )
 
 
@@ -113,6 +134,7 @@ class OffloadOptimizerConfig:
     pipeline_read: bool = C.OFFLOAD_OPTIMIZER_PIPELINE_READ_DEFAULT
     pipeline_write: bool = C.OFFLOAD_OPTIMIZER_PIPELINE_WRITE_DEFAULT
     fast_init: bool = C.OFFLOAD_OPTIMIZER_FAST_INIT_DEFAULT
+    pipeline_depth: int = C.OFFLOAD_OPTIMIZER_PIPELINE_DEPTH_DEFAULT
 
     @property
     def pipeline(self) -> bool:
@@ -122,6 +144,15 @@ class OffloadOptimizerConfig:
     def from_dict(d: Optional[Dict[str, Any]]) -> Optional["OffloadOptimizerConfig"]:
         if d is None:
             return None
+        pipeline_depth = int(get_scalar_param(
+            d, C.OFFLOAD_OPTIMIZER_PIPELINE_DEPTH,
+            C.OFFLOAD_OPTIMIZER_PIPELINE_DEPTH_DEFAULT))
+        if pipeline_depth < 2:
+            raise DeepSpeedConfigError(
+                f"offload_optimizer.{C.OFFLOAD_OPTIMIZER_PIPELINE_DEPTH}="
+                f"{pipeline_depth} — the leaf sweep needs >= 2 rotating "
+                "buffer triples to overlap reads/Adam/write-backs "
+                "(reference PipelinedOptimizerSwapper is depth 2)")
         return OffloadOptimizerConfig(
             device=get_scalar_param(d, C.OFFLOAD_OPTIMIZER_DEVICE,
                                     C.OFFLOAD_OPTIMIZER_DEVICE_DEFAULT),
@@ -140,6 +171,7 @@ class OffloadOptimizerConfig:
                 C.OFFLOAD_OPTIMIZER_PIPELINE_WRITE_DEFAULT),
             fast_init=get_scalar_param(d, C.OFFLOAD_OPTIMIZER_FAST_INIT,
                                        C.OFFLOAD_OPTIMIZER_FAST_INIT_DEFAULT),
+            pipeline_depth=pipeline_depth,
         )
 
 
@@ -321,27 +353,51 @@ class ZeroConfig:
 
 @dataclass
 class AioConfig:
-    """Reference: deepspeed/runtime/swap_tensor/aio_config.py:18."""
+    """Reference: deepspeed/runtime/swap_tensor/aio_config.py:18, plus the
+    `backend` engine selector (io_uring | batched | threadpool | auto —
+    constants.AIO_BACKENDS, resolved at handle-creation time by
+    swap_tensor/aio_handle.resolve_backend with a loud fallback log when
+    io_uring is requested but the kernel can't deliver it)."""
     block_size: int = C.AIO_BLOCK_SIZE_DEFAULT
     queue_depth: int = C.AIO_QUEUE_DEPTH_DEFAULT
     thread_count: int = C.AIO_THREAD_COUNT_DEFAULT
     single_submit: bool = C.AIO_SINGLE_SUBMIT_DEFAULT
     overlap_events: bool = C.AIO_OVERLAP_EVENTS_DEFAULT
+    backend: str = C.AIO_BACKEND_DEFAULT
 
     @staticmethod
     def from_dict(d: Optional[Dict[str, Any]]) -> "AioConfig":
         d = d or {}
+        block_size = int(get_scalar_param(d, C.AIO_BLOCK_SIZE,
+                                          C.AIO_BLOCK_SIZE_DEFAULT))
+        if block_size < C.AIO_BLOCK_SIZE_MIN:
+            raise DeepSpeedConfigError(
+                f"aio.{C.AIO_BLOCK_SIZE}={block_size} — below the "
+                f"{C.AIO_BLOCK_SIZE_MIN}-byte I/O alignment floor")
+        queue_depth = int(get_scalar_param(d, C.AIO_QUEUE_DEPTH,
+                                           C.AIO_QUEUE_DEPTH_DEFAULT))
+        if queue_depth < 1:
+            raise DeepSpeedConfigError(
+                f"aio.{C.AIO_QUEUE_DEPTH}={queue_depth} — must be >= 1")
+        thread_count = int(get_scalar_param(d, C.AIO_THREAD_COUNT,
+                                            C.AIO_THREAD_COUNT_DEFAULT))
+        if thread_count < 1:
+            raise DeepSpeedConfigError(
+                f"aio.{C.AIO_THREAD_COUNT}={thread_count} — must be >= 1")
+        backend = get_scalar_param(d, C.AIO_BACKEND, C.AIO_BACKEND_DEFAULT)
+        if backend not in C.AIO_BACKENDS:
+            raise DeepSpeedConfigError(
+                f"aio.{C.AIO_BACKEND}={backend!r} — supported backends "
+                f"are {list(C.AIO_BACKENDS)}")
         return AioConfig(
-            block_size=int(get_scalar_param(d, C.AIO_BLOCK_SIZE,
-                                            C.AIO_BLOCK_SIZE_DEFAULT)),
-            queue_depth=int(get_scalar_param(d, C.AIO_QUEUE_DEPTH,
-                                             C.AIO_QUEUE_DEPTH_DEFAULT)),
-            thread_count=int(get_scalar_param(d, C.AIO_THREAD_COUNT,
-                                              C.AIO_THREAD_COUNT_DEFAULT)),
+            block_size=block_size,
+            queue_depth=queue_depth,
+            thread_count=thread_count,
             single_submit=get_scalar_param(d, C.AIO_SINGLE_SUBMIT,
                                            C.AIO_SINGLE_SUBMIT_DEFAULT),
             overlap_events=get_scalar_param(d, C.AIO_OVERLAP_EVENTS,
                                             C.AIO_OVERLAP_EVENTS_DEFAULT),
+            backend=backend,
         )
 
 

@@ -336,6 +336,13 @@ OFFLOAD_PARAM_MAX_IN_CPU = "max_in_cpu"
 OFFLOAD_PARAM_MAX_IN_CPU_DEFAULT = 1_000_000_000
 OFFLOAD_PARAM_PIN_MEMORY = "pin_memory"
 OFFLOAD_PARAM_PIN_MEMORY_DEFAULT = False
+# NVMe swap-in look-ahead for the streaming engine (zero/infinity.py):
+# number of pinned window buffers the step may hold in flight at once —
+# 2 = double buffer (group i computing, group i+1 reading), the carried
+# discipline of PR 7 one tier down; < 2 serializes swap-ins at use.
+# Must fit in buffer_count.
+OFFLOAD_PARAM_PREFETCH_DEPTH = "prefetch_depth"
+OFFLOAD_PARAM_PREFETCH_DEPTH_DEFAULT = 2
 
 OFFLOAD_OPTIMIZER = "offload_optimizer"
 OFFLOAD_OPTIMIZER_DEVICE = "device"
@@ -353,6 +360,12 @@ OFFLOAD_OPTIMIZER_PIPELINE_WRITE_DEFAULT = False
 OFFLOAD_OPTIMIZER_PIPELINE = "pipeline"
 OFFLOAD_OPTIMIZER_FAST_INIT = "fast_init"
 OFFLOAD_OPTIMIZER_FAST_INIT_DEFAULT = False
+# Leaf-pipeline depth of the NVMe optimizer sweep (optimizer_swapper.py):
+# number of rotating (param, exp_avg, exp_avg_sq) buffer triples — depth D
+# overlaps leaf i's Adam with leaf i+1's read and leaf i-(D-1)'s
+# write-back.  >= 2 (the reference PipelinedOptimizerSwapper is depth 2).
+OFFLOAD_OPTIMIZER_PIPELINE_DEPTH = "pipeline_depth"
+OFFLOAD_OPTIMIZER_PIPELINE_DEPTH_DEFAULT = 2
 
 #############################################
 # Async I/O (reference: runtime/swap_tensor/constants.py)
@@ -368,6 +381,20 @@ AIO_SINGLE_SUBMIT = "single_submit"
 AIO_SINGLE_SUBMIT_DEFAULT = False
 AIO_OVERLAP_EVENTS = "overlap_events"
 AIO_OVERLAP_EVENTS_DEFAULT = True
+# Engine selection (this repo's addition — the reference hardwires libaio):
+#   io_uring   kernel SQ/CQ rings, runtime-probed (csrc/aio/uring_aio.cpp)
+#   batched    portable batched-submission preadv/pwritev pool
+#   threadpool the original one-syscall-per-chunk pool
+#   auto       io_uring when available, else batched
+AIO_BACKEND = "backend"
+AIO_BACKEND_AUTO = "auto"
+AIO_BACKEND_IO_URING = "io_uring"
+AIO_BACKEND_BATCHED = "batched"
+AIO_BACKEND_THREADPOOL = "threadpool"
+AIO_BACKENDS = (AIO_BACKEND_AUTO, AIO_BACKEND_IO_URING,
+                AIO_BACKEND_BATCHED, AIO_BACKEND_THREADPOOL)
+AIO_BACKEND_DEFAULT = AIO_BACKEND_AUTO
+AIO_BLOCK_SIZE_MIN = 4096  # O_DIRECT-friendly floor (engines clamp too)
 
 #############################################
 # Activation checkpointing

@@ -79,9 +79,15 @@ class OpBuilder:
             return False
 
     # ------------------------------------------------------------------ #
+    def hash_inputs(self) -> List[str]:
+        """Files whose content keys the build artifact — sources plus any
+        private headers (not passed to the compiler, but staleness-
+        relevant all the same)."""
+        return self.sources()
+
     def _src_hash(self) -> str:
         h = hashlib.sha256()
-        for src in self.sources():
+        for src in self.hash_inputs():
             with open(src, "rb") as f:
                 h.update(f.read())
         h.update(" ".join(self.cxx_flags() + self.ldflags()).encode())
@@ -129,12 +135,22 @@ class CPUAdamBuilder(OpBuilder):
 
 class AsyncIOBuilder(OpBuilder):
     """Async NVMe file I/O engine (reference: op_builder/async_io.py +
-    csrc/aio/)."""
+    csrc/aio/).  Two sources: the portable pool engines (threadpool +
+    batched-submit preadv/pwritev) and the io_uring ring engine, which is
+    compiled everywhere but RUNTIME-probed (ds_uring_probe) — the
+    reference probes libaio at build time (async_io.py:106); io_uring
+    availability is a kernel/sandbox property, so the probe moves to
+    ds_aio_create2 time and aio_handle.py falls back loudly."""
 
     NAME = "async_io"
 
     def sources(self):
-        return [os.path.join(CSRC_DIR, "aio", "host_aio.cpp")]
+        return [os.path.join(CSRC_DIR, "aio", "host_aio.cpp"),
+                os.path.join(CSRC_DIR, "aio", "uring_aio.cpp")]
+
+    def hash_inputs(self):
+        return self.sources() + [os.path.join(CSRC_DIR, "aio",
+                                              "aio_backend.h")]
 
     def ldflags(self):
         return ["-lpthread"]

@@ -1,10 +1,22 @@
-"""ctypes wrapper over the native async file I/O engine.
+"""ctypes wrapper over the native async file I/O engines.
 
 Reference: csrc/aio/py_lib/deepspeed_py_aio_handle.cpp:282 (`aio_handle`
 bound via pybind) with the knobs of runtime/swap_tensor/constants.py —
-block_size, queue_depth, single_submit, overlap_events, thread_count.  Same
-handle API here, backed by csrc/aio/host_aio.cpp (pthread pool + positional
-I/O) and loaded with ctypes via AsyncIOBuilder.
+block_size, queue_depth, single_submit, overlap_events, thread_count —
+plus this repo's `aio.backend` knob selecting the engine behind the same
+pread/pwrite/wait API:
+
+  io_uring   — kernel SQ/CQ rings (csrc/aio/uring_aio.cpp), submissions
+               batched per request, completions reaped in bulk.  Runtime-
+               probed: unavailable on pre-5.1 kernels and under seccomp.
+  batched    — portable batched-submission pool (one preadv/pwritev per
+               queue_depth-segment run; csrc/aio/host_aio.cpp).
+  threadpool — the original one-syscall-per-chunk pool (the aio_sweep
+               baseline).
+  auto       — io_uring when the probe passes, else batched.
+
+Loaded with ctypes via AsyncIOBuilder; falls back to synchronous Python
+file I/O when no native lib builds.
 """
 
 import ctypes
@@ -12,11 +24,20 @@ from typing import Optional
 
 import numpy as np
 
+from ...constants import (AIO_BACKEND_AUTO, AIO_BACKEND_BATCHED,
+                          AIO_BACKEND_IO_URING, AIO_BACKEND_THREADPOOL,
+                          AIO_BACKENDS)
 from ...ops.op_builder import AsyncIOBuilder
 from ...utils.logging import logger
 
 _LIB = None
 _TRIED = False
+
+# native backend ids (csrc/aio/aio_backend.h Backend enum)
+_BACKEND_IDS = {AIO_BACKEND_THREADPOOL: 0,
+                AIO_BACKEND_BATCHED: 1,
+                AIO_BACKEND_IO_URING: 2}
+_URING_FALLBACK_WARNED = False
 
 
 def get_aio_lib():
@@ -31,7 +52,15 @@ def get_aio_lib():
                 lib.ds_aio_create.argtypes = [ctypes.c_int64, ctypes.c_int,
                                               ctypes.c_int, ctypes.c_int,
                                               ctypes.c_int]
+                lib.ds_aio_create2.restype = ctypes.c_void_p
+                lib.ds_aio_create2.argtypes = [ctypes.c_int64, ctypes.c_int,
+                                               ctypes.c_int, ctypes.c_int,
+                                               ctypes.c_int, ctypes.c_int]
                 lib.ds_aio_destroy.argtypes = [ctypes.c_void_p]
+                lib.ds_aio_backend.restype = ctypes.c_int
+                lib.ds_aio_backend.argtypes = [ctypes.c_void_p]
+                lib.ds_uring_probe.restype = ctypes.c_int
+                lib.ds_uring_probe.argtypes = []
                 for fn in (lib.ds_aio_pread, lib.ds_aio_pwrite):
                     fn.restype = ctypes.c_int
                     fn.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
@@ -45,13 +74,59 @@ def get_aio_lib():
     return _LIB
 
 
+def io_uring_available() -> bool:
+    """True when the io_uring syscalls work on this kernel/sandbox."""
+    lib = get_aio_lib()
+    return bool(lib is not None and lib.ds_uring_probe())
+
+
+def resolve_backend(backend: str = AIO_BACKEND_AUTO) -> str:
+    """Map a requested `aio.backend` to the one that will actually run,
+    logging loudly when io_uring was asked for but is unavailable (the
+    config promised NVMe-line-rate submission batching; the host can't
+    deliver it, and silently measuring the fallback would mis-attribute
+    the resulting numbers)."""
+    global _URING_FALLBACK_WARNED
+    if backend not in AIO_BACKENDS:
+        raise ValueError(
+            f"aio.backend={backend!r} — supported: {list(AIO_BACKENDS)}")
+    have_uring = io_uring_available()
+    if backend == AIO_BACKEND_AUTO:
+        return AIO_BACKEND_IO_URING if have_uring else AIO_BACKEND_BATCHED
+    if backend == AIO_BACKEND_IO_URING and not have_uring:
+        if not _URING_FALLBACK_WARNED:
+            _URING_FALLBACK_WARNED = True
+            logger.warning(
+                "aio.backend=io_uring requested but io_uring_setup failed "
+                "on this kernel/sandbox (needs Linux >= 5.1 and a seccomp "
+                "policy that allows it) — falling back to the batched-"
+                "submission pool.  Expect the aio_sweep 'batched' ceiling, "
+                "not the io_uring one.")
+        return AIO_BACKEND_BATCHED
+    return backend
+
+
+def handle_kwargs(aio_config) -> dict:
+    """AsyncIOHandle kwargs from a config.AioConfig — the single place the
+    config block maps onto handle knobs (every swapper builds handles
+    through this, so `aio.backend` reaches all of them)."""
+    if aio_config is None:
+        return {}
+    return dict(block_size=aio_config.block_size,
+                queue_depth=aio_config.queue_depth,
+                single_submit=aio_config.single_submit,
+                overlap_events=aio_config.overlap_events,
+                thread_count=aio_config.thread_count,
+                backend=aio_config.backend)
+
+
 class AsyncIOHandle:
     """One submission context (reference aio_handle).  Python-side fallback
     does synchronous numpy file I/O when the native engine is unavailable."""
 
     def __init__(self, block_size: int = 1048576, queue_depth: int = 8,
                  single_submit: bool = False, overlap_events: bool = True,
-                 thread_count: int = 4):
+                 thread_count: int = 4, backend: str = AIO_BACKEND_AUTO):
         self.block_size = block_size
         self.queue_depth = queue_depth
         self.single_submit = single_submit
@@ -60,24 +135,54 @@ class AsyncIOHandle:
         self._lib = get_aio_lib()
         self._handle = None
         self._sync_completed = 0
+        self.backend = "python"
         if self._lib is not None:
-            self._handle = self._lib.ds_aio_create(
+            resolved = resolve_backend(backend)
+            self._handle = self._lib.ds_aio_create2(
                 block_size, queue_depth, int(single_submit),
-                int(overlap_events), thread_count)
+                int(overlap_events), thread_count, _BACKEND_IDS[resolved])
+            if self._handle is None and resolved == AIO_BACKEND_IO_URING:
+                # probe raced a policy change — same loud fallback
+                logger.warning("io_uring engine creation failed after a "
+                               "successful probe; using the batched pool")
+                resolved = AIO_BACKEND_BATCHED
+                self._handle = self._lib.ds_aio_create2(
+                    block_size, queue_depth, int(single_submit),
+                    int(overlap_events), thread_count,
+                    _BACKEND_IDS[resolved])
+            if self._handle is not None:
+                self.backend = resolved
 
     @property
     def using_native(self) -> bool:
         return self._handle is not None
 
+    @property
+    def backend_name(self) -> str:
+        return self.backend
+
     def _check(self, rc: int, op: str, path: str):
         if rc < 0:
             raise OSError(-rc, f"aio {op} failed for {path}")
+
+    @staticmethod
+    def _check_buffer(buffer: np.ndarray, op: str) -> None:
+        """The engine transfers through the RAW base pointer: a
+        non-contiguous array would be read/filled across its gaps
+        (native) or silently detached into a reshape copy (fallback) —
+        both corrupt data, so reject up front."""
+        if not buffer.flags["C_CONTIGUOUS"]:
+            raise ValueError(
+                f"aio {op} requires a C-contiguous buffer (the engine "
+                "works on the raw pointer); got a strided/fancy view — "
+                "np.ascontiguousarray it first")
 
     def pread(self, buffer: np.ndarray, path: str,
               async_op: bool = False) -> None:
         """Read len(buffer) bytes from path.  With async_op the caller must
         keep `buffer` alive until wait() — the engine reads/writes the raw
         pointer (same contract as the reference's pinned bounce buffers)."""
+        self._check_buffer(buffer, "pread")
         nbytes = buffer.nbytes
         if self._handle is not None:
             rc = self._lib.ds_aio_pread(
@@ -87,12 +192,20 @@ class AsyncIOHandle:
             return
         with open(path, "rb") as f:  # fallback
             data = f.read(nbytes)
+        if len(data) < nbytes:
+            # parity with the native engines' -EIO on short read: a
+            # truncated file (torn write-back) must fail loudly, never
+            # hand back a buffer that is part new data, part stale bytes
+            raise OSError(
+                5, f"aio pread short read for {path}: wanted {nbytes} "
+                   f"bytes, file holds {len(data)}")
         flat = buffer.reshape(-1).view(np.uint8)
-        flat[:len(data)] = np.frombuffer(data, np.uint8)
+        flat[:nbytes] = np.frombuffer(data, np.uint8)
         self._sync_completed += 1
 
     def pwrite(self, buffer: np.ndarray, path: str,
                async_op: bool = False) -> None:
+        self._check_buffer(buffer, "pwrite")
         if self._handle is not None:
             rc = self._lib.ds_aio_pwrite(
                 self._handle, buffer.ctypes.data_as(ctypes.c_void_p),

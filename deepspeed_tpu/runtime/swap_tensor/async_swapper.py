@@ -4,7 +4,12 @@ Reference: runtime/swap_tensor/async_swapper.py:16 (AsyncTensorSwapper):
 gradients/tensors are handed to the swapper, which stages them into
 aligned buffers and writes asynchronously, overlapping with compute;
 callers reclaim buffers at the next synchronization point.
-"""
+
+Each pool buffer gets its OWN submission context, and swap_out returns an
+InflightTensorWrite handle: waiting one write reclaims only its buffer
+instead of draining the whole pool (the wait-at-use pattern the ZeRO-
+Infinity streaming engine had to drop — a shared wait() serializes every
+in-flight neighbour behind the slowest write)."""
 
 from typing import List, Optional, Tuple
 
@@ -14,28 +19,79 @@ from .aio_handle import AsyncIOHandle
 from .utils import SwapBuffer, SwapBufferPool
 
 
+class InflightTensorWrite:
+    """One issued swap_out; wait() lands it and reclaims its buffer."""
+
+    def __init__(self, swapper: "AsyncTensorSwapper", buf: SwapBuffer,
+                 handle: AsyncIOHandle, path: str):
+        self._swapper = swapper
+        self._buf = buf
+        self._handle = handle
+        self.path = path
+        self._done = False
+
+    def wait(self) -> None:
+        if self._done:
+            return
+        try:
+            self._handle.wait()
+        finally:
+            # reclaim the buffer even when the write FAILED — otherwise
+            # an ENOSPC-style error leaks the slot and later swap_outs
+            # wedge on 'pool exhausted' instead of the real I/O error
+            self._done = True
+            self._swapper._retire(self)
+
+    @property
+    def done(self) -> bool:
+        return self._done
+
+
 class AsyncTensorSwapper:
     def __init__(self, handle: AsyncIOHandle, buffer_bytes: int,
                  buffer_count: int = 4):
         self.handle = handle
         self.pool = SwapBufferPool(buffer_bytes, buffer_count)
-        self._inflight: List[SwapBuffer] = []
+        if handle.using_native:
+            # per-buffer submission contexts, cloned from the template
+            # handle's knobs (reference: PipelinedOptimizerSwapper's
+            # dual handles, one per overlap lane)
+            self._handles: List[AsyncIOHandle] = [
+                AsyncIOHandle(block_size=handle.block_size,
+                              queue_depth=handle.queue_depth,
+                              single_submit=handle.single_submit,
+                              overlap_events=handle.overlap_events,
+                              thread_count=handle.thread_count,
+                              backend=handle.backend)
+                for _ in range(buffer_count)]
+        else:  # python sync fallback: sharing is free, writes are eager
+            self._handles = [handle] * buffer_count
+        self._inflight: List[InflightTensorWrite] = []
 
-    def swap_out(self, array: np.ndarray, path: str) -> None:
-        """Stage `array` into a pool buffer and write asynchronously."""
+    def swap_out(self, array: np.ndarray, path: str) -> InflightTensorWrite:
+        """Stage `array` into a pool buffer and write asynchronously;
+        returns the carryable in-flight handle."""
         if self.pool.free_count == 0:
             self.synchronize()
         buf = self.pool.allocate()
-        view = buf.view(array.size, array.dtype)
-        view[...] = array.reshape(-1)
-        self.handle.pwrite(view, path, async_op=True)
-        self._inflight.append(buf)
+        handle = self._handles[self.pool.buffers.index(buf)]
+        try:
+            view = buf.view(array.size, array.dtype)
+            view[...] = array.reshape(-1)
+            handle.pwrite(view, path, async_op=True)
+        except BaseException:
+            self.pool.release(buf)  # submission failed: no leak
+            raise
+        op = InflightTensorWrite(self, buf, handle, path)
+        self._inflight.append(op)
+        return op
+
+    def _retire(self, op: InflightTensorWrite) -> None:
+        if op in self._inflight:
+            self._inflight.remove(op)
+            self.pool.release(op._buf)
 
     def synchronize(self) -> None:
         """Wait for all in-flight writes; reclaim buffers."""
-        if not self._inflight:
-            return
-        self.handle.wait()
-        for buf in self._inflight:
-            self.pool.release(buf)
-        self._inflight.clear()
+        for op in list(self._inflight):
+            op.wait()

@@ -8,37 +8,45 @@ chunk).
 
 TPU recasting: fp32 master params and Adam moments live as per-leaf files
 on local SSD.  One step pipelines over param-tree leaves (the natural
-sub_group analog):
+sub_group analog) at configurable depth D >= 2
+(offload_optimizer.pipeline_depth):
 
-    read(leaf 0) ; for i: [async read leaf i+1] ‖ [host Adam on leaf i]
-                          ‖ [async write-back leaf i-1]
+    prefill D-1 reads ; for i: [async read leaf i+D-1]
+                               ‖ [host Adam on leaf i]
+                               ‖ [async write-back of leaves < i]
 
-with two rotating buffer sets and separate read/write aio handles, so disk
-traffic overlaps the OpenMP Adam math exactly like the reference's
-PipelinedOptimizerSwapper overlaps swaps with the optimizer step.
+with D rotating buffer sets, each with its OWN read/write submission
+contexts — so reusing a set waits only for ITS previous occupant's
+write-back (at depth >= 3 that write has had D-1 Adam sweeps to land),
+exactly the reference PipelinedOptimizerSwapper overlap, one knob deeper.
 """
 
 import os
-from typing import Any, List, Optional
+import time
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
+from ...constants import OFFLOAD_OPTIMIZER_PIPELINE_DEPTH_DEFAULT
 from ...ops.adam.cpu_adam import adam_step_buffers, get_native_lib
 from ...utils.logging import log_dist
-from .aio_handle import AsyncIOHandle
+from .aio_handle import AsyncIOHandle, handle_kwargs
 from .utils import aligned_empty
 
 
 class _BufferSet:
-    """One (param, exp_avg, exp_avg_sq) fp32 buffer triple."""
+    """One (param, exp_avg, exp_avg_sq) fp32 buffer triple with its own
+    read/write submission contexts (per-lane waits)."""
 
-    def __init__(self, num_bytes: int):
+    def __init__(self, num_bytes: int, aio_kw: dict):
         self.p = aligned_empty(num_bytes)
         self.m = aligned_empty(num_bytes)
         self.v = aligned_empty(num_bytes)
+        self.read_handle = AsyncIOHandle(**aio_kw)
+        self.write_handle = AsyncIOHandle(**aio_kw)
 
     def views(self, count: int):
         return self.p[:count], self.m[:count], self.v[:count]
@@ -53,7 +61,8 @@ class NVMeOffloadOptimizer:
                  optimizer_params: Optional[dict] = None,
                  gradient_clipping: float = 0.0,
                  aio_config=None, pipeline_read: bool = True,
-                 pipeline_write: bool = True):
+                 pipeline_write: bool = True,
+                 pipeline_depth: int = OFFLOAD_OPTIMIZER_PIPELINE_DEPTH_DEFAULT):
         name = (optimizer_name or "adam").lower()
         if name not in ("adam", "adamw"):
             raise ValueError(
@@ -69,21 +78,20 @@ class NVMeOffloadOptimizer:
         self.gradient_clipping = float(gradient_clipping or 0.0)
         self.pipeline_read = pipeline_read
         self.pipeline_write = pipeline_write
+        self.pipeline_depth = max(2, int(pipeline_depth))
         self._step = 0
         self._lib = get_native_lib()
+        self.last_sweep_stats: Optional[Dict[str, float]] = None
 
         os.makedirs(swap_dir, exist_ok=True)
         self.swap_dir = swap_dir
 
-        kw = {}
-        if aio_config is not None:
-            kw = dict(block_size=aio_config.block_size,
-                      queue_depth=aio_config.queue_depth,
-                      single_submit=aio_config.single_submit,
-                      overlap_events=aio_config.overlap_events,
-                      thread_count=aio_config.thread_count)
-        # Separate read/write submission contexts so waits don't serialize
-        # the pipeline (reference PipelinedOptimizerSwapper dual handles).
+        kw = handle_kwargs(aio_config)
+        self._aio_kw = kw
+        # Control-plane submission contexts (init/gather/checkpoint); the
+        # sweep's per-set handles live on each _BufferSet so waits don't
+        # serialize the pipeline (reference PipelinedOptimizerSwapper dual
+        # handles, one pair per rotating set here).
         self.read_handle = AsyncIOHandle(**kw)
         self.write_handle = AsyncIOHandle(**kw)
 
@@ -121,11 +129,13 @@ class NVMeOffloadOptimizer:
                 self._ram_leaves.append(np.array(arr, copy=True))
         self.write_handle.wait()
         del pinned
-        self._bufs = (_BufferSet(max_bytes), _BufferSet(max_bytes))
+        self._bufs = [_BufferSet(max_bytes, kw)
+                      for _ in range(self.pipeline_depth)]
         total = sum(self._sizes)
         log_dist(
             f"ZeRO-Infinity: {total} fp32 params + 2x moments on NVMe at "
-            f"{swap_dir} (native_aio={self.read_handle.using_native}, "
+            f"{swap_dir} (aio_backend={self.read_handle.backend_name}, "
+            f"pipeline_depth={self.pipeline_depth}, "
             f"native_adam={self._lib is not None})", ranks=[0])
 
     # ------------------------------------------------------------------ #
@@ -141,10 +151,10 @@ class NVMeOffloadOptimizer:
     def _read_leaf(self, i: int, bufs: _BufferSet, async_op: bool):
         n = self._sizes[i]
         p, m, v = bufs.views(n)
-        self.read_handle.pread(p, self._path(i, "param"), async_op=async_op)
-        self.read_handle.pread(m, self._path(i, "exp_avg"),
+        bufs.read_handle.pread(p, self._path(i, "param"), async_op=async_op)
+        bufs.read_handle.pread(m, self._path(i, "exp_avg"),
                                async_op=async_op)
-        self.read_handle.pread(v, self._path(i, "exp_avg_sq"),
+        bufs.read_handle.pread(v, self._path(i, "exp_avg_sq"),
                                async_op=async_op)
         if not async_op:
             pass  # pread(async_op=False) already waited per call
@@ -152,11 +162,11 @@ class NVMeOffloadOptimizer:
     def _write_leaf(self, i: int, bufs: _BufferSet, async_op: bool):
         n = self._sizes[i]
         p, m, v = bufs.views(n)
-        self.write_handle.pwrite(p, self._path(i, "param"),
+        bufs.write_handle.pwrite(p, self._path(i, "param"),
                                  async_op=async_op)
-        self.write_handle.pwrite(m, self._path(i, "exp_avg"),
+        bufs.write_handle.pwrite(m, self._path(i, "exp_avg"),
                                  async_op=async_op)
-        self.write_handle.pwrite(v, self._path(i, "exp_avg_sq"),
+        bufs.write_handle.pwrite(v, self._path(i, "exp_avg_sq"),
                                  async_op=async_op)
 
     # ------------------------------------------------------------------ #
@@ -209,19 +219,41 @@ class NVMeOffloadOptimizer:
 
         self._step += 1
         out: List[Optional[np.ndarray]] = list(self._ram_leaves)
+        stats = {"read_wait_s": 0.0, "write_wait_s": 0.0, "adam_s": 0.0,
+                 "wall_s": 0.0, "leaves": float(len(idxs)),
+                 "bytes_read": 0.0, "bytes_written": 0.0,
+                 "pipeline_depth": float(self.pipeline_depth)}
+        t_wall = time.perf_counter()
         if idxs:
-            cur, nxt = self._bufs
-            self._read_leaf(idxs[0], cur, async_op=True)
-            self.read_handle.wait()
+            D = self.pipeline_depth
+            nleaves = len(idxs)
+
+            def issue_read(j: int) -> None:
+                s = self._bufs[j % D]
+                if j >= D:
+                    # the set's previous occupant (leaf j-D) issued its
+                    # write-back from these buffers — it must land before
+                    # the read overwrites them.  At depth >= 3 that write
+                    # has had D-1 Adam sweeps of runway.
+                    t0 = time.perf_counter()
+                    s.write_handle.wait()
+                    stats["write_wait_s"] += time.perf_counter() - t0
+                self._read_leaf(idxs[j], s, async_op=True)
+                stats["bytes_read"] += 12 * self._sizes[idxs[j]]
+
+            # prefill: D-1 reads in flight before the first Adam
+            for j in range(min(D - 1, nleaves)):
+                issue_read(j)
             for pos, i in enumerate(idxs):
-                has_next = pos + 1 < len(idxs)
-                if has_next:
-                    # Reusing `nxt` requires its write-back (leaf pos-1) to
-                    # have landed.
-                    self.write_handle.wait()
-                    self._read_leaf(idxs[pos + 1], nxt, async_op=True)
+                if pos + D - 1 < nleaves:
+                    issue_read(pos + D - 1)
+                s = self._bufs[pos % D]
+                t0 = time.perf_counter()
+                s.read_handle.wait()
+                stats["read_wait_s"] += time.perf_counter() - t0
                 n = self._sizes[i]
-                p, m, v = cur.views(n)
+                p, m, v = s.views(n)
+                t0 = time.perf_counter()
                 if store_dtype == jnp.bfloat16:
                     bf16 = np.empty(n, np.uint16)
                     adam_step_buffers(
@@ -243,12 +275,16 @@ class NVMeOffloadOptimizer:
                     dt = np.dtype(store_dtype)
                     out[i] = (p.copy() if dt == np.float32
                               else p.astype(dt)).reshape(self._shapes[i])
+                stats["adam_s"] += time.perf_counter() - t0
                 g_float.pop(i, None)  # free this grad leaf (boxed callers)
-                self._write_leaf(i, cur, async_op=True)
-                if has_next:
-                    self.read_handle.wait()
-                cur, nxt = nxt, cur
-            self.write_handle.wait()
+                self._write_leaf(i, s, async_op=True)
+                stats["bytes_written"] += 12 * n
+            t0 = time.perf_counter()
+            for s in self._bufs:
+                s.write_handle.wait()
+            stats["write_wait_s"] += time.perf_counter() - t0
+        stats["wall_s"] = time.perf_counter() - t_wall
+        self.last_sweep_stats = stats
         return jax.tree_util.tree_unflatten(self._treedef, out)
 
     # ------------------------------------------------------------------ #
@@ -321,4 +357,5 @@ def create_nvme_offload_optimizer(model_parameters, config,
         optimizer_params=config.optimizer_params,
         gradient_clipping=gradient_clipping,
         aio_config=config.aio_config,
-        pipeline_read=oo.pipeline_read, pipeline_write=oo.pipeline_write)
+        pipeline_read=oo.pipeline_read, pipeline_write=oo.pipeline_write,
+        pipeline_depth=oo.pipeline_depth)

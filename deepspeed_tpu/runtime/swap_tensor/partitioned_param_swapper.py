@@ -11,12 +11,20 @@ param pytree, or the embed/head chains) — the natural streaming granule of
 the layer-streaming engine (runtime/zero/infinity.py), playing the role the
 reference's per-param ds_tensor handles play.  Groups are flat compute-dtype
 files on local SSD; a fixed window of io-aligned host buffers (reference:
-pinned buffer pool, utils.py:95) absorbs async reads, and `prefetch` lets
-the engine overlap the next group's disk read with the current group's
-device compute.
+pinned buffer pool, utils.py:95) absorbs async reads.
+
+`swap_in(name)` is the in-flight contract the streaming engine carries:
+it issues the async read immediately and returns an InflightGroupRead
+whose wait() completes ONLY that group's window slot — so the engine can
+hold group i+1's read in its loop carry while group i computes (the PR 7
+carried-double-buffer discipline, one tier down), and the handle's
+issue/wait timestamps make the achieved overlap measurable instead of
+assumed.  `prefetch`/`get` remain as the fire-and-forget veneer over the
+same machinery.
 """
 
 import os
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -24,7 +32,7 @@ import numpy as np
 import jax
 
 from ...utils.logging import log_dist
-from .aio_handle import AsyncIOHandle
+from .aio_handle import AsyncIOHandle, handle_kwargs
 from .utils import aligned_empty
 
 
@@ -62,11 +70,46 @@ class _Group:
         return self.treedef.unflatten(leaves)
 
 
+class InflightGroupRead:
+    """One issued swap-in.  wait() blocks only on THIS group's window slot
+    and returns the host tree; the issue→wait timestamps split the read's
+    wall time into `hidden_s` (elapsed before the caller needed it — the
+    window the disk had to work under compute) and `exposed_s` (time the
+    caller actually blocked — serialized swap-in time)."""
+
+    def __init__(self, swapper: "PartitionedParamSwapper", name: str):
+        self.swapper = swapper
+        self.name = name
+        self.nbytes = swapper.groups[name].nbytes
+        self.t_issue = time.perf_counter()
+        self.hidden_s: Optional[float] = None
+        self.exposed_s: Optional[float] = None
+        self._tree = None
+
+    @property
+    def done(self) -> bool:
+        return self._tree is not None
+
+    def wait(self, copy: bool = True) -> Any:
+        if self._tree is None:
+            t0 = time.perf_counter()
+            self._tree = self.swapper.get(self.name, copy=copy)
+            t1 = time.perf_counter()
+            self.hidden_s = t0 - self.t_issue
+            self.exposed_s = t1 - t0
+            st = self.swapper.stats
+            st["read_bytes"] += self.nbytes
+            st["read_hidden_s"] += self.hidden_s
+            st["read_exposed_s"] += self.exposed_s
+        return self._tree
+
+
 class PartitionedParamSwapper:
     """Pages named parameter groups between NVMe files and a host window.
 
     API (mirroring the reference swapper's swap_in/swap_out lifecycle):
       write(name, tree)      — (over)write a group's file from host values
+      swap_in(name) -> h     — issue async read NOW, carry the handle
       get(name) -> tree      — group's params as host arrays (reads if not
                                resident; completes any pending prefetch)
       prefetch(name)         — async read into a window buffer
@@ -80,13 +123,7 @@ class PartitionedParamSwapper:
         self.swap_dir = swap_dir
         self.groups = {name: _Group(name, tree)
                        for name, tree in groups.items()}
-        kw = {}
-        if aio_config is not None:
-            kw = dict(block_size=aio_config.block_size,
-                      queue_depth=aio_config.queue_depth,
-                      single_submit=aio_config.single_submit,
-                      overlap_events=aio_config.overlap_events,
-                      thread_count=aio_config.thread_count)
+        kw = handle_kwargs(aio_config)
         self.write_handle = AsyncIOHandle(**kw)
         max_bytes = max(g.nbytes for g in self.groups.values())
         self.buffer_count = max(2, int(buffer_count))
@@ -103,10 +140,16 @@ class PartitionedParamSwapper:
         self._pending: Dict[str, int] = {}      # name -> buffer idx (reading)
         self._lru: List[str] = []
         self._inflight_writes: List[np.ndarray] = []
+        # cumulative I/O accounting, drained by the engine per step
+        # (snapshot_stats); hidden/exposed come from InflightGroupRead
+        self.stats: Dict[str, float] = {
+            "read_bytes": 0.0, "read_hidden_s": 0.0, "read_exposed_s": 0.0,
+            "prefetch_hits": 0.0, "serialized_reads": 0.0,
+            "write_bytes": 0.0, "write_wait_s": 0.0}
         log_dist(
             f"ZeRO-Infinity param swapper: {len(self.groups)} groups, "
             f"window={self.buffer_count} x {max_bytes >> 20}MiB at "
-            f"{swap_dir} (native_aio={self.write_handle.using_native})",
+            f"{swap_dir} (aio_backend={self.write_handle.backend_name})",
             ranks=[0])
 
     # ------------------------------------------------------------------ #
@@ -116,6 +159,14 @@ class PartitionedParamSwapper:
     @property
     def resident_groups(self) -> List[str]:
         return list(self._resident) + list(self._pending)
+
+    def snapshot_stats(self) -> Dict[str, float]:
+        """Return-and-reset the cumulative I/O counters (per-step window
+        accounting in the streaming engine)."""
+        snap = dict(self.stats)
+        for k in self.stats:
+            self.stats[k] = 0.0
+        return snap
 
     def _evict_for(self, name: str) -> int:
         if self._free:
@@ -131,9 +182,22 @@ class PartitionedParamSwapper:
             f"pending={list(self._pending)}) — raise "
             f"offload_param.buffer_count")
 
+    def _complete_pending(self, name: str) -> None:
+        """Finish an in-flight read of `name` (slot becomes resident)."""
+        idx = self._pending.pop(name)
+        self._read_handles[idx].wait()   # only THIS slot's read
+        self._resident[name] = idx
+        self._lru.append(name)
+
     # ------------------------------------------------------------------ #
     def write(self, name: str, tree: Any, async_op: bool = False) -> None:
         g = self.groups[name]
+        # a pending read of this group streams from the very file the
+        # pwrite below will truncate — complete it first or the reader
+        # sees a torn mix of old and new bytes (the in-flight-buffer
+        # contract of aio_handle.py, enforced rather than assumed)
+        if name in self._pending:
+            self._complete_pending(name)
         flat = g.flatten(tree)
         if name in self._resident:      # keep the window coherent
             idx = self._resident[name]
@@ -142,11 +206,14 @@ class PartitionedParamSwapper:
         # (the reference pins its bounce buffers for the same reason)
         self._inflight_writes.append(flat)
         self.write_handle.pwrite(flat, self._path(name), async_op=async_op)
+        self.stats["write_bytes"] += g.nbytes
         if not async_op:
             self.flush_writes()
 
     def flush_writes(self) -> None:
+        t0 = time.perf_counter()
         self.write_handle.wait()
+        self.stats["write_wait_s"] += time.perf_counter() - t0
         self._inflight_writes.clear()
 
     def prefetch(self, name: str) -> None:
@@ -158,6 +225,11 @@ class PartitionedParamSwapper:
         self._read_handles[idx].pread(buf, self._path(name), async_op=True)
         self._pending[name] = idx
 
+    def swap_in(self, name: str) -> InflightGroupRead:
+        """Issue the group's read NOW and return the carryable handle."""
+        self.prefetch(name)
+        return InflightGroupRead(self, name)
+
     def get(self, name: str, copy: bool = True) -> Any:
         """Group params as host arrays.  copy=True (default) detaches the
         result from the window buffer — callers hand these to async
@@ -167,11 +239,12 @@ class PartitionedParamSwapper:
         synchronous consumers."""
         g = self.groups[name]
         if name in self._pending:
-            idx = self._pending.pop(name)
-            self._read_handles[idx].wait()   # only THIS slot's read
-            self._resident[name] = idx
-            self._lru.append(name)
+            self._complete_pending(name)
+            self.stats["prefetch_hits"] += 1
         elif name not in self._resident:
+            # no read in flight: the caller pays the full disk latency
+            # inline — the serialized swap-in the prefetch exists to hide
+            self.stats["serialized_reads"] += 1
             idx = self._evict_for(name)
             buf = self._buffers[idx][:g.nbytes]
             self._read_handles[idx].pread(buf, self._path(name),
@@ -189,10 +262,7 @@ class PartitionedParamSwapper:
 
     def release(self, name: str) -> None:
         if name in self._pending:
-            idx = self._pending.pop(name)
-            self._read_handles[idx].wait()
-            self._resident[name] = idx
-            self._lru.append(name)
+            self._complete_pending(name)
         if name in self._resident:
             self._free.append(self._resident.pop(name))
             if name in self._lru:

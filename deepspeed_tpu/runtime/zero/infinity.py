@@ -31,8 +31,10 @@ layer / head-loss functions.  `deepspeed_tpu.initialize` dispatches here
 when `zero_optimization.offload_param` is configured on such a model.
 """
 
+import json
 import os
-from typing import Any, Dict, Optional
+import time
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -43,6 +45,65 @@ from ...config import DeepSpeedConfig
 from ...utils.logging import log_dist
 from ...utils.timer import ThroughputTimer
 from ..engine import resolve_mesh_ctx
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+SWEEP_RESULTS_PATH = os.environ.get(
+    "DS_AIO_SWEEP_RESULTS",
+    os.path.join(_REPO_ROOT, "benchmarks", "aio_sweep_results.txt"))
+
+
+def load_sweep_ceiling(backend: str,
+                       path: str = None) -> Optional[Dict[str, float]]:
+    """Measured read/write GB/s ceiling for `backend` from the aio sweep
+    artifact (benchmarks/aio_sweep_results.txt `aio_best_config` line) —
+    the denominator of the engine's achieved-bytes/s honesty report.
+    Returns None when no sweep has been run on this host."""
+    path = path or SWEEP_RESULTS_PATH
+    best = None
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if row.get("metric") == "aio_best_config":
+                    best = row  # later lines win (append-only artifact)
+    except OSError:
+        return None
+    if best is None:
+        return None
+    ceilings = best.get("ceilings")
+    if ceilings is not None:
+        if backend in ceilings:
+            return {"read_gbps": float(ceilings[backend]["read_gbps"]),
+                    "write_gbps": float(ceilings[backend]["write_gbps"])}
+        # the sweep never measured THIS backend — no ceiling, rather
+        # than another backend's number as a false denominator
+        return None
+    # pre-backend-axis artifact: one global best
+    if "read_gbps" in best:
+        return {"read_gbps": float(best["read_gbps"]),
+                "write_gbps": float(best["write_gbps"])}
+    return None
+
+
+class _HostFetch:
+    """swap_in handle for host-RAM parameter groups (no NVMe tier): the
+    'read' is free, so it is all hidden and zero bytes."""
+
+    def __init__(self, tree):
+        self._tree = tree
+        self.nbytes = 0
+        self.hidden_s = 0.0
+        self.exposed_s = 0.0
+
+    def wait(self, copy: bool = True):
+        return self._tree
 
 
 class ZeroInfinityEngine:
@@ -104,6 +165,11 @@ class ZeroInfinityEngine:
             str(np.asarray(a).dtype) == "bfloat16" else np.asarray(a),
             model_parameters))
         self._use_nvme_params = op is not None and op.device == "nvme"
+        # swap-in look-ahead: how many window buffers the sweeps may hold
+        # in flight (2 = double buffer; < 2 serializes reads at use).
+        # Validated against buffer_count at the config boundary.
+        self._prefetch_depth = (int(op.prefetch_depth)
+                                if op is not None else 0)
         if self._use_nvme_params:
             from ..swap_tensor.partitioned_param_swapper import (
                 PartitionedParamSwapper)
@@ -117,6 +183,7 @@ class ZeroInfinityEngine:
             for name, tree in groups_compute.items():
                 self._swapper.write(name, tree, async_op=True)
             self._swapper.flush_writes()
+            self._swapper.snapshot_stats()  # init writes are not step I/O
             self._host_groups = None
         else:
             self._swapper = None
@@ -195,6 +262,23 @@ class ZeroInfinityEngine:
         self._last_loss = None
         self.max_live_param_groups = 0
         self._live_now = 0
+        # cross-sweep carries: each sweep's FIRST swap-in is issued at the
+        # tail of the adjacent sweep (backward's first group under the
+        # head compute, next forward's embed under the optimizer sweep) —
+        # without these the first read of every sweep is structurally
+        # serialized
+        self._fwd_carry = None
+        self._bwd_carry = None
+        # ---- swap-overlap accounting (per optimizer-step window) ----- #
+        self._swap_events: List[Dict[str, float]] = []
+        self._step_t0: Optional[float] = None
+        self.last_swap_stats: Optional[Dict[str, Any]] = None
+        self.serialized_swap_steps = 0
+        backend = (self._swapper.write_handle.backend_name
+                   if self._swapper is not None else "none")
+        self.aio_backend = backend
+        self.sweep_ceiling = (load_sweep_ceiling(backend)
+                              if self._swapper is not None else None)
         self.tput_timer = ThroughputTimer(
             batch_size=self.config.train_micro_batch_size_per_gpu,
             num_workers=dp,
@@ -205,7 +289,11 @@ class ZeroInfinityEngine:
             f"ZeroInfinityEngine: {n_params:,} params in "
             f"{len(self._order)} streamed groups, params_on="
             f"{'nvme' if self._use_nvme_params else 'host'}, "
-            f"optimizer={type(self._opt).__name__}", ranks=[0])
+            f"optimizer={type(self._opt).__name__}, "
+            f"aio_backend={self.aio_backend}, "
+            f"prefetch_depth={self._prefetch_depth}"
+            + (f", sweep_ceiling={self.sweep_ceiling['read_gbps']:.2f}GB/s "
+               "read" if self.sweep_ceiling else ""), ranks=[0])
 
     # ------------------------------------------------------------------ #
     def _configure_dataloader(self, training_data, collate_fn):
@@ -261,14 +349,6 @@ class ZeroInfinityEngine:
             return self._swapper.get(name)
         return self._host_groups[name]
 
-    def _fetch_device(self, name: str):
-        """Host/NVMe -> HBM upload of one group (async dispatch)."""
-        tree = self._group_host(name)
-        self._live_now += 1
-        self.max_live_param_groups = max(self.max_live_param_groups,
-                                         self._live_now)
-        return jax.tree.map(jnp.asarray, tree)
-
     def _release_device(self, ref):
         """Callers MUST rebind: ``p = self._release_device(p)`` — deleting a
         local alias alone would keep the device arrays alive and push peak
@@ -277,9 +357,59 @@ class ZeroInfinityEngine:
         del ref
         return None
 
-    def _prefetch(self, name: str) -> None:
+    # ---- carried swap-in machinery ----------------------------------- #
+    # The sweeps walk a fetch PLAN (ordered group names).  _take(pos)
+    # first issues the next prefetch_depth-1 plan positions' NVMe reads,
+    # THEN waits for position pos — so group i+1's disk read runs while
+    # group i's wait returns (usually instantly, read done under the
+    # previous group's compute) and its jitted compute dispatches.  The
+    # in-flight handles live in `inflight`, the sweep's carry — the PR 7
+    # carried-double-buffer discipline one tier down, with two (or
+    # prefetch_depth) pinned window buffers instead of HBM gather slots.
+
+    def _swap_in(self, name: str):
         if self._swapper is not None:
-            self._swapper.prefetch(name)
+            return self._swapper.swap_in(name)
+        return _HostFetch(self._host_groups[name])
+
+    def _sweep_state(self, plan: List[str]):
+        return {"plan": plan, "inflight": {}}
+
+    def _take(self, st, pos: int, extra: int = 0):
+        """Device params for plan position `pos`; issues the look-ahead.
+        `extra` widens it when upcoming positions are consumed by ONE
+        compute (the head + tied-embed pair) — without it the pair's
+        second read could only start after the first's wait."""
+        plan, inflight = st["plan"], st["inflight"]
+        if self._prefetch_depth >= 2:
+            for k in range(pos, min(pos + self._prefetch_depth, len(plan))):
+                if k not in inflight:
+                    inflight[k] = self._swap_in(plan[k])
+        handle = inflight.pop(pos, None)
+        if handle is None:
+            # prefetch disabled (or depth exhausted): pay the read inline
+            handle = self._swap_in(plan[pos])
+        tree = handle.wait()
+        if self._prefetch_depth >= 2 and extra:
+            # the widened tail issues AFTER the wait: `tree` is a detached
+            # copy, so pos's window slot is evictable and the pair fits
+            # even in a two-buffer window
+            ahead = self._prefetch_depth + extra
+            for k in range(pos + 1, min(pos + ahead, len(plan))):
+                if k not in inflight:
+                    inflight[k] = self._swap_in(plan[k])
+        if handle.nbytes:
+            self._swap_events.append({
+                "name": plan[pos], "bytes": float(handle.nbytes),
+                "hidden_s": handle.hidden_s, "exposed_s": handle.exposed_s})
+        self._live_now += 1
+        self.max_live_param_groups = max(self.max_live_param_groups,
+                                         self._live_now)
+        return jax.tree.map(jnp.asarray, tree)
+
+    def _release_group(self, name: str) -> None:
+        if self._swapper is not None:
+            self._swapper.release(name)
 
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)
@@ -297,44 +427,57 @@ class ZeroInfinityEngine:
         """Stream groups forward; returns the loss.  The head runs fused
         with value_and_grad so backward() starts with the cotangent ready
         (the reference's PreBackwardFunction re-fetch begins the same way,
-        stage3.py:546)."""
+        stage3.py:546).
+
+        The fetch plan is carried: _take(i) issues layer i+1's (and, at
+        depth > 2, further) NVMe reads BEFORE waiting on layer i, so the
+        disk streams the next group while this group's compute holds the
+        device — swap-in latency hides under MXU work instead of
+        serializing the sweep."""
         self.tput_timer.start()
+        if self._step_t0 is None:
+            self._step_t0 = time.perf_counter()
         self._t("fwd start")
         rng = self._next_rng() if self._is_dropout_mode() else None
         ids = jnp.asarray(input_ids)
         lbl = None if labels is None else jnp.asarray(labels)
 
-        embed_g = self._fetch_device("embed")
+        plan = (["embed"] + [f"layer{i}" for i in range(self.num_layers)]
+                + ["head", "embed"])
+        st = self._sweep_state(plan)
+        if self._fwd_carry is not None:     # issued under the last step()
+            st["inflight"][0] = self._fwd_carry
+            self._fwd_carry = None
+        embed_g = self._take(st, 0)
         h = self._jit_embed(embed_g, ids, rng)
         acts = [h]
         # release the embed group during the layer sweep — the head step
         # re-fetches it (tied wte); peak device residency stays at 2 groups
         embed_g = self._release_device(embed_g)
-        if self._swapper is not None:
-            self._swapper.release("embed")
-        self._prefetch("layer0")
+        self._release_group("embed")
         for i in range(self.num_layers):
-            if i + 1 < self.num_layers:
-                self._prefetch(f"layer{i + 1}")
-            else:
-                self._prefetch("head")
-            p = self._fetch_device(f"layer{i}")
+            # on the last layer the look-ahead covers BOTH head groups —
+            # jit_head consumes head + tied embed in one compute, so the
+            # pair must stream together under this layer's window
+            extra = 1 if i == self.num_layers - 1 else 0
+            p = self._take(st, 1 + i, extra=extra)
             h = self._jit_layer(p, h, rng, jnp.int32(i))
             acts.append(h)
             p = self._release_device(p)
-            if self._swapper is not None:
-                self._swapper.release(f"layer{i}")
+            self._release_group(f"layer{i}")
 
         self._t("fwd layers done")
-        head_g = self._fetch_device("head")
-        embed_g = self._fetch_device("embed")
+        head_g = self._take(st, 1 + self.num_layers)
+        embed_g = self._take(st, 2 + self.num_layers)
         loss, (g_head, g_embed_head, dh) = self._jit_head(
             head_g, embed_g, h, ids, lbl)
         head_g = self._release_device(head_g)
         embed_g = self._release_device(embed_g)
-        if self._swapper is not None:
-            self._swapper.release("head")
-            self._swapper.release("embed")
+        self._release_group("head")
+        self._release_group("embed")
+        if self._prefetch_depth >= 2 and self._swapper is not None:
+            # backward's first group streams in under the head compute
+            self._bwd_carry = self._swap_in(f"layer{self.num_layers - 1}")
         self._t("fwd head done")
         self._acts = acts
         self._pending = {"rng": rng, "ids": ids, "dh": dh,
@@ -386,13 +529,14 @@ class ZeroInfinityEngine:
 
         self._t("bwd start")
         inflight = start_copy("head", pend["g_head"])
-        self._prefetch(f"layer{self.num_layers - 1}")
-        for i in reversed(range(self.num_layers)):
-            if i > 0:
-                self._prefetch(f"layer{i - 1}")
-            else:
-                self._prefetch("embed")
-            p = self._fetch_device(f"layer{i}")
+        plan = ([f"layer{i}" for i in reversed(range(self.num_layers))]
+                + ["embed"])
+        st = self._sweep_state(plan)
+        if self._bwd_carry is not None:     # issued under the head compute
+            st["inflight"][0] = self._bwd_carry
+            self._bwd_carry = None
+        for pos, i in enumerate(reversed(range(self.num_layers))):
+            p = self._take(st, pos)
             gp, dh = self._jit_layer_vjp(p, acts[i], dh, rng, jnp.int32(i))
             # materialize the PREVIOUS group (its async copy overlapped
             # this vjp's dispatch) before starting the next copy — one
@@ -400,11 +544,10 @@ class ZeroInfinityEngine:
             acc(*inflight)
             inflight = start_copy(f"layer{i}", gp)
             p = self._release_device(p)
-            if self._swapper is not None:
-                self._swapper.release(f"layer{i}")
+            self._release_group(f"layer{i}")
             self._t(f"bwd layer{i} done")
 
-        embed_g = self._fetch_device("embed")
+        embed_g = self._take(st, self.num_layers)
         g_embed = self._jit_embed_vjp(embed_g, ids, dh, rng)
         g_embed = jax.tree.map(jnp.add, g_embed,
                                jax.tree.map(jnp.asarray,
@@ -412,8 +555,12 @@ class ZeroInfinityEngine:
         acc(*inflight)
         acc("embed", g_embed)
         embed_g = self._release_device(embed_g)
-        if self._swapper is not None:
-            self._swapper.release("embed")
+        self._release_group("embed")
+        if self._prefetch_depth >= 2 and self._swapper is not None:
+            # next forward's embed streams in under the optimizer sweep
+            # (write() keeps the pending slot coherent when the step
+            # rewrites the group's file)
+            self._fwd_carry = self._swap_in("embed")
         self._acts = None
         self._pending = None
         self.micro_steps += 1
@@ -465,9 +612,86 @@ class ZeroInfinityEngine:
             self.skipped_steps += 1
         self.global_steps += 1
         self.tput_timer.stop(global_step=True)
+        self._finalize_swap_stats()
         if self.global_steps % self.config.steps_per_print == 0:
+            stats = self.last_swap_stats or {}
+            extra = ""
+            if stats.get("read_bytes"):
+                extra = (f", swap_read={stats['read_gbps']:.2f}GB/s"
+                         + (f" ({stats['read_vs_ceiling']:.0%} of sweep "
+                            "ceiling)" if stats.get("read_vs_ceiling")
+                            is not None else "")
+                         + f", overlap={stats['overlap_fraction']:.0%}")
             log_dist(f"step={self.global_steps}, "
-                     f"loss={float(self._last_loss):.6f}", ranks=[0])
+                     f"loss={float(self._last_loss):.6f}{extra}", ranks=[0])
+
+    # ------------------------------------------------------------------ #
+    def _finalize_swap_stats(self):
+        """Fold the step window's swap-in handle timings into the honesty
+        report: achieved bytes/s (lower bound — per-group issue->done
+        windows), the bytes-weighted overlap fraction (how much of the
+        swap traffic hid under compute), and the serialized-swap-in
+        finding (auditor-style WARNING: prefetch was configured but a
+        group's read was paid inline on the critical path)."""
+        events, self._swap_events = self._swap_events, []
+        t0, self._step_t0 = self._step_t0, None
+        if self._swapper is None:
+            self.last_swap_stats = None
+            return
+        io = self._swapper.snapshot_stats()
+        read_bytes = sum(e["bytes"] for e in events)
+        hidden_s = sum(e["hidden_s"] for e in events)
+        exposed_s = sum(e["exposed_s"] for e in events)
+        overlap_bytes = sum(
+            e["bytes"] * (e["hidden_s"] / (e["hidden_s"] + e["exposed_s"]))
+            for e in events if e["hidden_s"] + e["exposed_s"] > 0)
+        serialized = [e["name"] for e in events
+                      if e["exposed_s"] > max(e["hidden_s"], 1e-4)]
+        window_s = hidden_s + exposed_s
+        stats: Dict[str, Any] = {
+            "aio_backend": self.aio_backend,
+            "prefetch_depth": self._prefetch_depth,
+            "read_bytes": read_bytes,
+            "read_exposed_s": exposed_s,
+            "read_hidden_s": hidden_s,
+            # lower bound: per-group issue->done windows overlap each
+            # other at depth > 2, so the true device-side rate is >= this
+            "read_gbps": (read_bytes / window_s / 1e9) if window_s else 0.0,
+            "overlap_bytes": overlap_bytes,
+            "overlap_fraction": (overlap_bytes / read_bytes
+                                 if read_bytes else 1.0),
+            "serialized_swap_ins": serialized,
+            "serialized_reads_inline": io.get("serialized_reads", 0.0),
+            "write_bytes": io.get("write_bytes", 0.0),
+            "write_exposed_s": io.get("write_wait_s", 0.0),
+            "step_wall_s": (time.perf_counter() - t0) if t0 else 0.0,
+        }
+        if self.sweep_ceiling is not None and stats["read_gbps"]:
+            stats["sweep_read_gbps"] = self.sweep_ceiling["read_gbps"]
+            stats["read_vs_ceiling"] = (stats["read_gbps"] /
+                                        self.sweep_ceiling["read_gbps"])
+        else:
+            stats["read_vs_ceiling"] = None
+        opt_stats = getattr(self._opt, "last_sweep_stats", None)
+        if opt_stats is not None:
+            stats["optimizer_sweep"] = dict(opt_stats)
+        if serialized and self._prefetch_depth >= 2:
+            self.serialized_swap_steps += 1
+            log_dist(
+                f"[infinity-schedule] WARNING: {len(serialized)} serialized "
+                f"swap-in(s) this step ({', '.join(serialized[:4])}"
+                f"{'...' if len(serialized) > 4 else ''}) — the NVMe read "
+                "was paid on the critical path despite prefetch_depth="
+                f"{self._prefetch_depth}.  The disk is slower than the "
+                "per-group compute window; raise the group size, deepen "
+                "the prefetch, or check the aio backend "
+                f"({self.aio_backend}) against the sweep ceiling.",
+                ranks=[0])
+        self.last_swap_stats = stats
+
+    def swap_stats(self) -> Optional[Dict[str, Any]]:
+        """Swap-overlap report for the last completed optimizer step."""
+        return self.last_swap_stats
 
     # ------------------------------------------------------------------ #
     def module_state_dict(self):

@@ -2,6 +2,8 @@
 tests/unit/test_aio.py:335 single/parallel read-write; ZeRO-Infinity step
 behavior from stage3.py:2777)."""
 
+import gc
+
 import numpy as np
 import pytest
 
@@ -9,15 +11,225 @@ import jax
 import jax.numpy as jnp
 
 import deepspeed_tpu as ds
+from deepspeed_tpu.constants import AIO_BACKENDS
 from deepspeed_tpu.runtime.swap_tensor import (AsyncIOHandle,
                                                AsyncTensorSwapper,
                                                NVMeOffloadOptimizer,
-                                               SwapBufferPool, aligned_empty)
+                                               SwapBufferPool, aligned_empty,
+                                               io_uring_available,
+                                               resolve_backend)
+from deepspeed_tpu.runtime.swap_tensor import aio_handle as aio_handle_mod
 
 
 def test_native_aio_builds():
     h = AsyncIOHandle()
     assert h.using_native, "host_aio.cpp must compile in this image"
+    h.close()
+
+
+# ---------------------------------------------------------------------- #
+# backend selection (ISSUE 8: aio.backend io_uring|batched|threadpool|auto)
+# ---------------------------------------------------------------------- #
+
+def test_explicit_backends_roundtrip(tmp_path):
+    """Every portable backend honors the same pread/pwrite/wait contract."""
+    data = np.random.RandomState(0).randn(50_000).astype(np.float32)
+    for backend in ("threadpool", "batched"):
+        h = AsyncIOHandle(block_size=8192, queue_depth=4, thread_count=2,
+                          backend=backend)
+        assert h.using_native
+        assert h.backend_name == backend
+        path = str(tmp_path / f"{backend}.bin")
+        h.pwrite(data, path, async_op=True)
+        assert h.wait() == 1
+        out = np.empty_like(data)
+        h.pread(out, path, async_op=True)
+        h.wait()
+        np.testing.assert_array_equal(data, out)
+        h.close()
+
+
+def test_auto_backend_resolution():
+    """auto = io_uring when the kernel delivers it, else the batched pool
+    — never the plain threadpool (the sweep's slower submission path)."""
+    resolved = resolve_backend("auto")
+    if io_uring_available():
+        assert resolved == "io_uring"
+    else:
+        assert resolved == "batched"
+    h = AsyncIOHandle(backend="auto")
+    assert h.backend_name == resolved
+    h.close()
+
+
+def test_io_uring_request_falls_back_loudly(monkeypatch):
+    """Explicit io_uring on a host that cannot run it must WARN and fall
+    back to batched — not silently measure the wrong engine."""
+    if io_uring_available():
+        pytest.skip("io_uring works here; fallback path not reachable")
+    monkeypatch.setattr(aio_handle_mod, "_URING_FALLBACK_WARNED", False)
+    warnings = []
+    monkeypatch.setattr(aio_handle_mod.logger, "warning",
+                        lambda msg, *a: warnings.append(str(msg)))
+    h = AsyncIOHandle(backend="io_uring")
+    assert h.backend_name == "batched"
+    assert any("io_uring" in w and "falling back" in w for w in warnings)
+    h.close()
+    # the fallback warns ONCE per process, not once per handle
+    h2 = AsyncIOHandle(backend="io_uring")
+    assert h2.backend_name == "batched"
+    assert sum("falling back" in w for w in warnings) == 1
+    h2.close()
+
+
+def test_unknown_backend_rejected():
+    with pytest.raises(ValueError, match="aio.backend"):
+        resolve_backend("libaio")
+    assert "auto" in AIO_BACKENDS
+
+
+def test_batched_odd_sizes_and_block_boundaries(tmp_path):
+    """Coalesced preadv/pwritev runs must be byte-exact across non-divisible
+    sizes (short tail chunk) and many-chunk batches."""
+    h = AsyncIOHandle(block_size=4096, queue_depth=8, thread_count=2,
+                      backend="batched")
+    for n in (1, 4095, 4096, 4097, 40_001, 1_000_003):
+        data = np.random.RandomState(n % 97).randint(
+            0, 256, size=n, dtype=np.uint8)
+        path = str(tmp_path / f"n{n}.bin")
+        h.pwrite(data, path, async_op=False)
+        out = np.empty_like(data)
+        h.pread(out, path, async_op=False)
+        np.testing.assert_array_equal(data, out)
+    h.close()
+
+
+# ---------------------------------------------------------------------- #
+# raw-pointer contract (ISSUE 8 bugfix satellite)
+# ---------------------------------------------------------------------- #
+
+def test_non_contiguous_buffer_rejected(tmp_path):
+    """The engines transfer through the raw base pointer: a strided view
+    would be silently corrupted (native) or silently detached (fallback
+    reshape copy) — both must be refused up front."""
+    h = AsyncIOHandle(thread_count=1)
+    data = np.zeros((64, 64), np.float32)
+    strided = data[:, ::2]
+    with pytest.raises(ValueError, match="contiguous"):
+        h.pwrite(strided, str(tmp_path / "x.bin"))
+    with pytest.raises(ValueError, match="contiguous"):
+        h.pread(strided, str(tmp_path / "x.bin"))
+    h.close()
+
+
+def test_short_read_fails_loudly(tmp_path):
+    """Reading more bytes than the file holds is a torn/truncated swap
+    file — native engines return -EIO; the Python fallback must match
+    rather than hand back a half-stale buffer."""
+    data = np.arange(1000, dtype=np.float32)
+    path = str(tmp_path / "t.bin")
+    native = AsyncIOHandle(thread_count=1)
+    native.pwrite(data, path)
+    big = np.empty(2000, np.float32)
+    with pytest.raises(OSError):
+        native.pread(big, path, async_op=False)
+    native.close()
+    # python fallback parity
+    h = AsyncIOHandle.__new__(AsyncIOHandle)
+    h._lib = None
+    h._handle = None
+    h._sync_completed = 0
+    h.backend = "python"
+    with pytest.raises(OSError):
+        h.pread(big, path, async_op=False)
+
+
+def test_inflight_write_buffer_lifetime(tmp_path):
+    """Async submissions borrow the caller's buffer until wait() — the
+    swapper layers must pin their bounce buffers for the whole flight.
+    Stress: many swap_outs from short-lived temporaries, a gc sweep mid-
+    flight, then verify every byte landed."""
+    h = AsyncIOHandle(block_size=4096, queue_depth=4, thread_count=2,
+                      backend="batched")
+    sw = AsyncTensorSwapper(h, buffer_bytes=256 * 1024, buffer_count=3)
+    expect = {}
+    ops = []
+    for i in range(12):
+        a = np.random.RandomState(i).randn(50_000).astype(np.float32)
+        expect[i] = a.copy()
+        ops.append((i, sw.swap_out(a, str(tmp_path / f"g{i}.bin"))))
+        del a                      # the temporary dies while in flight
+        gc.collect()
+    sw.synchronize()
+    assert all(op.done for _, op in ops)
+    check = AsyncIOHandle(thread_count=1)
+    for i, a in expect.items():
+        out = np.empty_like(a)
+        check.pread(out, str(tmp_path / f"g{i}.bin"), async_op=False)
+        np.testing.assert_array_equal(a, out)
+    check.close()
+    h.close()
+
+
+def test_failed_write_reclaims_buffer(tmp_path):
+    """A write that errors must surface the I/O error AND return its
+    buffer — leaking the slot would wedge later swap_outs behind a
+    misleading 'pool exhausted' instead of the real failure."""
+    h = AsyncIOHandle(thread_count=1)
+    sw = AsyncTensorSwapper(h, buffer_bytes=64 * 1024, buffer_count=2)
+    a = np.zeros(100, np.float32)
+    # submission-time failure (missing directory)
+    with pytest.raises(OSError):
+        sw.swap_out(a, str(tmp_path / "no" / "such" / "dir" / "x.bin"))
+    assert sw.pool.free_count == 2
+    # completion-time failure (reaped at wait)
+    op = sw.swap_out(a, str(tmp_path / "ok.bin"))
+    import unittest.mock as mock
+    with mock.patch.object(op._handle, "wait",
+                           side_effect=OSError(28, "injected ENOSPC")):
+        with pytest.raises(OSError):
+            op.wait()
+    assert op.done
+    assert sw.pool.free_count == 2
+    h.close()
+
+
+def test_sweep_ceiling_missing_backend_is_none(tmp_path):
+    """A per-backend ceilings artifact must never hand one backend
+    another backend's number as its denominator."""
+    from deepspeed_tpu.runtime.zero.infinity import load_sweep_ceiling
+    art = tmp_path / "sweep.txt"
+    art.write_text(
+        '{"metric": "aio_best_config", "read_gbps": 9.9, "write_gbps": '
+        '1.0, "ceilings": {"batched": {"read_gbps": 2.0, "write_gbps": '
+        '0.5}}}\n')
+    assert load_sweep_ceiling("batched", str(art)) == {
+        "read_gbps": 2.0, "write_gbps": 0.5}
+    assert load_sweep_ceiling("io_uring", str(art)) is None
+    # pre-backend-axis artifact (no ceilings key): global best applies
+    old = tmp_path / "old.txt"
+    old.write_text('{"metric": "aio_best_config", "read_gbps": 2.78, '
+                   '"write_gbps": 0.39}\n')
+    assert load_sweep_ceiling("threadpool", str(old)) == {
+        "read_gbps": 2.78, "write_gbps": 0.39}
+    assert load_sweep_ceiling("anything", str(tmp_path / "absent")) is None
+
+
+def test_inflight_write_handle_per_buffer_reclaim(tmp_path):
+    """swap_out returns a real in-flight handle: waiting ONE write
+    reclaims only its buffer (no wait-at-use drain of the whole pool)."""
+    h = AsyncIOHandle(thread_count=2)
+    sw = AsyncTensorSwapper(h, buffer_bytes=64 * 1024, buffer_count=2)
+    a = np.random.RandomState(0).randn(1000).astype(np.float32)
+    b = np.random.RandomState(1).randn(1000).astype(np.float32)
+    op_a = sw.swap_out(a, str(tmp_path / "a.bin"))
+    op_b = sw.swap_out(b, str(tmp_path / "b.bin"))
+    assert sw.pool.free_count == 0
+    op_a.wait()
+    assert op_a.done and not op_b.done
+    assert sw.pool.free_count == 1   # only a's buffer came back
+    sw.synchronize()
+    assert sw.pool.free_count == 2
     h.close()
 
 
