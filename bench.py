@@ -291,19 +291,27 @@ def _time_steps(step, warmup=3, iters=30, align=1, final_sync=None):
     return time.time() - t0, final_loss, iters
 
 
-def _program_audit_fields(engine):
+def _program_audit_fields(engine, measured_step_s=None):
     """Static-audit provenance for a ladder row: the collective-lockstep
     signature and trip-weighted wire bytes/step of the exact programs
     this row dispatches (docs/program_auditor.md).  A perf regression
     that changes PROGRAM SHAPE (dense fallback reappearing, a collective
     reordered) then shows as a signature/wire diff in the row JSON, not
     just a slower number.  Best-effort: rows must never fail on an audit
-    bug."""
+    bug.
+
+    With ``measured_step_s`` the row also embeds the monitor's
+    reconciliation summary (monitor/reconcile.py — the same math the
+    runtime telemetry subsystem runs per window, docs/telemetry.md):
+    measured step time vs the roofline lower bound with per-lane
+    attribution, and measured memory vs the liveness estimate.  A
+    stale/wedged run's last row then carries WHY it was slow, not just a
+    stale-mark."""
     try:
         from deepspeed_tpu.analysis import audit_engine
         report = audit_engine(engine, multihost=False)
         lb = report.predicted_step_time_lb_s
-        return {
+        out = {
             "lockstep_signature": (report.signature or "")[:16],
             "wire_bytes_per_step": report.wire_bytes_per_step,
             "audit_findings": report.counts(),
@@ -315,8 +323,30 @@ def _program_audit_fields(engine):
             "predicted_step_time_lb": (round(lb, 6)
                                        if lb is not None else None),
         }
+        if measured_step_s is not None and report.step_time is not None:
+            out["reconciliation"] = _reconciliation_summary(
+                report, measured_step_s)
+        return out
     except Exception as e:  # noqa: BLE001 — provenance is best-effort
         return {"lockstep_signature": f"audit-failed: {e}"[:80]}
+
+
+def _reconciliation_summary(report, measured_step_s):
+    """Monitor-schema reconciliation payload for one measured row (single-
+    sourced field names: deepspeed_tpu.monitor.record / reconcile)."""
+    from deepspeed_tpu.analysis import per_lane_predictions
+    from deepspeed_tpu.monitor import (Bands, bare_summary, device_memory,
+                                       reconcile_window)
+    from deepspeed_tpu.monitor import record as mrec
+    mem = device_memory()
+    return bare_summary(reconcile_window(
+        {"step_time_s": measured_step_s,
+         "hbm_peak_bytes": mem.get(mrec.F_MEM_PEAK_BYTES),
+         "mem_source": mem.get(mrec.F_MEM_SOURCE)},
+        {"predicted_step_time_lb_s": report.predicted_step_time_lb_s,
+         "lanes": per_lane_predictions(report.step_time),
+         "peak_hbm_bytes": report.peak_hbm_bytes},
+        Bands()))
 
 
 def bench_gpt2(batch=8, metric="gpt2_124m_train_tokens_per_sec_1chip",
@@ -390,7 +420,7 @@ def bench_gpt2(batch=8, metric="gpt2_124m_train_tokens_per_sec_1chip",
         "mfu": round(tflops / peak, 4),
         "final_loss": round(final_loss, 4),
         "batch": batch,
-        **_program_audit_fields(engine),
+        **_program_audit_fields(engine, measured_step_s=dt / n),
         **({"probe_overrides": overrides} if overrides else {}),
     }
 
@@ -463,7 +493,7 @@ def _bench_gpt2_gas(fused, gas=4, batch=8):
         "gradient_accumulation_steps": gas,
         "dispatches_per_step": 1 if fused else 2 * gas,
         "final_loss": round(final_loss, 4),
-        **_program_audit_fields(engine),
+        **_program_audit_fields(engine, measured_step_s=dt / n),
     }
 
 
@@ -563,7 +593,7 @@ def _bench_gpt2_zero3_stream(carried, batch=8):
         "zero_world": zero_world,
         "stream_plan": {"layers_per_step": plan.layers_per_step,
                         "prefetch": plan.prefetch, "mode": plan.mode},
-        **_program_audit_fields(engine),
+        **_program_audit_fields(engine, measured_step_s=dt / n),
     }
 
 
@@ -614,7 +644,7 @@ def bench_smoke():
         "unit": "tokens/s",
         "vs_baseline": 0.0,
         "final_loss": round(final_loss, 4),
-        **_program_audit_fields(engine),
+        **_program_audit_fields(engine, measured_step_s=dt / n),
     }
 
 
@@ -1166,7 +1196,26 @@ def bench_infinity_stream():
         "serialized_swap_ins_last": on["serialized_swap_ins_last"],
         "loss_trajectory_match": True,
         "final_loss": round(losses_on[-1], 4),
+        "reconciliation": _swap_reconciliation(on, ceiling,
+                                               dt_on / steps),
     }
+
+
+def _swap_reconciliation(agg, ceiling, measured_step_s):
+    """Swap-lane reconciliation for the streaming row (same math/field
+    names as the runtime monitor's per-window report — the streaming
+    engine has no static roofline, so the comparison is achieved GB/s +
+    overlap vs the aio sweep ceiling)."""
+    from deepspeed_tpu.monitor import Bands, bare_summary, reconcile_window
+    swap = {"read_gbps": agg["read_gbps"],
+            "overlap_fraction": agg["overlap_fraction"],
+            "read_exposed_s": agg["read_exposed_s"],
+            "write_exposed_s": agg["write_exposed_s"]}
+    if ceiling:
+        swap["sweep_read_gbps"] = ceiling["read_gbps"]
+        swap["read_vs_ceiling"] = agg["read_gbps"] / ceiling["read_gbps"]
+    return bare_summary(reconcile_window(
+        {"step_time_s": measured_step_s, "swap": swap}, None, Bands()))
 
 
 def bench_bert_s512():

@@ -10,7 +10,8 @@ low-bandwidth wire-byte accounting.
 from .auditor import (ProgramAuditor, audit_engine, engine_targets,
                       enforce, synthesize_sample_batch,
                       verify_multihost_lockstep)
-from .cost_model import build_step_time_model, program_io_bytes
+from .cost_model import (build_step_time_model, per_lane_predictions,
+                         program_io_bytes)
 from .findings import (ALL_RULES, AuditReport, Finding, ProgramAuditError,
                        RULE_COMM_BUDGET, RULE_DONATION, RULE_DTYPE_HAZARD,
                        RULE_HBM_BUDGET, RULE_HOST_SYNC, RULE_LOCKSTEP,
@@ -41,7 +42,7 @@ __all__ = [
     "compare_lockstep", "engine_targets", "enforce", "eqn_scope",
     "estimate_liveness", "first_divergence", "iter_eqns",
     "lockstep_expectation_finding", "lockstep_signature",
-    "overlap_efficiency", "program_io_bytes",
+    "overlap_efficiency", "per_lane_predictions", "program_io_bytes",
     "signature_of_sequence", "step_wire_bytes", "sub_jaxprs",
     "summarize_overlap", "synthesize_sample_batch",
     "verify_multihost_lockstep",

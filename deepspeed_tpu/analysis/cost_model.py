@@ -36,6 +36,23 @@ def program_io_bytes(closed_jaxpr) -> int:
             + sum(aval_bytes(v) for v in jx.outvars))
 
 
+def per_lane_predictions(step_time: Dict[str, Any]) -> Dict[str, Any]:
+    """Normalize a step-time payload (build_step_time_model output) into
+    the per-lane form the runtime monitor's reconciliation consumes
+    (monitor/reconcile.py) and bench rows embed: one entry per cost-model
+    lane plus the binding term and the lower bound itself.  Single-sourced
+    here so the static and measured halves can never disagree on lane
+    names."""
+    return {
+        "compute": step_time["t_compute_s"],
+        "memory": step_time["t_memory_s"],
+        "hidden_comm": step_time["t_comm_hidden_s"],
+        "exposed_comm": step_time["t_comm_exposed_s"],
+        "bound": step_time["bound"],
+        "predicted_step_time_lb_s": step_time["predicted_step_time_lb_s"],
+    }
+
+
 def build_step_time_model(total_flops: int, io_bytes: int,
                           records: List[CollectiveOverlap],
                           cfg) -> Dict[str, Any]:

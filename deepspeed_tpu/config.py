@@ -514,6 +514,98 @@ class FusedStepConfig:
 
 
 @dataclass
+class MonitorConfig:
+    """Runtime telemetry block (docs/telemetry.md): per-step structured
+    metric records, pluggable writers, optional Chrome/Perfetto trace
+    export, and the measured-vs-predicted reconciliation report.  Off by
+    default; with it on, all host reads stay batched at flush-window
+    boundaries (the async-host-loop discipline)."""
+    enabled: bool = C.MONITOR_ENABLED_DEFAULT
+    output_path: str = C.MONITOR_OUTPUT_PATH_DEFAULT
+    job_name: str = C.MONITOR_JOB_NAME_DEFAULT
+    writers: tuple = C.MONITOR_WRITERS_DEFAULT
+    write_interval: Optional[int] = C.MONITOR_WRITE_INTERVAL_DEFAULT
+    trace: bool = C.MONITOR_TRACE_DEFAULT
+    trace_steps: int = C.MONITOR_TRACE_STEPS_DEFAULT
+    reconcile: bool = C.MONITOR_RECONCILE_DEFAULT
+    step_time_ratio_max: float = C.MONITOR_STEP_TIME_RATIO_MAX_DEFAULT
+    hbm_ratio_max: float = C.MONITOR_HBM_RATIO_MAX_DEFAULT
+    swap_min_vs_ceiling: float = C.MONITOR_SWAP_MIN_VS_CEILING_DEFAULT
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "MonitorConfig":
+        d = d or {}
+        writers = d.get(C.MONITOR_WRITERS, C.MONITOR_WRITERS_DEFAULT)
+        if isinstance(writers, str):
+            writers = (writers,)
+        try:
+            writers = tuple(writers)
+        except TypeError:
+            raise DeepSpeedConfigError(
+                f"monitor.writers must be a list of backend names "
+                f"(supported: {list(C.MONITOR_WRITER_KINDS)}), got "
+                f"{writers!r}")
+        interval = get_scalar_param(d, C.MONITOR_WRITE_INTERVAL,
+                                    C.MONITOR_WRITE_INTERVAL_DEFAULT)
+        cfg = MonitorConfig(
+            enabled=get_scalar_param(d, C.MONITOR_ENABLED,
+                                     C.MONITOR_ENABLED_DEFAULT),
+            output_path=get_scalar_param(d, C.MONITOR_OUTPUT_PATH,
+                                         C.MONITOR_OUTPUT_PATH_DEFAULT),
+            job_name=get_scalar_param(d, C.MONITOR_JOB_NAME,
+                                      C.MONITOR_JOB_NAME_DEFAULT),
+            writers=writers,
+            write_interval=None if interval is None else int(interval),
+            trace=bool(get_scalar_param(d, C.MONITOR_TRACE,
+                                        C.MONITOR_TRACE_DEFAULT)),
+            trace_steps=int(get_scalar_param(
+                d, C.MONITOR_TRACE_STEPS, C.MONITOR_TRACE_STEPS_DEFAULT)),
+            reconcile=bool(get_scalar_param(d, C.MONITOR_RECONCILE,
+                                            C.MONITOR_RECONCILE_DEFAULT)),
+            step_time_ratio_max=float(get_scalar_param(
+                d, C.MONITOR_STEP_TIME_RATIO_MAX,
+                C.MONITOR_STEP_TIME_RATIO_MAX_DEFAULT)),
+            hbm_ratio_max=float(get_scalar_param(
+                d, C.MONITOR_HBM_RATIO_MAX,
+                C.MONITOR_HBM_RATIO_MAX_DEFAULT)),
+            swap_min_vs_ceiling=float(get_scalar_param(
+                d, C.MONITOR_SWAP_MIN_VS_CEILING,
+                C.MONITOR_SWAP_MIN_VS_CEILING_DEFAULT)),
+        )
+        unknown = [w for w in cfg.writers if w not in C.MONITOR_WRITER_KINDS]
+        if unknown:
+            raise DeepSpeedConfigError(
+                f"monitor.writers contains unknown backend(s) {unknown} — "
+                f"supported: {list(C.MONITOR_WRITER_KINDS)}")
+        if cfg.enabled and not cfg.writers:
+            raise DeepSpeedConfigError(
+                "monitor.enabled requires at least one writer backend "
+                f"(supported: {list(C.MONITOR_WRITER_KINDS)})")
+        if cfg.write_interval is not None and cfg.write_interval <= 0:
+            raise DeepSpeedConfigError(
+                "monitor.write_interval must be positive, got "
+                f"{cfg.write_interval}")
+        if cfg.trace_steps <= 0:
+            raise DeepSpeedConfigError(
+                f"monitor.trace_steps must be positive, got "
+                f"{cfg.trace_steps}")
+        if cfg.step_time_ratio_max <= 1.0:
+            raise DeepSpeedConfigError(
+                "monitor.step_time_ratio_max must be > 1.0 (measured is "
+                f"compared against a LOWER bound), got "
+                f"{cfg.step_time_ratio_max}")
+        if cfg.hbm_ratio_max <= 1.0:
+            raise DeepSpeedConfigError(
+                "monitor.hbm_ratio_max must be > 1.0, got "
+                f"{cfg.hbm_ratio_max}")
+        if not 0.0 <= cfg.swap_min_vs_ceiling <= 1.0:
+            raise DeepSpeedConfigError(
+                "monitor.swap_min_vs_ceiling must be in [0, 1], got "
+                f"{cfg.swap_min_vs_ceiling}")
+        return cfg
+
+
+@dataclass
 class AnalysisConfig:
     """Program Auditor block (docs/program_auditor.md): static jaxpr lint
     of the traced step programs at engine init, plus the runtime
@@ -1031,6 +1123,7 @@ class DeepSpeedConfig:
         self.fused_step_config = FusedStepConfig.from_dict(
             pd.get(C.FUSED_STEP))
         self.analysis_config = AnalysisConfig.from_dict(pd.get(C.ANALYSIS))
+        self.monitor_config = MonitorConfig.from_dict(pd.get(C.MONITOR))
         self.eigenvalue_config = EigenvalueConfig.from_dict(pd.get(C.EIGENVALUE))
         self.pld_config = PLDConfig.from_dict(pd.get(C.PROGRESSIVE_LAYER_DROP))
         self.curriculum_config = CurriculumConfig.from_dict(

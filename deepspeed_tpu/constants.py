@@ -203,6 +203,53 @@ ANALYSIS_HW_ICI_GBPS = "hw_ici_gbps"
 ANALYSIS_HW_ICI_GBPS_DEFAULT = 90.0
 
 #############################################
+# Runtime telemetry monitor (TPU-native addition; docs/telemetry.md)
+#
+# Structured per-step metric records (JSONL/CSV/TensorBoard writers on a
+# background thread), a Chrome/Perfetto trace-event exporter, and a
+# measured-vs-predicted reconciliation report against the Program/
+# Schedule Auditor's static model.  Off by default; all host reads are
+# batched at flush-window boundaries so the async host loop's
+# no-hot-loop-sync guarantee holds with the monitor on.
+#############################################
+MONITOR = "monitor"
+MONITOR_ENABLED = "enabled"
+MONITOR_ENABLED_DEFAULT = False
+MONITOR_OUTPUT_PATH = "output_path"
+MONITOR_OUTPUT_PATH_DEFAULT = "./monitor_logs"
+MONITOR_JOB_NAME = "job_name"
+MONITOR_JOB_NAME_DEFAULT = ""
+# writer backends; jsonl is always available (no extra deps), csv is the
+# fixed-column projection, tensorboard reuses the engine's own writer
+MONITOR_WRITERS = "writers"
+MONITOR_WRITERS_DEFAULT = ("jsonl",)
+MONITOR_WRITER_KINDS = ("jsonl", "csv", "tensorboard")
+# flush-window cadence in optimizer steps; None inherits steps_per_print
+# (the same boundary the engine's own coalesced host reads use)
+MONITOR_WRITE_INTERVAL = "write_interval"
+MONITOR_WRITE_INTERVAL_DEFAULT = None
+# Chrome/Perfetto trace-event export (trace.json in the output dir);
+# trace_steps bounds the number of optimizer steps traced
+MONITOR_TRACE = "trace"
+MONITOR_TRACE_DEFAULT = False
+MONITOR_TRACE_STEPS = "trace_steps"
+MONITOR_TRACE_STEPS_DEFAULT = 128
+# measured-vs-predicted reconciliation per flush window, with flag bands:
+# measured/predicted step time above step_time_ratio_max flags (and below
+# ~1.0 flags model_violation); measured HBM outside
+# [1/hbm_ratio_max, hbm_ratio_max] of the liveness estimate flags;
+# achieved swap read below swap_min_vs_ceiling of the aio sweep ceiling
+# flags
+MONITOR_RECONCILE = "reconcile"
+MONITOR_RECONCILE_DEFAULT = True
+MONITOR_STEP_TIME_RATIO_MAX = "step_time_ratio_max"
+MONITOR_STEP_TIME_RATIO_MAX_DEFAULT = 10.0
+MONITOR_HBM_RATIO_MAX = "hbm_ratio_max"
+MONITOR_HBM_RATIO_MAX_DEFAULT = 2.0
+MONITOR_SWAP_MIN_VS_CEILING = "swap_min_vs_ceiling"
+MONITOR_SWAP_MIN_VS_CEILING_DEFAULT = 0.25
+
+#############################################
 # Tensorboard
 #############################################
 TENSORBOARD = "tensorboard"

@@ -1,0 +1,40 @@
+"""Runtime telemetry subsystem (docs/telemetry.md).
+
+Structured per-step metrics behind the ``monitor`` config block (off by
+default): a MetricsStream assembling one record per optimizer step with
+boundary-only batched host reads, pluggable JSONL/CSV/TensorBoard
+writers on a background thread, a Chrome/Perfetto trace-event exporter
+for step phases and swap-tier I/O, and a measured-vs-predicted
+reconciliation report against the Program/Schedule Auditor's static
+model — every run, on-chip or CPU, self-attributing.
+"""
+
+from . import record
+from .monitor import (METRICS_CSV, METRICS_JSONL, TRACE_JSON,
+                      MetricsStream, TrainingMonitor)
+from .reconcile import (ATTR_COMM_EXPOSED, ATTR_COMM_HIDDEN, ATTR_COMPUTE,
+                        ATTR_IO, ATTR_SWAP, FLAG_HBM_ABOVE_BAND,
+                        FLAG_HBM_BELOW_BAND, FLAG_MODEL_VIOLATION,
+                        FLAG_STEP_TIME_ABOVE_BAND, FLAG_SWAP_BELOW_CEILING,
+                        Bands, attribute_gap, bare_summary, format_line,
+                        reconcile_window)
+from .record import (KIND_META, KIND_RECONCILE, KIND_STEP,
+                     STEP_RECORD_FIELDS, device_memory, make_step_record)
+from .trace import TraceEventBuffer, validate_trace_events
+from .writers import (CsvWriter, JsonlWriter, MetricsWriter,
+                      ScalarJsonlWriter, TensorBoardWriter, WriterThread)
+
+__all__ = [
+    "ATTR_COMM_EXPOSED", "ATTR_COMM_HIDDEN", "ATTR_COMPUTE", "ATTR_IO",
+    "ATTR_SWAP", "Bands", "CsvWriter",
+    "FLAG_HBM_ABOVE_BAND", "FLAG_HBM_BELOW_BAND", "FLAG_MODEL_VIOLATION",
+    "FLAG_STEP_TIME_ABOVE_BAND", "FLAG_SWAP_BELOW_CEILING",
+    "JsonlWriter", "KIND_META", "KIND_RECONCILE", "KIND_STEP",
+    "METRICS_CSV", "METRICS_JSONL", "MetricsStream", "MetricsWriter",
+    "STEP_RECORD_FIELDS", "ScalarJsonlWriter", "TRACE_JSON",
+    "TensorBoardWriter", "TraceEventBuffer", "TrainingMonitor",
+    "WriterThread", "attribute_gap", "bare_summary", "device_memory",
+    "format_line",
+    "make_step_record", "record", "reconcile_window",
+    "validate_trace_events",
+]

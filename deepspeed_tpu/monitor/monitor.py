@@ -1,0 +1,294 @@
+"""TrainingMonitor — runtime telemetry orchestrator (docs/telemetry.md).
+
+One instance per engine (rank 0 only), behind the ``monitor`` config
+block.  The design constraint everything here serves: the step loop must
+stay dispatch-deep.  Per optimizer step the monitor does ONLY host work
+— a perf_counter read, appending a pending tuple holding the loss as a
+*device array reference* (not a value), and integer counter copies.
+All device fetches (the batched loss reads, lr / loss-scale, memory
+stats) happen at flush-window boundaries, exactly like the engine's own
+``_boundary_logging`` — which is why the host-sync audit of a monitored
+program reports nothing new (tests/unit/test_monitor.py pins this).
+
+Emission is decoupled twice: records materialize at the boundary, and
+file I/O runs on the WriterThread — a slow disk never blocks a step.
+"""
+
+import atexit
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.logging import log_dist, logger
+from . import record as R
+from .reconcile import Bands, format_line, reconcile_window
+from .trace import TID_STEP, TraceEventBuffer
+from .writers import (CsvWriter, JsonlWriter, MetricsWriter,
+                      TensorBoardWriter, WriterThread)
+
+METRICS_JSONL = "metrics.jsonl"
+METRICS_CSV = "metrics.csv"
+TRACE_JSON = "trace.json"
+
+
+def _batched_loss_fetch(refs):
+    """Materialize a window of retained device scalars in ONE transfer
+    (jax.device_get on the whole list) — N sequential per-record fetches
+    would pay N host-device round trips at every boundary.  Falls back
+    per-ref for values device_get cannot handle."""
+    try:
+        import jax
+        vals = jax.device_get(refs)
+    except Exception:  # noqa: BLE001 — mixed/foreign refs
+        vals = refs
+    out = []
+    for v in vals:
+        if v is None:
+            out.append(None)
+            continue
+        try:
+            out.append(round(float(np.asarray(v)), 6))
+        except Exception:  # noqa: BLE001
+            out.append(None)
+    return out
+
+
+class MetricsStream:
+    """Assembles one structured record per optimizer step.
+
+    ``end_step`` is the per-step hot-path call: O(1) host work, no device
+    reads.  ``flush`` is the boundary call: one batched fetch of the
+    window's retained device scalars plus one read each of lr/loss-scale
+    (``boundary_fn``), memory stats, and swap stats (``swap_stats_fn``),
+    then the whole window's records go to the writer thread at once."""
+
+    def __init__(self, window: int, sink: Callable[[List[dict]], None],
+                 boundary_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+                 swap_stats_fn: Optional[Callable[[], Optional[dict]]] = None,
+                 reconciler: Optional[Callable[[dict], Optional[dict]]] = None):
+        self.window = max(1, int(window))
+        self._sink = sink
+        self._boundary_fn = boundary_fn
+        self._swap_stats_fn = swap_stats_fn
+        self._reconciler = reconciler
+        self._pending: List[dict] = []
+        self._t_prev: Optional[float] = None
+        self.records_emitted = 0
+
+    def mark_step_start(self) -> None:
+        """Arm the wall clock before the first step's dispatch (later
+        steps measure arrival-to-arrival — DELIVERED step time including
+        host/dataloader gaps, same semantics as ThroughputTimer)."""
+        if self._t_prev is None:
+            self._t_prev = time.perf_counter()
+
+    def discard_step(self) -> None:
+        """A step that produced no record (e.g. a sentinel rewind)
+        still consumed wall time — reset the arrival clock so the NEXT
+        record does not silently absorb it."""
+        if self._t_prev is not None:
+            self._t_prev = time.perf_counter()
+
+    def end_step(self, step: int, loss: Any = None,
+                 tokens: Optional[int] = None,
+                 counters: Optional[Dict[str, Any]] = None,
+                 swap: Optional[Dict[str, Any]] = None) -> None:
+        """``swap``: this STEP's swap-stats dict when the caller already
+        has it as host data (the streaming engine computes it per step in
+        _finalize_swap_stats) — records then carry per-step values
+        instead of the window boundary's snapshot."""
+        now = time.perf_counter()
+        wall = (now - self._t_prev) if self._t_prev is not None else None
+        self._t_prev = now
+        self._pending.append({"step": int(step), "loss_ref": loss,
+                              "wall_s": wall, "tokens": tokens,
+                              "counters": dict(counters or {}),
+                              "swap": swap})
+        if len(self._pending) >= self.window:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._pending:
+            return
+        pending, self._pending = self._pending, []
+        boundary: Dict[str, Any] = {}
+        if self._boundary_fn is not None:
+            try:
+                boundary = self._boundary_fn() or {}
+            except Exception as e:  # noqa: BLE001 — never fail a step
+                logger.warning(f"monitor: boundary reads failed ({e})")
+        memory = R.device_memory()
+        swap = None
+        if self._swap_stats_fn is not None:
+            try:
+                swap = self._swap_stats_fn()
+            except Exception:  # noqa: BLE001
+                swap = None
+        losses = _batched_loss_fetch([p["loss_ref"] for p in pending])
+        records = []
+        walls = []
+        for p, loss in zip(pending, losses):
+            if p["wall_s"] is not None:
+                walls.append(p["wall_s"])
+            records.append(R.make_step_record(
+                p["step"], loss, p["wall_s"], p["tokens"], p["counters"],
+                boundary, memory,
+                p["swap"] if p["swap"] is not None else swap))
+        if self._reconciler is not None:
+            rec = self._reconciler({
+                "window_start_step": pending[0]["step"],
+                "window_end_step": pending[-1]["step"],
+                "step_time_s": (sum(walls) / len(walls)) if walls else None,
+                "hbm_peak_bytes": memory.get(R.F_MEM_PEAK_BYTES),
+                "mem_source": memory.get(R.F_MEM_SOURCE),
+                "swap": swap,
+            })
+            if rec is not None:
+                records.append(rec)
+        self.records_emitted += len(records)
+        self._sink(records)
+
+
+class TrainingMonitor:
+    """Config-driven telemetry: MetricsStream + writers + trace +
+    reconciliation.  Constructed by the engines when ``monitor.enabled``;
+    safe to close() more than once (atexit-registered so a crashed run
+    still flushes what it saw)."""
+
+    def __init__(self, cfg, steps_per_print: int = 10,
+                 predictions: Optional[Dict[str, Any]] = None,
+                 summary_writer: Any = None,
+                 boundary_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+                 swap_stats_fn: Optional[Callable[[], Optional[dict]]] = None,
+                 meta: Optional[Dict[str, Any]] = None):
+        self.cfg = cfg
+        self.out_dir = os.path.join(cfg.output_path, cfg.job_name or "")
+        self.predictions = predictions
+        self.bands = Bands(step_time_ratio_max=cfg.step_time_ratio_max,
+                           hbm_ratio_max=cfg.hbm_ratio_max,
+                           swap_min_vs_ceiling=cfg.swap_min_vs_ceiling)
+        window = cfg.write_interval or steps_per_print
+        self.last_reconciliation: Optional[Dict[str, Any]] = None
+
+        writers: List[MetricsWriter] = []
+        self.jsonl_path = self.csv_path = self.trace_path = None
+        if "jsonl" in cfg.writers:
+            self.jsonl_path = os.path.join(self.out_dir, METRICS_JSONL)
+            writers.append(JsonlWriter(self.jsonl_path))
+        if "csv" in cfg.writers:
+            self.csv_path = os.path.join(self.out_dir, METRICS_CSV)
+            writers.append(CsvWriter(self.csv_path))
+        if "tensorboard" in cfg.writers:
+            if summary_writer is not None:
+                writers.append(TensorBoardWriter(summary_writer))
+            else:
+                logger.warning(
+                    "monitor: writer 'tensorboard' requested but the "
+                    "engine has no summary writer (enable the tensorboard "
+                    "config block) — skipping that backend")
+        self._thread = WriterThread(writers)
+
+        self.trace: Optional[TraceEventBuffer] = None
+        if cfg.trace:
+            self.trace = TraceEventBuffer(max_steps=cfg.trace_steps)
+            self.trace_path = os.path.join(self.out_dir, TRACE_JSON)
+
+        reconciler = None
+        if cfg.reconcile:
+            reconciler = self._reconcile
+        self.stream = MetricsStream(window, self._sink,
+                                    boundary_fn=boundary_fn,
+                                    swap_stats_fn=swap_stats_fn,
+                                    reconciler=reconciler)
+        if meta:
+            self._thread.submit([{R.F_KIND: R.KIND_META, **meta,
+                                  **({"predicted_step_time_lb_s":
+                                      predictions.get(
+                                          "predicted_step_time_lb_s")}
+                                     if predictions else {})}])
+        self._closed = False
+        atexit.register(self.close)
+        log_dist(
+            f"monitor: writers={list(cfg.writers)} window={window} "
+            f"trace={'on' if self.trace else 'off'} "
+            f"reconcile={'on' if reconciler else 'off'} "
+            f"-> {self.out_dir}", ranks=[0])
+
+    # ------------------------------------------------------------------ #
+    # hot-path API (host-only work; see MetricsStream)
+    # ------------------------------------------------------------------ #
+    @property
+    def trace_active(self) -> bool:
+        return self.trace is not None and not self.trace.saturated
+
+    def mark_step_start(self) -> None:
+        self.stream.mark_step_start()
+
+    def discard_step(self) -> None:
+        self.stream.discard_step()
+
+    def end_step(self, step: int, loss: Any = None,
+                 tokens: Optional[int] = None,
+                 counters: Optional[Dict[str, Any]] = None,
+                 swap: Optional[Dict[str, Any]] = None) -> None:
+        if self.trace is not None:
+            self.trace.note_untraced_step(step)
+        self.stream.end_step(step, loss=loss, tokens=tokens,
+                             counters=counters, swap=swap)
+
+    def add_phase(self, name: str, t_start: float,
+                  step: Optional[int] = None,
+                  t_end: Optional[float] = None) -> None:
+        """Record one dispatch-phase span ending now (or at t_end)."""
+        if self.trace is not None:
+            self.trace.add_span(name, t_start,
+                                t_end if t_end is not None
+                                else time.perf_counter(),
+                                tid=TID_STEP, step=step)
+
+    # ------------------------------------------------------------------ #
+    def _sink(self, records: List[dict]) -> None:
+        """Flush-boundary sink: hand the window to the writer thread and
+        mark the boundary on the trace timeline (the flush is where the
+        batched device reads happen — worth seeing next to the spans)."""
+        if self.trace is not None and not self.trace.saturated:
+            self.trace.add_instant("flush", time.perf_counter(),
+                                   args={"records": len(records)})
+        self._thread.submit(records)
+
+    def _reconcile(self, measured: Dict[str, Any]) -> Optional[dict]:
+        rec = reconcile_window(measured, self.predictions, self.bands)
+        self.last_reconciliation = rec
+        if rec.get(R.R_FLAGS):
+            logger.warning(format_line(rec))
+        else:
+            log_dist(format_line(rec), ranks=[0])
+        return rec
+
+    def flush(self) -> None:
+        self.stream.flush()
+
+    def close(self) -> None:
+        """Flush pending records, write the trace file, stop the writer
+        thread.  Idempotent; registered atexit."""
+        if self._closed:
+            return
+        self._closed = True
+        # drop the atexit registry's reference so a discarded engine's
+        # monitor (trace buffer + writer thread) is actually reclaimable
+        try:
+            atexit.unregister(self.close)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            self.stream.flush()
+        except Exception as e:  # noqa: BLE001
+            logger.warning(f"monitor: final flush failed ({e})")
+        if self.trace is not None and self.trace_path is not None:
+            try:
+                self.trace.write(self.trace_path)
+            except Exception as e:  # noqa: BLE001
+                logger.warning(f"monitor: trace export failed ({e})")
+        self._thread.close()

@@ -1,0 +1,134 @@
+"""Step-record schema — the single source of metric field names.
+
+One optimizer step produces one structured record.  Every consumer —
+the JSONL/CSV/TensorBoard writers, the reconciliation report, and the
+bench ladder rows (bench.py) — imports these names instead of spelling
+its own, so a field rename is a one-file change and a bench row can
+never drift from the stream schema.
+
+The record is assembled with BOUNDARY-ONLY host reads: per-step fields
+are either pure host state (wall time, counters) or device scalar
+*references* that the MetricsStream batches into one fetch at the flush
+window boundary (monitor.py).  Nothing in this module syncs the device
+per step — the PR-3 async host loop's no-hot-loop-sync guarantee is the
+design constraint the whole subsystem is built around.
+"""
+
+from typing import Any, Dict, Optional
+
+# ---- record kinds ---------------------------------------------------- #
+KIND_STEP = "step"
+KIND_RECONCILE = "reconcile"
+KIND_META = "meta"
+
+# ---- per-step field names (the schema) ------------------------------- #
+F_KIND = "kind"
+F_STEP = "step"
+F_LOSS = "loss"
+F_LR = "lr"
+F_LOSS_SCALE = "loss_scale"
+F_WALL_TIME_S = "wall_time_s"
+F_TOKENS_PER_SEC = "tokens_per_sec"
+F_MEM_PEAK_BYTES = "mem_peak_bytes"
+F_MEM_IN_USE_BYTES = "mem_in_use_bytes"
+F_MEM_SOURCE = "mem_source"
+F_SKIPPED_STEPS = "skipped_steps"
+F_SENTINEL_ANOMALIES = "sentinel_anomalies"
+F_SENTINEL_SKIPS = "sentinel_skips"
+F_RETRACES = "retraces"
+F_DISPATCHES_PER_STEP = "dispatches_per_step"
+F_SWAP_READ_GBPS = "swap_read_gbps"
+F_SWAP_OVERLAP_FRACTION = "swap_overlap_fraction"
+F_SWAP_READ_VS_CEILING = "swap_read_vs_ceiling"
+
+# CSV column order; JSONL records carry the same names (plus any
+# engine-specific extras, which CSV drops — CSV is the fixed-width view)
+STEP_RECORD_FIELDS = (
+    F_STEP, F_LOSS, F_LR, F_LOSS_SCALE, F_WALL_TIME_S, F_TOKENS_PER_SEC,
+    F_MEM_PEAK_BYTES, F_MEM_IN_USE_BYTES, F_MEM_SOURCE,
+    F_SKIPPED_STEPS, F_SENTINEL_ANOMALIES, F_SENTINEL_SKIPS, F_RETRACES,
+    F_DISPATCHES_PER_STEP,
+    F_SWAP_READ_GBPS, F_SWAP_OVERLAP_FRACTION, F_SWAP_READ_VS_CEILING,
+)
+
+# ---- reconciliation field names (reconcile.py payload) --------------- #
+R_WINDOW_START = "window_start_step"
+R_WINDOW_END = "window_end_step"
+R_MEASURED_STEP_S = "measured_step_time_s"
+R_PREDICTED_STEP_S = "predicted_step_time_lb_s"
+R_STEP_RATIO = "step_time_ratio"
+R_LANES = "lanes"
+R_ATTRIBUTION = "attribution"
+R_MEASURED_HBM = "measured_hbm_peak_bytes"
+R_PREDICTED_HBM = "predicted_hbm_peak_bytes"
+R_HBM_RATIO = "hbm_ratio"
+R_SWAP_GBPS = "swap_read_gbps"
+R_SWAP_CEILING_GBPS = "swap_ceiling_gbps"
+R_SWAP_VS_CEILING = "swap_read_vs_ceiling"
+R_OVERLAP_FRACTION = "swap_overlap_fraction"
+R_FLAGS = "flags"
+
+
+def device_memory() -> Dict[str, Any]:
+    """Measured memory high-water, one bounded read.
+
+    Prefers the accelerator's own allocator stats
+    (``jax.local_devices()[0].memory_stats()`` — peak_bytes_in_use is the
+    HBM high-water the liveness estimator predicts).  CPU backends
+    usually report no allocator stats; there the process RSS high-water
+    (``ru_maxrss``) stands in, labeled via ``mem_source`` so a record
+    never passes host RSS off as device HBM."""
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats() or {}
+    except Exception:  # noqa: BLE001 — monitoring must never crash a step
+        stats = {}
+    peak = stats.get("peak_bytes_in_use")
+    if peak:
+        return {F_MEM_PEAK_BYTES: int(peak),
+                F_MEM_IN_USE_BYTES: int(stats.get("bytes_in_use", 0)),
+                F_MEM_SOURCE: "device"}
+    try:
+        import resource
+        import sys
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        # linux reports ru_maxrss in KiB; macOS/BSD report bytes
+        unit = 1024 if sys.platform.startswith("linux") else 1
+        return {F_MEM_PEAK_BYTES: int(ru.ru_maxrss) * unit,
+                F_MEM_IN_USE_BYTES: None,
+                F_MEM_SOURCE: "host_rss"}
+    except Exception:  # noqa: BLE001
+        return {F_MEM_PEAK_BYTES: None, F_MEM_IN_USE_BYTES: None,
+                F_MEM_SOURCE: "unavailable"}
+
+
+def make_step_record(step: int, loss: Optional[float], wall_s: float,
+                     tokens: Optional[int], counters: Dict[str, Any],
+                     boundary: Dict[str, Any],
+                     memory: Dict[str, Any],
+                     swap: Optional[Dict[str, Any]] = None,
+                     extra: Optional[Dict[str, Any]] = None
+                     ) -> Dict[str, Any]:
+    """Assemble one step record from already-fetched host values."""
+    rec: Dict[str, Any] = {F_KIND: KIND_STEP, F_STEP: int(step)}
+    rec[F_LOSS] = loss
+    rec[F_WALL_TIME_S] = round(float(wall_s), 6) if wall_s else wall_s
+    rec[F_TOKENS_PER_SEC] = (round(tokens / wall_s, 1)
+                             if tokens and wall_s and wall_s > 0 else None)
+    rec[F_LR] = boundary.get("lr")
+    rec[F_LOSS_SCALE] = boundary.get("loss_scale")
+    rec.update(memory)
+    for k in (F_SKIPPED_STEPS, F_SENTINEL_ANOMALIES, F_SENTINEL_SKIPS,
+              F_RETRACES, F_DISPATCHES_PER_STEP):
+        rec[k] = counters.get(k)
+    if swap:
+        rec[F_SWAP_READ_GBPS] = swap.get("read_gbps")
+        rec[F_SWAP_OVERLAP_FRACTION] = swap.get("overlap_fraction")
+        rec[F_SWAP_READ_VS_CEILING] = swap.get("read_vs_ceiling")
+    else:
+        rec[F_SWAP_READ_GBPS] = None
+        rec[F_SWAP_OVERLAP_FRACTION] = None
+        rec[F_SWAP_READ_VS_CEILING] = None
+    if extra:
+        rec.update(extra)
+    return rec

@@ -26,6 +26,7 @@ gradient-accumulation boundaries.
 """
 
 import os
+import time
 import warnings
 from typing import Any, Callable, Dict, Optional
 
@@ -70,6 +71,9 @@ BACKWARD_MICRO_TIMER = "backward_microstep"
 BACKWARD_GLOBAL_TIMER = "backward"
 STEP_MICRO_TIMER = "step_microstep"
 STEP_GLOBAL_TIMER = "step"
+# window-level timer for the fused whole-step path: the gas window is ONE
+# dispatch, so forward/backward micro timers cannot exist there
+FUSED_STEP_TIMER = "fused_train_batch"
 
 
 def _tree_cast(tree, dtype):
@@ -308,6 +312,10 @@ class DeepSpeedEngine:
         self._fused_sent_state = ()
         self._fused_pending_flags = []
         self.fused_step_reason = None
+        # telemetry provenance: XLA dispatches issued per optimizer step
+        # (gas grad programs + gas-1 accumulation adds + 1 apply); the
+        # fused build overrides this to 1
+        self._dispatches_per_step = 2 * self.gradient_accumulation_steps()
         if self.config.fused_step_config.enabled:
             from .fused_step import (build_fused_step, fused_fallback_reason,
                                      sentinel_state_from_host)
@@ -327,6 +335,17 @@ class DeepSpeedEngine:
                     f"(gas={self.gradient_accumulation_steps()}; modular "
                     f"loop would issue "
                     f"{2 * self.gradient_accumulation_steps()})", ranks=[0])
+                if self.wall_clock_breakdown():
+                    # the forward/backward/step micro timers never run
+                    # under the fused program (the whole window is one
+                    # dispatch) — say so ONCE instead of printing an
+                    # empty breakdown every window
+                    logger.warning(
+                        "wall_clock_breakdown: forward/backward micro "
+                        "timers are unavailable under fused_step (the "
+                        "window is one compiled dispatch) — the window-"
+                        f"level '{FUSED_STEP_TIMER}' timer reports the "
+                        "whole optimizer step instead")
 
         # ---- data ---------------------------------------------------- #
         self.training_dataloader = self._configure_dataloader(
@@ -429,6 +448,15 @@ class DeepSpeedEngine:
                 self.program_audit.predicted_step_time_lb_s)
             log_dist(self.program_audit.summary_line(), ranks=[0])
             enforce(self.program_audit, self.analysis.mode, logger)
+
+        # ---- runtime telemetry monitor (off by default; docs/telemetry.md)
+        # Per-step structured records with boundary-only batched host
+        # reads, background writers, optional trace export, and the
+        # measured-vs-predicted reconciliation against the static model.
+        self.monitor = None
+        self._monitor_seq = None
+        if self.config.monitor_config.enabled and jax.process_index() == 0:
+            self.monitor = self._configure_monitor()
 
         log_dist(
             f"DeepSpeedEngine: zero_stage={stage} dtype={self.compute_dtype} "
@@ -586,16 +614,40 @@ class DeepSpeedEngine:
             data_parallel_rank=jax.process_index())
 
     def _configure_tensorboard(self):
+        """Summary-writer resolution without a hard torch dependency:
+        torch.utils.tensorboard, then tensorboardX, then the monitor's
+        JSONL scalar writer — a torch-free JAX host still gets metrics
+        (the fallback is loud, once, and names where the scalars went)."""
         tb = self.config.tensorboard_config
         if not tb.enabled:
             return None
+        path = os.path.join(tb.output_path or "./runs", tb.job_name or "")
+        errors = []
         try:
             from torch.utils.tensorboard import SummaryWriter
-            path = os.path.join(tb.output_path or "./runs", tb.job_name)
             return SummaryWriter(log_dir=path)
-        except Exception as e:  # pragma: no cover
-            logger.warning(f"tensorboard unavailable: {e}")
+        except Exception as e:  # noqa: BLE001 — torch absent or broken
+            errors.append(f"torch.utils.tensorboard: {e}")
+        try:
+            from tensorboardX import SummaryWriter
+            return SummaryWriter(log_dir=path)
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"tensorboardX: {e}")
+        try:
+            from ..monitor.writers import ScalarJsonlWriter
+            writer = ScalarJsonlWriter(path)
+        except Exception as e:  # noqa: BLE001 — e.g. unwritable path;
+            # metrics degrade, engine init must not crash (old contract)
+            errors.append(f"jsonl fallback: {e}")
+            logger.warning("tensorboard unavailable: " + "; ".join(errors))
             return None
+        # name the REAL failures (a broken-protobuf torch is not the same
+        # problem as an absent torch) so the operator debugs the right one
+        logger.warning(
+            "tensorboard requested but no SummaryWriter backend worked "
+            f"({'; '.join(errors)}) — scalars will be written as JSONL "
+            f"to {writer.path} instead")
+        return writer
 
     # ------------------------------------------------------------------ #
     # compiled programs
@@ -913,6 +965,8 @@ class DeepSpeedEngine:
             self.timers(FORWARD_MICRO_TIMER).start()
         if self._is_train_mode:
             self.tput_timer.start()
+            if self.monitor is not None:
+                self.monitor.mark_step_start()
         if self.curriculum_scheduler is not None and self._is_train_mode:
             # Truncate every sequence-sized axis to the current difficulty
             # (reference: engine.py:1239-1245 curriculum_seqlen injection).
@@ -941,6 +995,8 @@ class DeepSpeedEngine:
             kwargs["pld_theta"] = jnp.float32(
                 self.progressive_layer_drop.get_theta())
         self._observe_retrace((args, kwargs))
+        if self.monitor is not None:
+            self._monitor_note_batch((args, kwargs))
         batch = self._shard_batch((args, kwargs))
         args, kwargs = batch
         rng = self._next_rng()
@@ -963,8 +1019,16 @@ class DeepSpeedEngine:
             # curvature probes re-run the loss on the latest TRAIN batch;
             # no quantizer = no consumer, don't pin the batch
             self._last_batch = (args, kwargs)
+        trace_on = self.monitor is not None and self.monitor.trace_active
+        if trace_on:
+            _tp0 = time.perf_counter()
         loss, grads = self._grad_fn(self.params, self.scaler_state,
                                     rng, *args, **kwargs)
+        if trace_on:
+            # host DISPATCH window of the grad program (XLA executes
+            # asynchronously behind it) — the async-host-loop timeline
+            self.monitor.add_phase("grad_dispatch", _tp0,
+                                   step=self.global_steps + 1)
         if profile_now:
             jax.block_until_ready(loss)
             prof.stop_profile()
@@ -990,10 +1054,16 @@ class DeepSpeedEngine:
             "backward() called before forward()"
         if self.wall_clock_breakdown():
             self.timers(BACKWARD_MICRO_TIMER).start()
+        trace_on = self.monitor is not None and self.monitor.trace_active
+        if trace_on:
+            _tp0 = time.perf_counter()
         if self._grad_acc is None:
             self._grad_acc = self._cached_grads
         else:
             self._grad_acc = self._acc_fn(self._grad_acc, self._cached_grads)
+        if trace_on:
+            self.monitor.add_phase("accumulate_dispatch", _tp0,
+                                   step=self.global_steps + 1)
         self._cached_grads = None
         self.micro_steps += 1
         if self.wall_clock_breakdown():
@@ -1018,12 +1088,19 @@ class DeepSpeedEngine:
                 # trajectory and are dropped wholesale
                 self._grad_acc = None
                 self._last_overflow = None
+                if self.monitor is not None:
+                    # no record for the rewound step — reset the arrival
+                    # clock so the next record's wall time stays per-step
+                    self.monitor.discard_step()
                 if self.wall_clock_breakdown():
                     self.timers(STEP_MICRO_TIMER).stop()
                 self._maybe_handle_preemption()
                 return
             sentinel_skip = verdict == "skip"
 
+        trace_on = self.monitor is not None and self.monitor.trace_active
+        if trace_on:
+            _tp0 = time.perf_counter()
         if self._offload_enabled:
             # host-side optimizer: a sentinel skip simply never runs it
             overflow = False if sentinel_skip else self._offload_step()
@@ -1036,6 +1113,9 @@ class DeepSpeedEngine:
             (self.params, self.opt_state, self.scaler_state,
              overflow) = self._apply_fn(self.params, self.opt_state,
                                         self.scaler_state, self._grad_acc)
+        if trace_on:
+            self.monitor.add_phase("apply_dispatch", _tp0,
+                                   step=self.global_steps + 1)
         self._grad_acc = None
         self._last_overflow = overflow
         self.global_steps += 1
@@ -1088,6 +1168,12 @@ class DeepSpeedEngine:
                     self.params = self._quantize_fn(bits)(
                         self.params, self._next_rng())
         self.tput_timer.stop(global_step=True)
+        if self.monitor is not None:
+            # O(1) host work: the loss stays a device-array REFERENCE;
+            # the monitor batch-fetches the window at its flush boundary
+            self.monitor.end_step(self.global_steps, loss=self._last_loss,
+                                  tokens=self._monitor_tokens_per_step(),
+                                  counters=self._monitor_counters())
         self._boundary_logging()
         if self.wall_clock_breakdown():
             self.timers(STEP_MICRO_TIMER).stop()
@@ -1126,6 +1212,89 @@ class DeepSpeedEngine:
                 self.global_steps * self.train_batch_size())
             self._summary_writer.add_scalar("Train/Samples/lr", lr,
                                             self.global_steps)
+
+    # ------------------------------------------------------------------ #
+    # runtime telemetry monitor (docs/telemetry.md)
+    # ------------------------------------------------------------------ #
+    def _configure_monitor(self):
+        """Build the TrainingMonitor.  Predictions come from the Program/
+        Schedule Auditor: reuse the init-time report when the analysis
+        block is on, otherwise trace one quietly (best-effort — the
+        monitor must work on engines the auditor cannot model)."""
+        from ..monitor import TrainingMonitor
+        report = self.program_audit
+        if report is None:
+            try:
+                from ..analysis import audit_engine
+                report = audit_engine(self, multihost=False)
+            except Exception as e:  # noqa: BLE001 — predictions optional
+                logger.warning(
+                    f"monitor: static predictions unavailable ({e}) — "
+                    "reconciliation will carry measured values only")
+        predictions = None
+        if report is not None and report.step_time is not None:
+            from ..analysis import per_lane_predictions
+            if self.predicted_step_time_lb_s is None:
+                self.predicted_step_time_lb_s = (
+                    report.predicted_step_time_lb_s)
+            predictions = {
+                "predicted_step_time_lb_s":
+                    report.predicted_step_time_lb_s,
+                "lanes": per_lane_predictions(report.step_time),
+                "peak_hbm_bytes": report.peak_hbm_bytes,
+            }
+        return TrainingMonitor(
+            self.config.monitor_config,
+            steps_per_print=self.steps_per_print(),
+            predictions=predictions,
+            summary_writer=self._summary_writer,
+            boundary_fn=self._monitor_boundary_reads,
+            meta={"engine": type(self).__name__,
+                  "zero_stage": self.config.zero_optimization_stage,
+                  "dtype": str(self.compute_dtype.__name__),
+                  "gas": self.gradient_accumulation_steps(),
+                  "micro_batch": self.train_micro_batch_size_per_gpu(),
+                  "world_size": self.world_size,
+                  "fused_step": self._fused_step_fn is not None})
+
+    def _monitor_boundary_reads(self) -> Dict[str, Any]:
+        """Flush-boundary device reads, batched: one lr (may read an
+        opt-state scalar) and one loss-scale scalar per WINDOW — never
+        per step (the same discipline as _boundary_logging)."""
+        out: Dict[str, Any] = {"lr": self.get_lr()[0]}
+        try:
+            out["loss_scale"] = float(self.scaler_state.loss_scale)
+        except Exception:  # noqa: BLE001
+            out["loss_scale"] = None
+        return out
+
+    def _monitor_counters(self) -> Dict[str, Any]:
+        """Host-side integers only — free to copy every step."""
+        from ..monitor import record as mrec
+        counters = {mrec.F_SKIPPED_STEPS: self.skipped_steps,
+                    mrec.F_DISPATCHES_PER_STEP: self._dispatches_per_step}
+        if self.sentinel is not None:
+            c = self.sentinel.counters()
+            counters[mrec.F_SENTINEL_ANOMALIES] = c["anomalies_seen"]
+            counters[mrec.F_SENTINEL_SKIPS] = c["steps_skipped"]
+        if self._recompile_guard is not None:
+            counters[mrec.F_RETRACES] = (
+                self._recompile_guard.counters().get("retraces_seen"))
+        return counters
+
+    def _monitor_note_batch(self, tree) -> None:
+        """Capture the sequence length from batch SHAPES (host metadata,
+        no data read) so records can carry tokens/s.  Both paths pass
+        UNSTACKED microbatches ([B, S] leaves)."""
+        for leaf in jax.tree.leaves(tree):
+            if getattr(leaf, "ndim", 0) >= 2:
+                self._monitor_seq = leaf.shape[1]
+                return
+
+    def _monitor_tokens_per_step(self) -> Optional[int]:
+        if self._monitor_seq is None:
+            return None
+        return self.train_batch_size() * self._monitor_seq
 
     # ------------------------------------------------------------------ #
     # program auditor: runtime recompile guard (docs/program_auditor.md)
@@ -1448,17 +1617,30 @@ class DeepSpeedEngine:
             b = next(data_iter)
             batches.append(b if isinstance(b, tuple) else (b,))
         if self.wall_clock_breakdown():
-            self.timers(STEP_MICRO_TIMER).start()
+            # window-level timer: the whole gas window is ONE dispatch, so
+            # forward/backward micro timers cannot exist here (logged once
+            # at build time)
+            self.timers(FUSED_STEP_TIMER).start()
         self.tput_timer.start()
+        if self.monitor is not None:
+            self.monitor.mark_step_start()
+            self._monitor_note_batch(batches[0])
         stacked = stack_microbatches(batches)
         self._observe_retrace(stacked)
         args = self._shard_stacked_batch(stacked)
         rng = self._next_rng()
+        trace_on = self.monitor is not None and self.monitor.trace_active
+        if trace_on:
+            _tp0 = time.perf_counter()
         (self.params, self.opt_state, self.scaler_state,
          self._fused_sent_state, loss, overflow,
          sent_flags) = self._fused_step_fn(
             self.params, self.opt_state, self.scaler_state,
             self._fused_sent_state, rng, args, {})
+        if trace_on:
+            self.monitor.add_phase(
+                getattr(self, "_fused_dispatch_label", "fused_dispatch"),
+                _tp0, step=self.global_steps + 1)
         self._last_loss = loss
         self._last_overflow = overflow
         self.micro_steps += gas
@@ -1500,9 +1682,13 @@ class DeepSpeedEngine:
                 self.sentinel.abort(self.global_steps,
                                     float(self._last_loss))
         self.tput_timer.stop(global_step=True)
+        if self.monitor is not None:
+            self.monitor.end_step(self.global_steps, loss=loss,
+                                  tokens=self._monitor_tokens_per_step(),
+                                  counters=self._monitor_counters())
         self._boundary_logging()
         if self.wall_clock_breakdown():
-            self.timers(STEP_MICRO_TIMER).stop()
+            self.timers(FUSED_STEP_TIMER).stop()
         self._maybe_handle_preemption()
         return loss
 

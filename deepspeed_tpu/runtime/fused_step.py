@@ -227,6 +227,11 @@ def build_fused_step(engine):
     engine._fused_step_raw = fused_step
     engine._fused_donate_argnums = (0, 1)
     engine._fused_scan_info = {"gas_scan_length": gas}
+    # telemetry provenance (monitor/record.py dispatches_per_step; the
+    # trace exporter labels the whole-window span with this): the fused
+    # path is ONE dispatch where the modular loop issues 2*gas
+    engine._dispatches_per_step = 1
+    engine._fused_dispatch_label = f"fused_step(gas={gas})"
     return jax.jit(
         fused_step,
         out_shardings=(engine.param_shardings, engine.opt_shardings,

@@ -11,6 +11,7 @@ instead of draining the whole pool (the wait-at-use pattern the ZeRO-
 Infinity streaming engine had to drop — a shared wait() serializes every
 in-flight neighbour behind the slowest write)."""
 
+import time
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -20,7 +21,13 @@ from .utils import SwapBuffer, SwapBufferPool
 
 
 class InflightTensorWrite:
-    """One issued swap_out; wait() lands it and reclaims its buffer."""
+    """One issued swap_out; wait() lands it and reclaims its buffer.
+
+    Issue/wait timestamps mirror InflightGroupRead's: ``hidden_s`` is the
+    window the disk worked before the caller needed the buffer back,
+    ``exposed_s`` the time the caller actually blocked — the monitor's
+    trace exporter turns the issue→done window into a span
+    (docs/telemetry.md)."""
 
     def __init__(self, swapper: "AsyncTensorSwapper", buf: SwapBuffer,
                  handle: AsyncIOHandle, path: str):
@@ -29,10 +36,15 @@ class InflightTensorWrite:
         self._handle = handle
         self.path = path
         self._done = False
+        self.nbytes = 0  # stamped by swap_out once the view is staged
+        self.t_issue = time.perf_counter()
+        self.hidden_s: Optional[float] = None
+        self.exposed_s: Optional[float] = None
 
     def wait(self) -> None:
         if self._done:
             return
+        t0 = time.perf_counter()
         try:
             self._handle.wait()
         finally:
@@ -40,7 +52,10 @@ class InflightTensorWrite:
             # an ENOSPC-style error leaks the slot and later swap_outs
             # wedge on 'pool exhausted' instead of the real I/O error
             self._done = True
-            self._swapper._retire(self)
+            t1 = time.perf_counter()
+            self.hidden_s = t0 - self.t_issue
+            self.exposed_s = t1 - t0
+            self._swapper._retire(self, t_done=t1)
 
     @property
     def done(self) -> bool:
@@ -67,6 +82,14 @@ class AsyncTensorSwapper:
         else:  # python sync fallback: sharing is free, writes are eager
             self._handles = [handle] * buffer_count
         self._inflight: List[InflightTensorWrite] = []
+        # completed-write windows for the monitor's trace exporter;
+        # bounded so unmonitored swappers never grow it
+        self._write_events: List[dict] = []
+
+    def drain_write_events(self) -> List[dict]:
+        """Return-and-reset completed issue→done write windows."""
+        done, self._write_events = self._write_events, []
+        return done
 
     def swap_out(self, array: np.ndarray, path: str) -> InflightTensorWrite:
         """Stage `array` into a pool buffer and write asynchronously;
@@ -83,13 +106,23 @@ class AsyncTensorSwapper:
             self.pool.release(buf)  # submission failed: no leak
             raise
         op = InflightTensorWrite(self, buf, handle, path)
+        op.nbytes = int(array.nbytes)
         self._inflight.append(op)
         return op
 
-    def _retire(self, op: InflightTensorWrite) -> None:
+    def _retire(self, op: InflightTensorWrite,
+                t_done: Optional[float] = None) -> None:
         if op in self._inflight:
             self._inflight.remove(op)
             self.pool.release(op._buf)
+            if t_done is not None:
+                self._write_events.append({
+                    "name": op.path.rsplit("/", 1)[-1],
+                    "bytes": float(op.nbytes),
+                    "t_issue": op.t_issue, "t_done": t_done,
+                    "wait_s": op.exposed_s})
+                if len(self._write_events) > 512:
+                    self._write_events = self._write_events[-512:]
 
     def synchronize(self) -> None:
         """Wait for all in-flight writes; reclaim buffers."""

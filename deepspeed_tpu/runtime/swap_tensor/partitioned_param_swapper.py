@@ -146,6 +146,10 @@ class PartitionedParamSwapper:
             "read_bytes": 0.0, "read_hidden_s": 0.0, "read_exposed_s": 0.0,
             "prefetch_hits": 0.0, "serialized_reads": 0.0,
             "write_bytes": 0.0, "write_wait_s": 0.0}
+        # per-write issue→flush windows for the monitor's trace exporter
+        # (docs/telemetry.md); drained by drain_write_events, bounded so
+        # an unmonitored engine never grows it past one step's writes
+        self._write_events: List[Dict[str, float]] = []
         log_dist(
             f"ZeRO-Infinity param swapper: {len(self.groups)} groups, "
             f"window={self.buffer_count} x {max_bytes >> 20}MiB at "
@@ -205,6 +209,8 @@ class PartitionedParamSwapper:
         # async submission only borrows the buffer — pin it until wait()
         # (the reference pins its bounce buffers for the same reason)
         self._inflight_writes.append(flat)
+        self._write_events.append({"name": name, "bytes": float(g.nbytes),
+                                   "t_issue": time.perf_counter()})
         self.write_handle.pwrite(flat, self._path(name), async_op=async_op)
         self.stats["write_bytes"] += g.nbytes
         if not async_op:
@@ -213,8 +219,22 @@ class PartitionedParamSwapper:
     def flush_writes(self) -> None:
         t0 = time.perf_counter()
         self.write_handle.wait()
-        self.stats["write_wait_s"] += time.perf_counter() - t0
+        t1 = time.perf_counter()
+        self.stats["write_wait_s"] += t1 - t0
         self._inflight_writes.clear()
+        for ev in self._write_events:
+            if "t_done" not in ev:
+                ev["t_done"] = t1
+                ev["wait_s"] = t1 - t0
+        if len(self._write_events) > 512:  # unmonitored engines: bounded
+            self._write_events = self._write_events[-512:]
+
+    def drain_write_events(self) -> List[Dict[str, float]]:
+        """Return-and-reset completed write windows (pending ones stay)."""
+        done = [e for e in self._write_events if "t_done" in e]
+        self._write_events = [e for e in self._write_events
+                              if "t_done" not in e]
+        return done
 
     def prefetch(self, name: str) -> None:
         if name in self._resident or name in self._pending:

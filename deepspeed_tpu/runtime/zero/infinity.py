@@ -184,6 +184,7 @@ class ZeroInfinityEngine:
                 self._swapper.write(name, tree, async_op=True)
             self._swapper.flush_writes()
             self._swapper.snapshot_stats()  # init writes are not step I/O
+            self._swapper.drain_write_events()  # ...nor step trace spans
             self._host_groups = None
         else:
             self._swapper = None
@@ -283,6 +284,27 @@ class ZeroInfinityEngine:
             batch_size=self.config.train_micro_batch_size_per_gpu,
             num_workers=dp,
             steps_per_output=self.config.steps_per_print)
+        # ---- runtime telemetry monitor (docs/telemetry.md) ------------ #
+        # The streaming engine has no static roofline (its step is a
+        # host-driven sweep, not one traced program) — reconciliation
+        # here is the SWAP lane: achieved GB/s + overlap vs the aio
+        # sweep ceiling, which _finalize_swap_stats measures per step.
+        self.monitor = None
+        self._monitor_seq = None
+        if self.config.monitor_config.enabled and jax.process_index() == 0:
+            from ...monitor import TrainingMonitor
+            self.monitor = TrainingMonitor(
+                self.config.monitor_config,
+                steps_per_print=self.config.steps_per_print,
+                predictions=None,
+                boundary_fn=self._monitor_boundary_reads,
+                swap_stats_fn=lambda: self.last_swap_stats,
+                meta={"engine": type(self).__name__,
+                      "params_on": ("nvme" if self._use_nvme_params
+                                    else "host"),
+                      "aio_backend": self.aio_backend,
+                      "prefetch_depth": self._prefetch_depth,
+                      "sweep_ceiling": self.sweep_ceiling})
         n_params = sum(int(np.prod(np.shape(l)))
                        for l in jax.tree.leaves(model_parameters))
         log_dist(
@@ -399,9 +421,14 @@ class ZeroInfinityEngine:
                 if k not in inflight:
                     inflight[k] = self._swap_in(plan[k])
         if handle.nbytes:
+            # t_issue/t_done are absolute perf_counter stamps: the monitor
+            # trace exporter turns the window into a Perfetto span
             self._swap_events.append({
                 "name": plan[pos], "bytes": float(handle.nbytes),
-                "hidden_s": handle.hidden_s, "exposed_s": handle.exposed_s})
+                "hidden_s": handle.hidden_s, "exposed_s": handle.exposed_s,
+                "t_issue": handle.t_issue,
+                "t_done": (handle.t_issue + handle.hidden_s +
+                           handle.exposed_s)})
         self._live_now += 1
         self.max_live_param_groups = max(self.max_live_param_groups,
                                          self._live_now)
@@ -435,6 +462,9 @@ class ZeroInfinityEngine:
         device — swap-in latency hides under MXU work instead of
         serializing the sweep."""
         self.tput_timer.start()
+        if self.monitor is not None:
+            self.monitor.mark_step_start()
+            self._monitor_seq = int(np.shape(input_ids)[-1])
         if self._step_t0 is None:
             self._step_t0 = time.perf_counter()
         self._t("fwd start")
@@ -613,6 +643,17 @@ class ZeroInfinityEngine:
         self.global_steps += 1
         self.tput_timer.stop(global_step=True)
         self._finalize_swap_stats()
+        if self.monitor is not None:
+            from ...monitor import record as mrec
+            tokens = (self.config.train_batch_size * self._monitor_seq
+                      if self._monitor_seq else None)
+            self.monitor.end_step(
+                self.global_steps, loss=self._last_loss, tokens=tokens,
+                counters={mrec.F_SKIPPED_STEPS: self.skipped_steps,
+                          mrec.F_DISPATCHES_PER_STEP: None},
+                # THIS step's swap stats are already host data — records
+                # carry per-step values, not the window boundary's
+                swap=self.last_swap_stats)
         if self.global_steps % self.config.steps_per_print == 0:
             stats = self.last_swap_stats or {}
             extra = ""
@@ -635,6 +676,15 @@ class ZeroInfinityEngine:
         group's read was paid inline on the critical path)."""
         events, self._swap_events = self._swap_events, []
         t0, self._step_t0 = self._step_t0, None
+        if (self.monitor is not None and self.monitor.trace_active
+                and self._swapper is not None):
+            # the step's I/O timeline becomes Perfetto spans: swap-in
+            # issue→done windows (+ exposed-wait tails) and the write-
+            # back issue→flush windows
+            self.monitor.trace.add_swap_read_events(
+                events, step=self.global_steps)
+            self.monitor.trace.add_swap_write_events(
+                self._swapper.drain_write_events(), step=self.global_steps)
         if self._swapper is None:
             self.last_swap_stats = None
             return
@@ -693,6 +743,17 @@ class ZeroInfinityEngine:
         """Swap-overlap report for the last completed optimizer step."""
         return self.last_swap_stats
 
+    def _monitor_boundary_reads(self) -> Dict[str, Any]:
+        """Flush-boundary reads for the monitor (host-side: the streaming
+        optimizer tier owns its step count as a plain int)."""
+        lr = None
+        if self.lr_scheduler is not None:
+            try:
+                lr = float(self.lr_scheduler.lr_at(self._opt.step_count()))
+            except Exception:  # noqa: BLE001
+                lr = None
+        return {"lr": lr, "loss_scale": None}
+
     # ------------------------------------------------------------------ #
     def module_state_dict(self):
         """Consolidated fp32 master weights (from the optimizer tier)."""
@@ -731,6 +792,9 @@ class ZeroInfinityEngine:
             for name, tree in new_groups.items():
                 self._swapper.write(name, tree, async_op=True)
             self._swapper.flush_writes()
+            # restore writes are not step I/O: keep them out of the next
+            # step's trace (same exclusion as the init write-back)
+            self._swapper.drain_write_events()
         else:
             self._host_groups = new_groups
         self.global_steps = client.get("global_steps", 0)

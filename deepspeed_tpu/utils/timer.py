@@ -8,21 +8,35 @@ which (by in-order execution per device) drains previously dispatched work.
 import time
 from typing import Dict, List, Optional
 
-from .logging import log_dist
+from .logging import log_dist, logger
+
+# one debug line per process, not one per timed step
+_sync_failure_logged = False
 
 
 def _device_sync():
+    """Drain the XLA dispatch queue.  Failures are narrowed: only the
+    expected benign cases (no jax installed: ImportError; backend not
+    initialized / torn down mid-exit: RuntimeError) are swallowed — and
+    even those are logged once at debug, because a sync that silently
+    fails times the queue depth as ~0 and every derived number lies."""
+    global _sync_failure_logged
     try:
         import jax
         import jax.numpy as jnp
         jnp.zeros(()).block_until_ready()
-        # effects_barrier waits for any outstanding host callbacks too.
-        try:
-            jax.effects_barrier()
-        except Exception:
-            pass
-    except Exception:
-        pass
+        # effects_barrier waits for any outstanding host callbacks too;
+        # older jax versions lack it (AttributeError is a version fact,
+        # not a sync failure)
+        barrier = getattr(jax, "effects_barrier", None)
+        if barrier is not None:
+            barrier()
+    except (ImportError, RuntimeError) as e:
+        if not _sync_failure_logged:
+            _sync_failure_logged = True
+            logger.debug(f"timer device sync unavailable "
+                         f"({type(e).__name__}: {e}) — timings will not "
+                         "drain the dispatch queue")
 
 
 class SynchronizedWallClockTimer:

@@ -36,7 +36,15 @@ def test_resilience_package_imports_cleanly():
             # the analysis block is on) and by the CLI entry point
             "deepspeed_tpu.analysis",
             "deepspeed_tpu.analysis.cli",
-            "deepspeed_tpu.analysis.__main__")
+            "deepspeed_tpu.analysis.__main__",
+            # telemetry monitor: lazily imported by the engines (only
+            # when the monitor block is on)
+            "deepspeed_tpu.monitor",
+            "deepspeed_tpu.monitor.record",
+            "deepspeed_tpu.monitor.writers",
+            "deepspeed_tpu.monitor.trace",
+            "deepspeed_tpu.monitor.reconcile",
+            "deepspeed_tpu.monitor.monitor")
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     out = subprocess.run(
