@@ -307,11 +307,20 @@ def _program_audit_fields(engine, measured_step_s=None):
     attribution, and measured memory vs the liveness estimate.  A
     stale/wedged run's last row then carries WHY it was slow, not just a
     stale-mark."""
+    out = {}
+    if measured_step_s is not None:
+        # per-host spread + straggler verdict (degenerate on 1 host).
+        # Hoisted OUTSIDE the audit try: the allgather inside must run
+        # on every host even when the audit throws on one of them —
+        # were it downstream of the audit, a host-local audit error
+        # would skip this host's exchange while every peer blocks in
+        # the timeout-less collective
+        out.update(_fleet_summary_fields(measured_step_s))
     try:
         from deepspeed_tpu.analysis import audit_engine
         report = audit_engine(engine, multihost=False)
         lb = report.predicted_step_time_lb_s
-        out = {
+        out.update({
             "lockstep_signature": (report.signature or "")[:16],
             "wire_bytes_per_step": report.wire_bytes_per_step,
             "audit_findings": report.counts(),
@@ -322,13 +331,55 @@ def _program_audit_fields(engine, measured_step_s=None):
             "peak_hbm_bytes": report.peak_hbm_bytes,
             "predicted_step_time_lb": (round(lb, 6)
                                        if lb is not None else None),
-        }
+        })
         if measured_step_s is not None and report.step_time is not None:
             out["reconciliation"] = _reconciliation_summary(
                 report, measured_step_s)
-        return out
     except Exception as e:  # noqa: BLE001 — provenance is best-effort
-        return {"lockstep_signature": f"audit-failed: {e}"[:80]}
+        out["lockstep_signature"] = f"audit-failed: {e}"[:80]
+    return out
+
+
+def _fleet_summary_fields(measured_step_s, final_loss=None,
+                          swap=None):
+    """Per-host attribution for a ladder row (monitor/fleet.py).
+
+    On a multihost run every process reaches this point in lockstep (the
+    whole bench row is lockstep), so the one fixed-shape allgather here
+    is safe — the row then lands with the per-host step-time spread and
+    a one-shot straggler verdict, so a slow POD number names the slow
+    HOST (ROADMAP items 1/3/5's on-chip runs).  A single-process run
+    records the degenerate 1-host summary: the field shape is identical,
+    so downstream tooling never branches.  Best-effort like the audit
+    fields — a row must never fail on its own telemetry."""
+    try:
+        import jax
+        from deepspeed_tpu.monitor import (FleetAggregator,
+                                           straggler_verdict,
+                                           summarize_fleet)
+        agg = FleetAggregator(process_index=jax.process_index(),
+                              process_count=jax.process_count())
+        summary = {
+            "last_step": 0,
+            "steps": 1,
+            "step_time_mean_s": measured_step_s,
+            "step_time_max_s": measured_step_s,
+            "loss_mean": final_loss,
+        }
+        if swap:
+            summary["swap_read_gbps"] = swap.get("read_gbps")
+            summary["swap_exposed_mean_s"] = (
+                (swap.get("read_exposed_s") or 0.0)
+                + (swap.get("write_exposed_s") or 0.0))
+        matrix = agg.exchange(summary)
+        hosts = agg.host_names()
+        fleet = summarize_fleet(matrix)
+        fleet.pop("window_end_step", None)
+        fleet["host_names"] = hosts
+        fleet["straggler"] = straggler_verdict(matrix, hosts)
+        return {"fleet": fleet}
+    except Exception as e:  # noqa: BLE001 — provenance is best-effort
+        return {"fleet": {"error": f"{e}"[:80]}}
 
 
 def _reconciliation_summary(report, measured_step_s):
@@ -1198,6 +1249,11 @@ def bench_infinity_stream():
         "final_loss": round(losses_on[-1], 4),
         "reconciliation": _swap_reconciliation(on, ceiling,
                                                dt_on / steps),
+        **_fleet_summary_fields(
+            dt_on / steps, final_loss=float(losses_on[-1]),
+            swap={"read_gbps": on["read_gbps"],
+                  "read_exposed_s": on["read_exposed_s"],
+                  "write_exposed_s": on["write_exposed_s"]}),
     }
 
 

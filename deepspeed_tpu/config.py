@@ -514,12 +514,70 @@ class FusedStepConfig:
 
 
 @dataclass
+class MonitorCaptureConfig:
+    """Anomaly-triggered deep profiling (monitor/capture.py): a bounded
+    ``jax.profiler`` trace capture armed when a reconciliation band is
+    breached or a fleet health event flags THIS host.  Off by default;
+    rate-limited so a persistently-bad band yields a few traces, never a
+    full-run profile."""
+    enabled: bool = C.MONITOR_CAPTURE_ENABLED_DEFAULT
+    steps: int = C.MONITOR_CAPTURE_STEPS_DEFAULT
+    max_captures: int = C.MONITOR_CAPTURE_MAX_CAPTURES_DEFAULT
+    cooldown_steps: int = C.MONITOR_CAPTURE_COOLDOWN_STEPS_DEFAULT
+    output_path: str = C.MONITOR_CAPTURE_OUTPUT_PATH_DEFAULT
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "MonitorCaptureConfig":
+        if d is True:
+            # the natural shorthand for "just turn it on"
+            d = {C.MONITOR_CAPTURE_ENABLED: True}
+        elif d in (None, False):
+            d = {}
+        elif not isinstance(d, dict):
+            raise DeepSpeedConfigError(
+                f"monitor.capture must be a config object (or true/"
+                f"false), got {d!r}")
+        cfg = MonitorCaptureConfig(
+            enabled=bool(get_scalar_param(
+                d, C.MONITOR_CAPTURE_ENABLED,
+                C.MONITOR_CAPTURE_ENABLED_DEFAULT)),
+            steps=int(get_scalar_param(
+                d, C.MONITOR_CAPTURE_STEPS,
+                C.MONITOR_CAPTURE_STEPS_DEFAULT)),
+            max_captures=int(get_scalar_param(
+                d, C.MONITOR_CAPTURE_MAX_CAPTURES,
+                C.MONITOR_CAPTURE_MAX_CAPTURES_DEFAULT)),
+            cooldown_steps=int(get_scalar_param(
+                d, C.MONITOR_CAPTURE_COOLDOWN_STEPS,
+                C.MONITOR_CAPTURE_COOLDOWN_STEPS_DEFAULT)),
+            output_path=get_scalar_param(
+                d, C.MONITOR_CAPTURE_OUTPUT_PATH,
+                C.MONITOR_CAPTURE_OUTPUT_PATH_DEFAULT) or "",
+        )
+        if cfg.steps <= 0:
+            raise DeepSpeedConfigError(
+                f"monitor.capture.steps must be positive, got {cfg.steps}")
+        if cfg.max_captures <= 0:
+            raise DeepSpeedConfigError(
+                "monitor.capture.max_captures must be positive, got "
+                f"{cfg.max_captures}")
+        if cfg.cooldown_steps < 0:
+            raise DeepSpeedConfigError(
+                "monitor.capture.cooldown_steps must be >= 0, got "
+                f"{cfg.cooldown_steps}")
+        return cfg
+
+
+@dataclass
 class MonitorConfig:
     """Runtime telemetry block (docs/telemetry.md): per-step structured
     metric records, pluggable writers, optional Chrome/Perfetto trace
-    export, and the measured-vs-predicted reconciliation report.  Off by
-    default; with it on, all host reads stay batched at flush-window
-    boundaries (the async-host-loop discipline)."""
+    export, and the measured-vs-predicted reconciliation report — plus
+    the fleet layer (cross-host aggregation + straggler/divergence
+    health, heartbeat liveness, anomaly-triggered profiler capture).
+    Off by default; with it on, all host reads AND all cross-host
+    aggregation traffic stay batched at flush-window boundaries (the
+    async-host-loop discipline)."""
     enabled: bool = C.MONITOR_ENABLED_DEFAULT
     output_path: str = C.MONITOR_OUTPUT_PATH_DEFAULT
     job_name: str = C.MONITOR_JOB_NAME_DEFAULT
@@ -531,6 +589,14 @@ class MonitorConfig:
     step_time_ratio_max: float = C.MONITOR_STEP_TIME_RATIO_MAX_DEFAULT
     hbm_ratio_max: float = C.MONITOR_HBM_RATIO_MAX_DEFAULT
     swap_min_vs_ceiling: float = C.MONITOR_SWAP_MIN_VS_CEILING_DEFAULT
+    fleet: bool = C.MONITOR_FLEET_DEFAULT
+    heartbeat: bool = C.MONITOR_HEARTBEAT_DEFAULT
+    straggler_zscore: float = C.MONITOR_STRAGGLER_ZSCORE_DEFAULT
+    straggler_min_ratio: float = C.MONITOR_STRAGGLER_MIN_RATIO_DEFAULT
+    divergence_rel_spread: float = C.MONITOR_DIVERGENCE_REL_SPREAD_DEFAULT
+    health_warmup_windows: int = C.MONITOR_HEALTH_WARMUP_WINDOWS_DEFAULT
+    capture: MonitorCaptureConfig = field(
+        default_factory=MonitorCaptureConfig)
 
     @staticmethod
     def from_dict(d: Optional[Dict[str, Any]]) -> "MonitorConfig":
@@ -571,6 +637,24 @@ class MonitorConfig:
             swap_min_vs_ceiling=float(get_scalar_param(
                 d, C.MONITOR_SWAP_MIN_VS_CEILING,
                 C.MONITOR_SWAP_MIN_VS_CEILING_DEFAULT)),
+            fleet=bool(get_scalar_param(d, C.MONITOR_FLEET,
+                                        C.MONITOR_FLEET_DEFAULT)),
+            heartbeat=bool(get_scalar_param(d, C.MONITOR_HEARTBEAT,
+                                            C.MONITOR_HEARTBEAT_DEFAULT)),
+            straggler_zscore=float(get_scalar_param(
+                d, C.MONITOR_STRAGGLER_ZSCORE,
+                C.MONITOR_STRAGGLER_ZSCORE_DEFAULT)),
+            straggler_min_ratio=float(get_scalar_param(
+                d, C.MONITOR_STRAGGLER_MIN_RATIO,
+                C.MONITOR_STRAGGLER_MIN_RATIO_DEFAULT)),
+            divergence_rel_spread=float(get_scalar_param(
+                d, C.MONITOR_DIVERGENCE_REL_SPREAD,
+                C.MONITOR_DIVERGENCE_REL_SPREAD_DEFAULT)),
+            health_warmup_windows=int(get_scalar_param(
+                d, C.MONITOR_HEALTH_WARMUP_WINDOWS,
+                C.MONITOR_HEALTH_WARMUP_WINDOWS_DEFAULT)),
+            capture=MonitorCaptureConfig.from_dict(
+                d.get(C.MONITOR_CAPTURE)),
         )
         unknown = [w for w in cfg.writers if w not in C.MONITOR_WRITER_KINDS]
         if unknown:
@@ -602,6 +686,23 @@ class MonitorConfig:
             raise DeepSpeedConfigError(
                 "monitor.swap_min_vs_ceiling must be in [0, 1], got "
                 f"{cfg.swap_min_vs_ceiling}")
+        if cfg.straggler_zscore <= 0:
+            raise DeepSpeedConfigError(
+                "monitor.straggler_zscore must be positive, got "
+                f"{cfg.straggler_zscore}")
+        if cfg.straggler_min_ratio < 1.0:
+            raise DeepSpeedConfigError(
+                "monitor.straggler_min_ratio must be >= 1.0 (a straggler "
+                "is SLOWER than the fleet median), got "
+                f"{cfg.straggler_min_ratio}")
+        if cfg.divergence_rel_spread <= 0:
+            raise DeepSpeedConfigError(
+                "monitor.divergence_rel_spread must be positive, got "
+                f"{cfg.divergence_rel_spread}")
+        if cfg.health_warmup_windows < 0:
+            raise DeepSpeedConfigError(
+                "monitor.health_warmup_windows must be >= 0, got "
+                f"{cfg.health_warmup_windows}")
         return cfg
 
 

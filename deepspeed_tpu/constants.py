@@ -248,6 +248,36 @@ MONITOR_HBM_RATIO_MAX = "hbm_ratio_max"
 MONITOR_HBM_RATIO_MAX_DEFAULT = 2.0
 MONITOR_SWAP_MIN_VS_CEILING = "swap_min_vs_ceiling"
 MONITOR_SWAP_MIN_VS_CEILING_DEFAULT = 0.25
+# ---- fleet observability (monitor/fleet.py, docs/telemetry.md) ------- #
+# fleet: every process contributes a window vector to a boundary-only
+# allgather; rank 0 emits per-host + fleet-aggregate records and every
+# host runs the straggler/divergence detection (monitor/health.py)
+MONITOR_FLEET = "fleet"
+MONITOR_FLEET_DEFAULT = False
+# heartbeat: per-host liveness files under <output_path>/heartbeat,
+# written at flush boundaries (dslaunch --watch renders them)
+MONITOR_HEARTBEAT = "heartbeat"
+MONITOR_HEARTBEAT_DEFAULT = False
+MONITOR_STRAGGLER_ZSCORE = "straggler_zscore"
+MONITOR_STRAGGLER_ZSCORE_DEFAULT = 3.0
+MONITOR_STRAGGLER_MIN_RATIO = "straggler_min_ratio"
+MONITOR_STRAGGLER_MIN_RATIO_DEFAULT = 1.15
+MONITOR_DIVERGENCE_REL_SPREAD = "divergence_rel_spread"
+MONITOR_DIVERGENCE_REL_SPREAD_DEFAULT = 1e-3
+MONITOR_HEALTH_WARMUP_WINDOWS = "health_warmup_windows"
+MONITOR_HEALTH_WARMUP_WINDOWS_DEFAULT = 2
+# ---- anomaly-triggered deep profiling (monitor/capture.py) ----------- #
+MONITOR_CAPTURE = "capture"
+MONITOR_CAPTURE_ENABLED = "enabled"
+MONITOR_CAPTURE_ENABLED_DEFAULT = False
+MONITOR_CAPTURE_STEPS = "steps"
+MONITOR_CAPTURE_STEPS_DEFAULT = 8
+MONITOR_CAPTURE_MAX_CAPTURES = "max_captures"
+MONITOR_CAPTURE_MAX_CAPTURES_DEFAULT = 2
+MONITOR_CAPTURE_COOLDOWN_STEPS = "cooldown_steps"
+MONITOR_CAPTURE_COOLDOWN_STEPS_DEFAULT = 100
+MONITOR_CAPTURE_OUTPUT_PATH = "output_path"
+MONITOR_CAPTURE_OUTPUT_PATH_DEFAULT = ""
 
 #############################################
 # Tensorboard

@@ -23,6 +23,8 @@ import os
 import shlex
 import subprocess
 import sys
+import threading
+import time
 from collections import OrderedDict
 from typing import Dict, List, Optional
 
@@ -61,6 +63,19 @@ def parse_args(args=None):
     parser.add_argument("--force_multi", action="store_true")
     parser.add_argument("--dry_run", action="store_true",
                         help="print the per-host commands, launch nothing")
+    parser.add_argument("--watch", type=str, default="",
+                        help="monitor output dir (the training config's "
+                             "monitor.output_path on a shared filesystem): "
+                             "while workers run, render the per-host "
+                             "heartbeat status table every "
+                             "--watch_interval seconds "
+                             "(monitor/heartbeat.py; needs "
+                             "monitor.heartbeat=true in the ds config)")
+    parser.add_argument("--watch_interval", type=float, default=10.0)
+    parser.add_argument("--watch_stale_s", type=float, default=60.0,
+                        help="a running host whose heartbeat is older "
+                             "than max(this, 3x its own beat interval) "
+                             "is rendered STALE")
     parser.add_argument("user_script", type=str)
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
     return parser.parse_args(args)
@@ -170,12 +185,113 @@ def build_host_commands(resources: "OrderedDict[str, List[int]]",
     return cmds
 
 
+def _pump_lines(stream, sink, prefix: str) -> None:
+    """Copy one worker stream line-by-line with a ``[host:rank]`` prefix
+    — multi-host logs interleave LABELED instead of as an anonymous
+    shuffle.  Line granularity keeps each record intact under
+    interleaving (partial lines are only possible at process exit)."""
+    try:
+        for line in iter(stream.readline, ""):
+            sink.write(prefix + line)
+            sink.flush()
+    except ValueError:  # stream closed mid-read at teardown
+        pass
+    finally:
+        try:
+            stream.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def launch_and_wait(cmds: List[List[str]], hosts: List[str],
+                    watch_dir: str = "", watch_interval: float = 10.0,
+                    watch_stale_s: float = 60.0) -> int:
+    """Spawn one process per host, label their output, surface failures.
+
+    Multi-host launches pipe each worker's stdout/stderr through a
+    ``[host:rank]`` line prefix; a single local process keeps its
+    terminal untouched (no pipe between the user and their script).
+    With ``watch_dir`` the launcher also renders the heartbeat status
+    table (monitor/heartbeat.py) every ``watch_interval`` seconds while
+    workers run.  Nonzero worker exits are reported WITH the offending
+    host named; the return code is the first nonzero worker rc."""
+    prefix_on = len(cmds) > 1
+    procs: List[subprocess.Popen] = []
+    pumps: List[threading.Thread] = []
+    for rank, (host, cmd) in enumerate(zip(hosts, cmds)):
+        if prefix_on:
+            # errors="replace": a worker emitting non-UTF-8 bytes (a
+            # binary progress bar, a core-dump banner) must garble one
+            # line, not kill the pump thread and SIGPIPE the worker
+            p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                 stderr=subprocess.PIPE, text=True,
+                                 errors="replace", bufsize=1)
+            for stream, sink in ((p.stdout, sys.stdout),
+                                 (p.stderr, sys.stderr)):
+                t = threading.Thread(
+                    target=_pump_lines, args=(stream, sink,
+                                              f"[{host}:{rank}] "),
+                    daemon=True, name=f"ds-launch-pump-{host}-{rank}")
+                t.start()
+                pumps.append(t)
+        else:
+            p = subprocess.Popen(cmd)
+        procs.append(p)
+
+    if watch_dir:
+        from ..monitor.heartbeat import (format_watch_table,
+                                         read_heartbeats,
+                                         resolve_heartbeat_dir)
+        next_render = time.monotonic()  # render immediately, then every
+        while any(p.poll() is None for p in procs):
+            if time.monotonic() >= next_render:
+                next_render = time.monotonic() + max(1.0, watch_interval)
+                try:
+                    # re-resolved every render: the job's
+                    # <output_path>/<job_name>/heartbeat dir may only
+                    # appear once workers reach their first window
+                    hb_dir = resolve_heartbeat_dir(watch_dir)
+                    table = format_watch_table(
+                        read_heartbeats(hb_dir),
+                        stale_after_s=watch_stale_s,
+                        expected_procs=len(cmds))
+                    print(f"--- dslaunch --watch {hb_dir} ---\n{table}",
+                          flush=True)
+                except Exception as e:  # noqa: BLE001 — a status render
+                    # must never take down the launcher (and its
+                    # rc-aggregation) while workers are alive
+                    logger.warning(f"dslaunch --watch render failed "
+                                   f"({e}) — will retry next interval")
+            time.sleep(0.5)
+
+    rc = 0
+    failed = []
+    for rank, (host, p) in enumerate(zip(hosts, procs)):
+        p.wait()
+        if p.returncode:
+            failed.append((host, rank, p.returncode))
+            rc = rc or p.returncode
+    for t in pumps:
+        t.join(timeout=5)
+    for host, rank, code in failed:
+        logger.error(f"dslaunch: worker on host {host!r} (rank {rank}) "
+                     f"exited with rc={code}")
+    if failed and len(failed) < len(procs):
+        ok = [h for h in hosts
+              if h not in {f[0] for f in failed}]
+        logger.error(f"dslaunch: {len(failed)}/{len(procs)} worker(s) "
+                     f"failed; clean exits on: {ok}")
+    return rc
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
+    labels: Dict[str, str] = {}
     if args.tpu:
         from .tpu_discovery import discover
         pod = discover(args.tpu, args.tpu_zone, args.tpu_project)
         resources = pod.resources()
+        labels = pod.labels()
         logger.info(
             f"dslaunch --tpu {args.tpu}: {len(pod.workers)} worker(s)"
             + (f" [{pod.accelerator_type}]" if pod.accelerator_type
@@ -195,12 +311,11 @@ def main(argv=None) -> int:
         for c in cmds:
             print(" ".join(map(shlex.quote, c)))
         return 0
-    procs = [subprocess.Popen(c) for c in cmds]
-    rc = 0
-    for p in procs:
-        p.wait()
-        rc = rc or p.returncode
-    return rc
+    return launch_and_wait(cmds,
+                           [labels.get(h, h) for h in active],
+                           watch_dir=args.watch,
+                           watch_interval=args.watch_interval,
+                           watch_stale_s=args.watch_stale_s)
 
 
 if __name__ == "__main__":

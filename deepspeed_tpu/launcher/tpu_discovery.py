@@ -47,6 +47,14 @@ class PodInfo:
         (multi-controller JAX), matching runner.py's model."""
         return OrderedDict((w, 1) for w in self.workers)
 
+    def labels(self) -> "OrderedDict[str, str]":
+        """host -> short display label ("w<N>", pod order) for the
+        launcher's ``[host:rank]`` output prefixes and the --watch
+        table: a 15-char IP per log line drowns the payload, the pod
+        worker number is what an operator actually greps for."""
+        return OrderedDict((w, f"w{i}")
+                           for i, w in enumerate(self.workers))
+
 
 def default_metadata_fetch(attribute: str, timeout: float = 5.0) -> str:
     """GET one instance attribute from the GCE metadata server (only

@@ -1,0 +1,153 @@
+"""Anomaly-triggered deep profiling — bounded jax.profiler captures.
+
+The reconciliation report can say a window was slow and WHY at lane
+granularity, but chasing an on-chip schedule bug needs the xplane trace
+— and by the time a human re-runs with the profiler armed, the anomaly
+is usually gone (T3's point: fine-grained overlap must be OBSERVED,
+arXiv:2401.16677).  This module closes that loop: when a reconciliation
+flag (``step_time_above_band``, ``swap_below_ceiling_band``) or a fleet
+health event for THIS host fires, the monitor arms a bounded
+``jax.profiler`` trace capture for the next K steps, so the first bad
+window ships with device-level evidence instead of a reproduction
+request.
+
+Guard rails, because an accidental always-on profiler is its own
+regression:
+
+  * off by default (``monitor.capture.enabled``);
+  * bounded: exactly ``steps`` optimizer steps per capture, then an
+    automatic ``stop_trace`` (disarm is unconditional — a capture can
+    never outlive its window);
+  * rate-limited: at most ``max_captures`` per run with a
+    ``cooldown_steps`` gap between them, so a persistently-breached band
+    yields a few traces, not a full-run profile;
+  * the profiler module is injectable — tests drive arm/disarm with a
+    mock, and a host without a working profiler degrades to a warning.
+
+``start_trace``/``stop_trace`` do host work (stop also flushes the
+xplane file).  That cost lands only on anomaly windows on the flagged
+host, which is exactly when a perturbed step is an acceptable price for
+evidence — and why capture is never armed in the hot loop itself, only
+at flush boundaries.
+"""
+
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from ..utils.logging import logger
+from .reconcile import FLAG_STEP_TIME_ABOVE_BAND, FLAG_SWAP_BELOW_CEILING
+
+# reconciliation flags that arm a capture (names single-sourced from
+# reconcile.py)
+TRIGGER_FLAGS = (FLAG_STEP_TIME_ABOVE_BAND, FLAG_SWAP_BELOW_CEILING)
+
+
+class ProfileCapture:
+    """Arm/observe/disarm state machine around jax.profiler."""
+
+    def __init__(self, output_path: str, steps: int = 8,
+                 max_captures: int = 2, cooldown_steps: int = 100,
+                 profiler: Any = None):
+        self.output_path = output_path
+        self.steps = max(1, int(steps))
+        self.max_captures = max(1, int(max_captures))
+        self.cooldown_steps = max(0, int(cooldown_steps))
+        self._profiler = profiler
+        self.armed = False
+        self._steps_captured = 0
+        self._last_stop_step: Optional[int] = None
+        self.captures: List[Dict[str, Any]] = []
+        self._failed = False
+
+    # ------------------------------------------------------------------ #
+    def _prof(self):
+        if self._profiler is None:
+            import jax.profiler as _p
+            self._profiler = _p
+        return self._profiler
+
+    @property
+    def exhausted(self) -> bool:
+        return len(self.captures) >= self.max_captures or self._failed
+
+    def _in_cooldown(self, step: int) -> bool:
+        return (self._last_stop_step is not None
+                and step - self._last_stop_step < self.cooldown_steps)
+
+    # ------------------------------------------------------------------ #
+    def arm(self, reason: str, step: int) -> bool:
+        """Request a capture starting at the next step.  Returns True iff
+        the profiler was actually armed (rate limits may refuse)."""
+        if self.armed or self.exhausted or self._in_cooldown(step):
+            return False
+        trace_dir = os.path.join(
+            self.output_path,
+            f"capture{len(self.captures)}_step{step}_"
+            + _slug(reason))
+        try:
+            os.makedirs(trace_dir, exist_ok=True)
+            self._prof().start_trace(trace_dir)
+        except Exception as e:  # noqa: BLE001 — capture must not crash
+            self._failed = True
+            logger.warning(
+                f"monitor: profiler capture failed to arm ({e}) — "
+                "deep-profiling disabled for the rest of the run")
+            return False
+        self.armed = True
+        self._steps_captured = 0
+        self.captures.append({"reason": reason, "armed_at_step": step,
+                              "dir": trace_dir, "t_armed": time.time(),
+                              "steps": None})
+        logger.warning(
+            f"monitor: profiler capture ARMED at step {step} "
+            f"({reason}) — tracing the next {self.steps} step(s) "
+            f"to {trace_dir}")
+        return True
+
+    def observe_step_end(self, step: int) -> None:
+        """Per-step tick while armed; disarms after K captured steps.
+        A no-op (one predicate check) when not armed."""
+        if not self.armed:
+            return
+        self._steps_captured += 1
+        if self._steps_captured >= self.steps:
+            self.disarm(step)
+
+    def disarm(self, step: int) -> None:
+        if not self.armed:
+            return
+        self.armed = False
+        self._last_stop_step = step
+        try:
+            self._prof().stop_trace()
+        except Exception as e:  # noqa: BLE001
+            logger.warning(f"monitor: profiler stop_trace failed ({e})")
+        cap = self.captures[-1]
+        cap["steps"] = self._steps_captured
+        cap["stopped_at_step"] = step
+        logger.warning(
+            f"monitor: profiler capture complete at step {step} "
+            f"({cap['steps']} step(s)) -> {cap['dir']}")
+
+    def maybe_arm_for_flags(self, flags: List[str], step: int) -> bool:
+        """Reconciliation hook: arm when any trigger flag is present."""
+        hit = [f for f in (flags or []) if f in TRIGGER_FLAGS]
+        if not hit:
+            return False
+        return self.arm("+".join(hit), step)
+
+    def close(self, step: int = -1) -> None:
+        """End-of-run safety: an armed capture is stopped so the xplane
+        file is flushed rather than lost."""
+        self.disarm(step)
+
+    def counters(self) -> Dict[str, int]:
+        return {"captures": len(self.captures),
+                "capture_armed": int(self.armed)}
+
+
+def _slug(reason: str, max_len: int = 48) -> str:
+    safe = "".join(c if c.isalnum() or c in "-_+" else "-"
+                   for c in str(reason))
+    return safe[:max_len] or "anomaly"

@@ -1,0 +1,261 @@
+"""Cross-host aggregation — the fleet half of the telemetry subsystem.
+
+PR 9's monitor sees exactly one host.  On a pod, every multihost failure
+mode the ROADMAP cares about — a straggler host dragging the lockstep
+collectives, a diverging replica, a slow swap tier on one host — is
+invisible from rank 0's own scalars.  This module closes that gap
+without touching the hot loop:
+
+  * every process compresses its flush window into a FIXED-SHAPE float64
+    vector (``encode_window_vector`` — the field list is static, missing
+    values ride as NaN, so the exchange can never retrace or reshape);
+  * at flush-window boundaries — and ONLY there, never per step, never
+    on the final/partial flush where hosts may have drifted apart — one
+    host-side allgather ships every host's vector to every host
+    (``FleetAggregator.exchange``).  All processes receive the full
+    [P, V] matrix so each host can run the SAME deterministic health
+    detection locally (monitor/health.py) and a flagged host can arm its
+    own profiler capture (monitor/capture.py) without a second
+    round-trip or a broadcast;
+  * rank 0 turns the matrix into per-host and fleet-aggregate records
+    (min/median/max/p99 step time, per-host swap GB/s and host-gap) and
+    emits them through the existing writer thread.
+
+The exchange is a host-initiated collective over already-materialized
+numpy data (jax.experimental.multihost_utils.process_allgather): it
+lives entirely OUTSIDE the traced step programs, so the host-sync audit
+and the lockstep signature are unchanged with fleet monitoring on
+(tests/unit/test_fleet_monitor.py pins this).  Host names cannot ride a
+float allgather, so they are exchanged ONCE at init as a fixed-width
+byte matrix.
+"""
+
+import math
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from . import record as R
+
+# ---- the fixed window-vector layout ---------------------------------- #
+# One slot per scalar; the tuple order IS the wire layout.  Extending it
+# is a one-line change here plus consumers — never reorder released
+# slots (a mixed-version pod would silently transpose metrics).
+VEC_FIELDS = (
+    "last_step",            # last global step in the window
+    "steps",                # records in the window
+    "step_time_mean_s",     # mean delivered (arrival-to-arrival) step time
+    "step_time_max_s",
+    "loss_mean",            # mean of the window's fetched losses
+    "host_gap_mean_s",      # mean host gap (end_step -> next forward)
+    "swap_read_gbps",       # achieved swap-tier read bandwidth
+    "swap_exposed_mean_s",  # mean per-step exposed (caller-blocked) swap
+    "grad_norm_mean",       # mean global grad norm (sentinel-fed; NaN
+                            # when no host-side norm is computed)
+)
+VEC_LEN = len(VEC_FIELDS)
+_IDX = {name: i for i, name in enumerate(VEC_FIELDS)}
+
+_HOSTNAME_BYTES = 64
+
+
+def encode_window_vector(summary: Dict[str, Any]) -> np.ndarray:
+    """Window summary dict -> fixed-shape float64 vector (NaN = absent)."""
+    vec = np.full(VEC_LEN, np.nan, dtype=np.float64)
+    for name, i in _IDX.items():
+        v = summary.get(name)
+        if v is None:
+            continue
+        try:
+            vec[i] = float(v)
+        except (TypeError, ValueError):
+            pass
+    return vec
+
+
+def decode_window_vector(vec: np.ndarray) -> Dict[str, Optional[float]]:
+    """Inverse of encode: NaN slots come back as None."""
+    out: Dict[str, Optional[float]] = {}
+    for name, i in _IDX.items():
+        v = float(vec[i])
+        out[name] = None if math.isnan(v) else v
+    return out
+
+
+def _encode_host(host: str) -> np.ndarray:
+    raw = host.encode("utf-8", "replace")[:_HOSTNAME_BYTES]
+    buf = np.zeros(_HOSTNAME_BYTES, dtype=np.uint8)
+    buf[:len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+    return buf
+
+
+def _decode_host(row: np.ndarray) -> str:
+    raw = bytes(row.astype(np.uint8))
+    return raw.rstrip(b"\x00").decode("utf-8", "replace")
+
+
+def _default_gather(vec: np.ndarray) -> np.ndarray:
+    """allgather a fixed-shape host array across processes -> [P, ...].
+
+    The jax multihost allgather is a collective: every process must call
+    it at the same point, which the lockstep flush-window cadence
+    guarantees (all hosts step together, windows close by step count)."""
+    from jax.experimental import multihost_utils
+    out = np.asarray(multihost_utils.process_allgather(vec, tiled=False))
+    # defensive: tiled gathers (or a 1-process run through the jax path)
+    # come back flat — restore the [P, ...] layout
+    if out.ndim == vec.ndim:
+        out = out.reshape((-1,) + vec.shape)
+    return out
+
+
+class FleetAggregator:
+    """Window-boundary fleet exchange + record assembly.
+
+    ``gather_fn`` is injectable so CPU tests drive the aggregation with
+    synthetic multi-host matrices (the fake-fleet harness) without a
+    real distributed world.  With ``process_count == 1`` the exchange is
+    a local stack — single-host runs emit the degenerate 1-host fleet
+    records, so the record shape downstream tooling sees is identical."""
+
+    def __init__(self, process_index: int = 0, process_count: int = 1,
+                 host: Optional[str] = None,
+                 gather_fn: Optional[Callable[[np.ndarray],
+                                              np.ndarray]] = None):
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        ident = R.identity(process_index=process_index,
+                           world_size=process_count, host=host)
+        self.host = ident[R.F_HOST]
+        self._gather = gather_fn
+        self.exchanges = 0
+        self._hosts: Optional[List[str]] = None
+
+    # ------------------------------------------------------------------ #
+    def _do_gather(self, arr: np.ndarray) -> np.ndarray:
+        if self._gather is not None:
+            return np.asarray(self._gather(arr))
+        if self.process_count <= 1:
+            return arr[None]
+        return _default_gather(arr)
+
+    def host_names(self) -> List[str]:
+        """All hosts' names, pod order.  Exchanged ONCE (init-time side
+        channel — strings cannot ride the float window gather); cached."""
+        if self._hosts is None:
+            mat = self._do_gather(_encode_host(self.host))
+            self._hosts = [_decode_host(row) for row in mat]
+            if len(self._hosts) != self.process_count:
+                # a test gather_fn rigged for a different world: trust it
+                self.process_count = len(self._hosts)
+        return self._hosts
+
+    def exchange(self, summary: Dict[str, Any]) -> np.ndarray:
+        """One flush window's collective: encode, allgather, return the
+        [P, VEC_LEN] matrix (every process gets the full fleet view)."""
+        self.host_names()  # resolve labels before the first window
+        mat = self._do_gather(encode_window_vector(summary))
+        self.exchanges += 1
+        if mat.shape != (self.process_count, VEC_LEN):
+            raise ValueError(
+                f"fleet gather returned shape {mat.shape}, expected "
+                f"{(self.process_count, VEC_LEN)} — mixed monitor schema "
+                "versions across the pod?")
+        return mat
+
+    # ------------------------------------------------------------------ #
+    # record assembly (rank 0 emits these through the writer thread)
+    # ------------------------------------------------------------------ #
+    def per_host_records(self, matrix: np.ndarray) -> List[Dict[str, Any]]:
+        hosts = self.host_names()
+        out = []
+        for p, row in enumerate(np.asarray(matrix)):
+            d = decode_window_vector(row)
+            rec = {
+                R.F_KIND: R.KIND_FLEET_HOST,
+                R.F_HOST: hosts[p] if p < len(hosts) else f"p{p}",
+                R.F_PROCESS_INDEX: p,
+                R.F_WORLD_SIZE: len(hosts),
+                R.FL_WINDOW_END: (int(d["last_step"])
+                                  if d["last_step"] is not None else None),
+                R.FL_STEP_TIME_MEAN_S: _r(d["step_time_mean_s"]),
+                R.FL_STEP_TIME_MAX_S: _r(d["step_time_max_s"]),
+                R.FL_LOSS_MEAN: _r(d["loss_mean"]),
+                R.FL_HOST_GAP_MEAN_S: _r(d["host_gap_mean_s"]),
+                R.FL_SWAP_READ_GBPS: _r(d["swap_read_gbps"]),
+                R.FL_SWAP_EXPOSED_S: _r(d["swap_exposed_mean_s"]),
+            }
+            out.append(rec)
+        return out
+
+    def fleet_record(self, matrix: np.ndarray) -> Dict[str, Any]:
+        """The fleet-aggregate view of one window's matrix."""
+        matrix = np.asarray(matrix)
+        summary = summarize_fleet(matrix)
+        hosts = self.host_names()
+        rec: Dict[str, Any] = {R.F_KIND: R.KIND_FLEET,
+                               R.F_WORLD_SIZE: len(hosts)}
+        rec.update(summary)
+        # per-host scalar lists keyed in pod order — the at-a-glance
+        # columns an operator scans for the odd host out
+        gap = matrix[:, _IDX["host_gap_mean_s"]]
+        swp = matrix[:, _IDX["swap_read_gbps"]]
+        rec[R.FL_PER_HOST] = {
+            "host": list(hosts),
+            "step_time_s": _rlist(matrix[:, _IDX["step_time_mean_s"]]),
+            "host_gap_s": _rlist(gap),
+            "swap_read_gbps": _rlist(swp),
+        }
+        return rec
+
+
+def summarize_fleet(matrix: np.ndarray) -> Dict[str, Any]:
+    """Fleet-aggregate scalars from a [P, VEC_LEN] window matrix — also
+    the embeddable form bench rows carry (bench.py multichip rows land
+    with per-host attribution built in)."""
+    matrix = np.asarray(matrix, dtype=np.float64)
+    times = matrix[:, _IDX["step_time_mean_s"]]
+    losses = matrix[:, _IDX["loss_mean"]]
+    steps = matrix[:, _IDX["last_step"]]
+    valid_t = times[np.isfinite(times)]
+    valid_l = losses[np.isfinite(losses)]
+    valid_s = steps[np.isfinite(steps)]
+    out: Dict[str, Any] = {
+        R.FL_HOSTS: int(matrix.shape[0]),
+        R.FL_WINDOW_END: (int(valid_s.max()) if valid_s.size else None),
+        R.FL_STEP_TIME_MIN_S: _r(valid_t.min()) if valid_t.size else None,
+        R.FL_STEP_TIME_MEDIAN_S: (_r(float(np.median(valid_t)))
+                                  if valid_t.size else None),
+        R.FL_STEP_TIME_MAX_S: _r(valid_t.max()) if valid_t.size else None,
+        R.FL_STEP_TIME_P99_S: (_r(float(np.percentile(valid_t, 99)))
+                               if valid_t.size else None),
+        R.FL_LOSS_MEAN: (_r(float(valid_l.mean()))
+                         if valid_l.size else None),
+        R.FL_LOSS_SPREAD: (_r(float(valid_l.max() - valid_l.min()))
+                           if valid_l.size else None),
+    }
+    return out
+
+
+def _r(v, nd: int = 6):
+    if v is None:
+        return None
+    v = float(v)
+    return None if math.isnan(v) else round(v, nd)
+
+
+def _rlist(arr) -> List[Optional[float]]:
+    return [_r(v) for v in np.asarray(arr, dtype=np.float64)]
+
+
+def format_fleet_line(rec: Dict[str, Any]) -> str:
+    """One-line log form of a fleet-aggregate record."""
+    med = rec.get(R.FL_STEP_TIME_MEDIAN_S)
+    mx = rec.get(R.FL_STEP_TIME_MAX_S)
+    bits = [f"hosts={rec.get(R.FL_HOSTS)}"]
+    if med is not None and mx is not None:
+        bits.append(f"step med {med * 1e3:.1f}ms max {mx * 1e3:.1f}ms")
+    spread = rec.get(R.FL_LOSS_SPREAD)
+    if spread is not None:
+        bits.append(f"loss spread {spread:.3g}")
+    return "[monitor-fleet] " + " ".join(bits)

@@ -43,6 +43,9 @@ ATTR_IO = "io-bound"
 ATTR_COMM_HIDDEN = "comm-hidden"
 ATTR_COMM_EXPOSED = "comm-exposed"
 ATTR_SWAP = "io-bound (swap exposed)"
+# fleet-health lane (health.py straggler attribution): the excess step
+# time sits BETWEEN dispatches — dataloader / host work, not the device
+ATTR_HOST_GAP = "host-gap"
 
 _LANE_ATTR = {"compute": ATTR_COMPUTE, "memory": ATTR_IO,
               "hidden_comm": ATTR_COMM_HIDDEN}

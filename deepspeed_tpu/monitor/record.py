@@ -16,10 +16,25 @@ design constraint the whole subsystem is built around.
 
 from typing import Any, Dict, Optional
 
+# Schema version of the record stream.  v1 (PR 9) had no host identity;
+# v2 (PR 10) adds host / process_index / world_size to every
+# single-host-attributable record plus the fleet/health record kinds
+# (the `fleet` aggregate carries world_size and per-host columns — it
+# describes the whole fleet, so a single host identity would mislead).
+# The version rides every meta record and the trace file's otherData so
+# a consumer can tell which era a stream came from.
+SCHEMA_VERSION = 2
+
 # ---- record kinds ---------------------------------------------------- #
 KIND_STEP = "step"
 KIND_RECONCILE = "reconcile"
 KIND_META = "meta"
+# fleet-aggregation kinds (monitor/fleet.py): one record per host per
+# flush window, one fleet-aggregate record per window, and structured
+# health events (monitor/health.py)
+KIND_FLEET_HOST = "fleet_host"
+KIND_FLEET = "fleet"
+KIND_HEALTH = "health"
 
 # ---- per-step field names (the schema) ------------------------------- #
 F_KIND = "kind"
@@ -40,16 +55,54 @@ F_DISPATCHES_PER_STEP = "dispatches_per_step"
 F_SWAP_READ_GBPS = "swap_read_gbps"
 F_SWAP_OVERLAP_FRACTION = "swap_overlap_fraction"
 F_SWAP_READ_VS_CEILING = "swap_read_vs_ceiling"
+# host identity (schema v2): populated on every record, single-host runs
+# included — a merged multi-host JSONL stream stays attributable per line
+F_HOST = "host"
+F_PROCESS_INDEX = "process_index"
+F_WORLD_SIZE = "world_size"
+# per-step host-gap: wall time between the previous step's end_step and
+# this step's first forward (dataloader / host work the device waits on)
+F_HOST_GAP_S = "host_gap_s"
 
 # CSV column order; JSONL records carry the same names (plus any
-# engine-specific extras, which CSV drops — CSV is the fixed-width view)
+# engine-specific extras, which CSV drops — CSV is the fixed-width view).
+# Schema v2 appends the identity + host-gap columns after the v1 set, so
+# v1 tooling reading by position keeps working on the shared prefix.
 STEP_RECORD_FIELDS = (
     F_STEP, F_LOSS, F_LR, F_LOSS_SCALE, F_WALL_TIME_S, F_TOKENS_PER_SEC,
     F_MEM_PEAK_BYTES, F_MEM_IN_USE_BYTES, F_MEM_SOURCE,
     F_SKIPPED_STEPS, F_SENTINEL_ANOMALIES, F_SENTINEL_SKIPS, F_RETRACES,
     F_DISPATCHES_PER_STEP,
     F_SWAP_READ_GBPS, F_SWAP_OVERLAP_FRACTION, F_SWAP_READ_VS_CEILING,
+    F_HOST_GAP_S, F_HOST, F_PROCESS_INDEX, F_WORLD_SIZE,
 )
+
+# ---- fleet field names (fleet.py / health.py payloads) --------------- #
+FL_WINDOW_START = "window_start_step"
+FL_WINDOW_END = "window_end_step"
+FL_HOSTS = "hosts"
+FL_STEP_TIME_MEAN_S = "step_time_mean_s"
+FL_STEP_TIME_MAX_S = "step_time_max_s"
+FL_STEP_TIME_MIN_S = "step_time_min_s"
+FL_STEP_TIME_MEDIAN_S = "step_time_median_s"
+FL_STEP_TIME_P99_S = "step_time_p99_s"
+FL_LOSS_MEAN = "loss_mean"
+FL_LOSS_SPREAD = "loss_spread"
+FL_HOST_GAP_MEAN_S = "host_gap_mean_s"
+FL_SWAP_READ_GBPS = "swap_read_gbps"
+FL_SWAP_EXPOSED_S = "swap_exposed_mean_s"
+FL_PER_HOST = "per_host"
+# health-event field names (health.py)
+H_EVENT = "event"
+H_STEP = "step"
+H_LANE = "lane"
+H_RATIO = "ratio"
+H_ZSCORE = "zscore"
+H_DETAIL = "detail"
+H_METRIC = "metric"
+H_SPREAD = "spread"
+EVENT_STRAGGLER = "straggler"
+EVENT_DIVERGENCE = "divergence"
 
 # ---- reconciliation field names (reconcile.py payload) --------------- #
 R_WINDOW_START = "window_start_step"
@@ -102,16 +155,45 @@ def device_memory() -> Dict[str, Any]:
                 F_MEM_SOURCE: "unavailable"}
 
 
+def identity(process_index: Optional[int] = None,
+             world_size: Optional[int] = None,
+             host: Optional[str] = None) -> Dict[str, Any]:
+    """The host-identity triple every v2 record carries.  Defaults are
+    resolved from the running process (jax process index/count + the
+    hostname) so single-host runs populate them too."""
+    if process_index is None or world_size is None:
+        try:
+            import jax
+            if process_index is None:
+                process_index = jax.process_index()
+            if world_size is None:
+                world_size = jax.process_count()
+        except Exception:  # noqa: BLE001 — identity must never crash
+            process_index = process_index or 0
+            world_size = world_size or 1
+    if host is None:
+        import socket
+        try:
+            host = socket.gethostname()
+        except Exception:  # noqa: BLE001
+            host = f"host{process_index}"
+    return {F_HOST: host, F_PROCESS_INDEX: int(process_index),
+            F_WORLD_SIZE: int(world_size)}
+
+
 def make_step_record(step: int, loss: Optional[float], wall_s: float,
                      tokens: Optional[int], counters: Dict[str, Any],
                      boundary: Dict[str, Any],
                      memory: Dict[str, Any],
                      swap: Optional[Dict[str, Any]] = None,
-                     extra: Optional[Dict[str, Any]] = None
+                     extra: Optional[Dict[str, Any]] = None,
+                     host_gap_s: Optional[float] = None
                      ) -> Dict[str, Any]:
     """Assemble one step record from already-fetched host values."""
     rec: Dict[str, Any] = {F_KIND: KIND_STEP, F_STEP: int(step)}
     rec[F_LOSS] = loss
+    rec[F_HOST_GAP_S] = (round(float(host_gap_s), 6)
+                         if host_gap_s is not None else None)
     rec[F_WALL_TIME_S] = round(float(wall_s), 6) if wall_s else wall_s
     rec[F_TOKENS_PER_SEC] = (round(tokens / wall_s, 1)
                              if tokens and wall_s and wall_s > 0 else None)

@@ -25,6 +25,8 @@ import os
 import time
 from typing import Any, Dict, List, Optional
 
+from .record import SCHEMA_VERSION
+
 # lane -> tid (thread_name metadata emitted on first use)
 TID_STEP = 1
 TID_SWAP_IN = 2
@@ -170,6 +172,7 @@ class TraceEventBuffer:
             "displayTimeUnit": "ms",
             "otherData": {
                 "source": "deepspeed_tpu.monitor",
+                "schema_version": SCHEMA_VERSION,
                 "clock": "host perf_counter (dispatch windows for "
                          "compiled phases; wall windows for swap I/O)",
                 "steps_traced": len(self._steps_seen),
@@ -195,6 +198,18 @@ def validate_trace_events(payload: Dict[str, Any]) -> List[str]:
     events = payload.get("traceEvents")
     if not isinstance(events, list):
         return ["traceEvents is not a list"]
+    # schema-version check (v2+): absent = a v1-era trace, accepted; a
+    # version from the FUTURE means this validator predates the writer
+    other = payload.get("otherData")
+    if isinstance(other, dict) and "schema_version" in other:
+        ver = other["schema_version"]
+        if not isinstance(ver, int):
+            problems.append(f"otherData.schema_version is not an int "
+                            f"({ver!r})")
+        elif ver > SCHEMA_VERSION:
+            problems.append(
+                f"trace schema_version {ver} is newer than this "
+                f"validator ({SCHEMA_VERSION}) — upgrade the reader")
     for i, ev in enumerate(events):
         for key in ("name", "ph", "pid", "tid"):
             if key not in ev:

@@ -420,6 +420,7 @@ class DeepSpeedEngine:
         self._cached_grads = None
         self._last_loss = None
         self._last_overflow = None
+        self._last_grad_norm_host = None  # sentinel-fetched, monitor-fed
         self._summary_writer = self._configure_tensorboard()
         # Summary scalars (and the loss/LR device reads they force) are
         # coalesced to this boundary — per-step writes would sync the
@@ -455,7 +456,16 @@ class DeepSpeedEngine:
         # measured-vs-predicted reconciliation against the static model.
         self.monitor = None
         self._monitor_seq = None
-        if self.config.monitor_config.enabled and jax.process_index() == 0:
+        # single-host posture: rank 0 only.  Fleet/heartbeat posture:
+        # EVERY process builds a monitor — non-zero ranks run no file
+        # writers, but they contribute window vectors to the
+        # boundary-only fleet allgather, beat their own heartbeat (the
+        # per-process liveness protocol needs every rank, fleet or not),
+        # and can arm their own profiler capture (monitor/fleet.py).
+        if self.config.monitor_config.enabled and (
+                jax.process_index() == 0 or
+                self.config.monitor_config.fleet or
+                self.config.monitor_config.heartbeat):
             self.monitor = self._configure_monitor()
 
         log_dist(
@@ -1173,7 +1183,9 @@ class DeepSpeedEngine:
             # the monitor batch-fetches the window at its flush boundary
             self.monitor.end_step(self.global_steps, loss=self._last_loss,
                                   tokens=self._monitor_tokens_per_step(),
-                                  counters=self._monitor_counters())
+                                  counters=self._monitor_counters(),
+                                  grad_norm=getattr(
+                                      self, "_last_grad_norm_host", None))
         self._boundary_logging()
         if self.wall_clock_breakdown():
             self.timers(STEP_MICRO_TIMER).stop()
@@ -1249,6 +1261,13 @@ class DeepSpeedEngine:
             predictions=predictions,
             summary_writer=self._summary_writer,
             boundary_fn=self._monitor_boundary_reads,
+            process_index=jax.process_index(),
+            world_size=jax.process_count(),
+            # fleet health events (straggler/divergence) land in the
+            # resilience sentinel's structured event log alongside its
+            # own loss/grad-norm anomalies (docs/resilience.md)
+            health_sink=(self.sentinel.record_health_event
+                         if self.sentinel is not None else None),
             meta={"engine": type(self).__name__,
                   "zero_stage": self.config.zero_optimization_stage,
                   "dtype": str(self.compute_dtype.__name__),
@@ -1326,6 +1345,7 @@ class DeepSpeedEngine:
         loss = (float(self._last_loss) if self._last_loss is not None
                 else float("nan"))
         norm = None
+        self._last_grad_norm_host = None
         if self._grad_norm_fn is not None:
             # the stored grads are loss-scaled and un-averaged; normalize
             # host-side (one scalar)
@@ -1340,6 +1360,10 @@ class DeepSpeedEngine:
                 # counting it against the anomaly budget would abort
                 # healthy fp16 runs
                 norm = None
+            # stash for the monitor (fleet grad-norm divergence lane):
+            # a host scalar the sentinel already paid for, never a read
+            # made for the monitor's sake
+            self._last_grad_norm_host = norm
         step = self.global_steps + 1
         if not s.observe(step, loss, norm):
             return "ok"
@@ -1683,6 +1707,11 @@ class DeepSpeedEngine:
                                     float(self._last_loss))
         self.tput_timer.stop(global_step=True)
         if self.monitor is not None:
+            # no grad_norm here: the fused path's sentinel EWMA is
+            # device-resident (no host-side norm scalar exists without
+            # a per-step sync the fused design forbids), so the fleet
+            # grad-norm divergence lane is loss-only under fused_step —
+            # documented in docs/telemetry.md
             self.monitor.end_step(self.global_steps, loss=loss,
                                   tokens=self._monitor_tokens_per_step(),
                                   counters=self._monitor_counters())

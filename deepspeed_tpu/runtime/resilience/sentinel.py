@@ -91,6 +91,11 @@ class TrainingSentinel:
         self.rewinds = 0
         self.consecutive_anomalies = 0
         self.last_reasons: List[str] = []
+        # structured fleet-health events (monitor/health.py straggler/
+        # divergence detections) — bounded ring so a chronically-sick
+        # pod cannot grow host memory without limit
+        self.health_events: List[Dict] = []
+        self.health_events_seen = 0
 
     # ---------------------------------------------------------------- #
     def observe(self, step: int, loss: float,
@@ -158,6 +163,31 @@ class TrainingSentinel:
     def record_rewind(self) -> None:
         self.rewinds += 1
 
+    _HEALTH_EVENTS_KEPT = 32
+
+    def record_health_event(self, event: Dict) -> None:
+        """Fleet-health sink (monitor/health.py): a straggler or
+        divergence detection lands here as a structured event so the
+        sentinel's diagnostic — the post-mortem an operator reads after
+        an abort — carries the FLEET's view next to the loss/grad-norm
+        history.  Events inform the diagnostic; they do not advance the
+        consecutive-anomaly abort budget (a slow host is an
+        infrastructure fault, not a training-dynamics one — the policy
+        machinery here must not skip steps because a neighbor's NVMe is
+        cold)."""
+        self.health_events_seen += 1
+        self.health_events.append(dict(event))
+        if len(self.health_events) > self._HEALTH_EVENTS_KEPT:
+            del self.health_events[:-self._HEALTH_EVENTS_KEPT]
+        # debug, not warning: the monitor already emits the formatted
+        # health line under its own emitter-or-mine gate — a second
+        # warning here would double-log every event on the ranks that
+        # feed the sink
+        logger.debug(
+            f"sentinel: fleet health event #{self.health_events_seen} "
+            f"({event.get('event')} on {event.get('host')} at step "
+            f"{event.get('step')})")
+
     # ---------------------------------------------------------------- #
     def diagnostic(self, step: int, loss: Optional[float] = None,
                    grad_norm: Optional[float] = None) -> Dict:
@@ -176,6 +206,8 @@ class TrainingSentinel:
             "rewinds": self.rewinds,
             "loss_ewma": self.loss_stat.state_dict(),
             "grad_norm_ewma": self.grad_stat.state_dict(),
+            "health_events_seen": self.health_events_seen,
+            "recent_health_events": list(self.health_events[-5:]),
         }
 
     def abort(self, step: int, loss: Optional[float] = None,
@@ -189,7 +221,8 @@ class TrainingSentinel:
     def counters(self) -> Dict[str, int]:
         return {"anomalies_seen": self.anomalies_seen,
                 "steps_skipped": self.steps_skipped,
-                "rewinds": self.rewinds}
+                "rewinds": self.rewinds,
+                "health_events": self.health_events_seen}
 
     def state_dict(self) -> Dict:
         return {
@@ -199,6 +232,7 @@ class TrainingSentinel:
             "steps_skipped": self.steps_skipped,
             "rewinds": self.rewinds,
             "consecutive_anomalies": self.consecutive_anomalies,
+            "health_events_seen": self.health_events_seen,
         }
 
     def load_state_dict(self, sd: Dict) -> None:
@@ -208,3 +242,4 @@ class TrainingSentinel:
         self.steps_skipped = int(sd.get("steps_skipped", 0))
         self.rewinds = int(sd.get("rewinds", 0))
         self.consecutive_anomalies = int(sd.get("consecutive_anomalies", 0))
+        self.health_events_seen = int(sd.get("health_events_seen", 0))

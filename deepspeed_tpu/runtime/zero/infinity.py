@@ -291,7 +291,10 @@ class ZeroInfinityEngine:
         # sweep ceiling, which _finalize_swap_stats measures per step.
         self.monitor = None
         self._monitor_seq = None
-        if self.config.monitor_config.enabled and jax.process_index() == 0:
+        if self.config.monitor_config.enabled and (
+                jax.process_index() == 0 or
+                self.config.monitor_config.fleet or
+                self.config.monitor_config.heartbeat):
             from ...monitor import TrainingMonitor
             self.monitor = TrainingMonitor(
                 self.config.monitor_config,
@@ -299,6 +302,8 @@ class ZeroInfinityEngine:
                 predictions=None,
                 boundary_fn=self._monitor_boundary_reads,
                 swap_stats_fn=lambda: self.last_swap_stats,
+                process_index=jax.process_index(),
+                world_size=jax.process_count(),
                 meta={"engine": type(self).__name__,
                       "params_on": ("nvme" if self._use_nvme_params
                                     else "host"),

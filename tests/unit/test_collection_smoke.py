@@ -44,7 +44,13 @@ def test_resilience_package_imports_cleanly():
             "deepspeed_tpu.monitor.writers",
             "deepspeed_tpu.monitor.trace",
             "deepspeed_tpu.monitor.reconcile",
-            "deepspeed_tpu.monitor.monitor")
+            "deepspeed_tpu.monitor.monitor",
+            # fleet observability layer (monitor.fleet is lazily
+            # reachable through the launcher's --watch too)
+            "deepspeed_tpu.monitor.fleet",
+            "deepspeed_tpu.monitor.health",
+            "deepspeed_tpu.monitor.heartbeat",
+            "deepspeed_tpu.monitor.capture")
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     out = subprocess.run(

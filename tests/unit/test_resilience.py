@@ -403,7 +403,8 @@ def test_sentinel_nan_bf16_skips_then_aborts(tmp_path):
     assert_params_equal(np_params(e), snap)
     assert e.skipped_steps == 2
     assert e.sentinel.counters() == {"anomalies_seen": 2,
-                                     "steps_skipped": 2, "rewinds": 0}
+                                     "steps_skipped": 2, "rewinds": 0,
+                                     "health_events": 0}
 
     # third consecutive anomaly exhausts the budget -> structured abort
     e.backward(e.forward(*bad))
@@ -489,7 +490,8 @@ def test_sentinel_counters_roundtrip_through_checkpoint(tmp_path):
     e2.load_checkpoint(str(tmp_path), tag="c")
     assert e2.skipped_steps == 1
     assert e2.sentinel.counters() == {"anomalies_seen": 1,
-                                      "steps_skipped": 1, "rewinds": 0}
+                                      "steps_skipped": 1, "rewinds": 0,
+                                      "health_events": 0}
 
 
 # --------------------------------------------------------------------- #
