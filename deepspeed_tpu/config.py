@@ -944,6 +944,7 @@ class PreemptionConfig:
     emergency_tag_prefix: str = C.PREEMPTION_EMERGENCY_TAG_PREFIX_DEFAULT
     save_dir: Optional[str] = C.PREEMPTION_SAVE_DIR_DEFAULT
     reraise: bool = C.PREEMPTION_RERAISE_DEFAULT
+    grace_s: float = C.PREEMPTION_GRACE_S_DEFAULT
 
     @staticmethod
     def from_dict(d: Optional[Dict[str, Any]]) -> "PreemptionConfig":
@@ -961,6 +962,11 @@ class PreemptionConfig:
                 raise DeepSpeedConfigError(
                     f"resilience.preemption.signals entry {name!r} is not "
                     "a signal name (expected e.g. \"SIGTERM\", \"SIGINT\")")
+        grace = float(get_scalar_param(d, C.PREEMPTION_GRACE_S,
+                                       C.PREEMPTION_GRACE_S_DEFAULT))
+        if grace < 0:
+            raise DeepSpeedConfigError(
+                f"resilience.preemption.grace_s must be >= 0, got {grace}")
         return PreemptionConfig(
             enabled=get_scalar_param(d, C.PREEMPTION_ENABLED,
                                      C.PREEMPTION_ENABLED_DEFAULT),
@@ -972,6 +978,7 @@ class PreemptionConfig:
                                       C.PREEMPTION_SAVE_DIR_DEFAULT),
             reraise=get_scalar_param(d, C.PREEMPTION_RERAISE,
                                      C.PREEMPTION_RERAISE_DEFAULT),
+            grace_s=grace,
         )
 
 
@@ -1037,6 +1044,8 @@ class ResilienceConfig:
     keep_every: int = C.RESILIENCE_KEEP_EVERY_DEFAULT
     io_retries: int = C.RESILIENCE_IO_RETRIES_DEFAULT
     io_backoff_seconds: float = C.RESILIENCE_IO_BACKOFF_SECONDS_DEFAULT
+    verify_lockstep_on_resume: bool = (
+        C.RESILIENCE_VERIFY_LOCKSTEP_ON_RESUME_DEFAULT)
     preemption: PreemptionConfig = field(default_factory=PreemptionConfig)
     sentinel: SentinelConfig = field(default_factory=SentinelConfig)
 
@@ -1051,6 +1060,10 @@ class ResilienceConfig:
     @property
     def gc_enabled(self) -> bool:
         return self.enabled and self.keep_last_n > 0
+
+    @property
+    def lockstep_resume_enabled(self) -> bool:
+        return self.enabled and self.verify_lockstep_on_resume
 
     @staticmethod
     def from_dict(d: Optional[Dict[str, Any]]) -> "ResilienceConfig":
@@ -1077,6 +1090,9 @@ class ResilienceConfig:
             io_backoff_seconds=float(get_scalar_param(
                 d, C.RESILIENCE_IO_BACKOFF_SECONDS,
                 C.RESILIENCE_IO_BACKOFF_SECONDS_DEFAULT)),
+            verify_lockstep_on_resume=get_scalar_param(
+                d, C.RESILIENCE_VERIFY_LOCKSTEP_ON_RESUME,
+                C.RESILIENCE_VERIFY_LOCKSTEP_ON_RESUME_DEFAULT),
             preemption=PreemptionConfig.from_dict(
                 d.get(C.RESILIENCE_PREEMPTION)),
             sentinel=SentinelConfig.from_dict(d.get(C.RESILIENCE_SENTINEL)),

@@ -664,6 +664,12 @@ RESILIENCE_IO_RETRIES = "io_retries"
 RESILIENCE_IO_RETRIES_DEFAULT = 3
 RESILIENCE_IO_BACKOFF_SECONDS = "io_backoff_seconds"
 RESILIENCE_IO_BACKOFF_SECONDS_DEFAULT = 0.5
+# Lockstep-signature re-verify on resume (resilience/reshard.py): a
+# same-topology resume must reproduce the checkpoint's saved collective
+# lockstep signature; a resharded resume re-verifies multihost
+# agreement on the new signature instead.
+RESILIENCE_VERIFY_LOCKSTEP_ON_RESUME = "verify_lockstep_on_resume"
+RESILIENCE_VERIFY_LOCKSTEP_ON_RESUME_DEFAULT = True
 
 # -- preemption sub-block ------------------------------------------- #
 RESILIENCE_PREEMPTION = "preemption"
@@ -677,6 +683,11 @@ PREEMPTION_SAVE_DIR = "save_dir"          # None → last save_checkpoint dir
 PREEMPTION_SAVE_DIR_DEFAULT = None
 PREEMPTION_RERAISE = "reraise"            # restore handler + re-deliver
 PREEMPTION_RERAISE_DEFAULT = True
+# Grace deadline: if no step boundary is reached within grace_s of the
+# signal, force-save the LAST COMPLETED step from a timer thread (tag
+# suffix "_forced") instead of losing the tag entirely.  0 = off.
+PREEMPTION_GRACE_S = "grace_s"
+PREEMPTION_GRACE_S_DEFAULT = 0.0
 
 # -- training-health sentinel sub-block ----------------------------- #
 RESILIENCE_SENTINEL = "sentinel"

@@ -123,6 +123,40 @@ def _get_compatible_micro_batch(final_batch_size: int, micro_batches: List[int],
     return max(candidates) if prefer_larger else min(candidates)
 
 
+def nearest_valid_world_sizes(valid_gpus: List[int], world_size: int,
+                              k: int = 3) -> List[int]:
+    """The `k` valid chip counts closest to `world_size` (ties resolve
+    smaller-first) — what an incompatible-world-size error suggests, and
+    what the fleet supervisor shrinks/regrows toward."""
+    return sorted(valid_gpus,
+                  key=lambda g: (abs(g - world_size), g))[:k]
+
+
+def _incompatible_world_size_error(world_size: int, final_batch_size: int,
+                                   valid_gpus: List[int],
+                                   micro_batches: List[int],
+                                   prefer_larger: bool
+                                   ) -> "ElasticityIncompatibleWorldSize":
+    """An ACTIONABLE incompatible-world-size error: names the nearest
+    valid world sizes and the micro-batch/gas each would run with, so an
+    operator (or the fleet supervisor) can pick a target instead of
+    bisecting chip counts against a bare exception."""
+    suggestions = []
+    for g in nearest_valid_world_sizes(valid_gpus, world_size):
+        micro = _get_compatible_micro_batch(final_batch_size, micro_batches,
+                                            g, prefer_larger)
+        suggestions.append(
+            f"{g} chips (micro_batch={micro}, "
+            f"gas={final_batch_size // (micro * g)})")
+    return ElasticityIncompatibleWorldSize(
+        f"World size ({world_size}) is not valid with the current list "
+        f"of valid chip counts: {valid_gpus} "
+        f"(final batch size {final_batch_size}). Nearest valid world "
+        f"sizes: {'; '.join(suggestions) or 'none'} — resize the job to "
+        "one of these, or widen elasticity.micro_batch_sizes / "
+        "min_gpus / max_gpus to admit the current size.")
+
+
 def compute_elastic_config(ds_config: Dict, world_size: int = 0):
     """Returns (final_batch_size, valid_gpus[, micro_batch_per_gpu]).
 
@@ -139,9 +173,10 @@ def compute_elastic_config(ds_config: Dict, world_size: int = 0):
         elastic_config.max_gpus, elastic_config.prefer_larger_batch_size)
     if world_size > 0:
         if world_size not in valid_gpus:
-            raise ElasticityIncompatibleWorldSize(
-                f"World size ({world_size}) is not valid with the current list "
-                f"of valid chip counts: {valid_gpus}")
+            raise _incompatible_world_size_error(
+                world_size, final_batch_size, valid_gpus,
+                elastic_config.micro_batches,
+                elastic_config.prefer_larger_batch_size)
         micro = _get_compatible_micro_batch(
             final_batch_size, elastic_config.micro_batches, world_size,
             elastic_config.prefer_larger_batch_size)

@@ -21,6 +21,7 @@ import base64
 import json
 import os
 import shlex
+import signal as _signal
 import subprocess
 import sys
 import threading
@@ -76,6 +77,29 @@ def parse_args(args=None):
                         help="a running host whose heartbeat is older "
                              "than max(this, 3x its own beat interval) "
                              "is rendered STALE")
+    parser.add_argument("--watch_fail_after", type=int, default=0,
+                        help="liveness gate for supervisor scripts: when "
+                             "a heartbeat stays STALE for this many "
+                             "consecutive --watch renders, terminate the "
+                             "workers and exit nonzero (rc=3) with the "
+                             "stale worker named — no table parsing "
+                             "needed (0 = render only, never act)")
+    parser.add_argument("--elastic", action="store_true",
+                        help="self-healing relaunch loop: on a worker "
+                             "failure (nonzero exit or --watch_fail_after "
+                             "liveness trip) drop the failed/stale hosts, "
+                             "shrink to the survivors, and relaunch — the "
+                             "engine-side resilience block resumes from "
+                             "the newest checkpoint, resharding ZeRO "
+                             "partitions onto the smaller world "
+                             "(docs/elastic_fleet.md).  With --tpu the "
+                             "pod is re-discovered before each relaunch, "
+                             "so replacement workers REGROW the fleet")
+    parser.add_argument("--elastic_min_nodes", type=int, default=1,
+                        help="stop relaunching (exit with the last rc) "
+                             "when fewer hosts than this survive")
+    parser.add_argument("--max_relaunches", type=int, default=3,
+                        help="bound on --elastic relaunch cycles")
     parser.add_argument("user_script", type=str)
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
     return parser.parse_args(args)
@@ -203,9 +227,30 @@ def _pump_lines(stream, sink, prefix: str) -> None:
             pass
 
 
+WATCH_FAIL_RC = 3  # liveness-gate exit code (--watch_fail_after tripped)
+
+
+class LaunchOutcome:
+    """What one launch cycle reports: aggregate rc, the workers that
+    exited nonzero, and the workers the --watch liveness gate declared
+    dark — the inputs the --elastic relaunch loop (and any external
+    supervisor script) shrinks the host list with."""
+
+    def __init__(self):
+        self.rc = 0
+        self.failed: List[tuple] = []      # (host, rank, exit code)
+        self.stale: List[tuple] = []       # (process_index, host_label)
+
+    @property
+    def bad_hosts(self) -> set:
+        return ({h for h, _, _ in self.failed}
+                | {h for _, h in self.stale})
+
+
 def launch_and_wait(cmds: List[List[str]], hosts: List[str],
                     watch_dir: str = "", watch_interval: float = 10.0,
-                    watch_stale_s: float = 60.0) -> int:
+                    watch_stale_s: float = 60.0,
+                    watch_fail_after: int = 0) -> int:
     """Spawn one process per host, label their output, surface failures.
 
     Multi-host launches pipe each worker's stdout/stderr through a
@@ -214,10 +259,22 @@ def launch_and_wait(cmds: List[List[str]], hosts: List[str],
     With ``watch_dir`` the launcher also renders the heartbeat status
     table (monitor/heartbeat.py) every ``watch_interval`` seconds while
     workers run.  Nonzero worker exits are reported WITH the offending
-    host named; the return code is the first nonzero worker rc."""
+    host named; the return code is the first nonzero worker rc.
+    ``watch_fail_after`` > 0 turns the watch into a liveness GATE: a
+    heartbeat that stays STALE for that many consecutive renders
+    terminates the workers and returns rc=3 with the worker named."""
+    return launch_and_collect(cmds, hosts, watch_dir, watch_interval,
+                              watch_stale_s, watch_fail_after).rc
+
+
+def launch_and_collect(cmds: List[List[str]], hosts: List[str],
+                       watch_dir: str = "", watch_interval: float = 10.0,
+                       watch_stale_s: float = 60.0,
+                       watch_fail_after: int = 0) -> LaunchOutcome:
     prefix_on = len(cmds) > 1
     procs: List[subprocess.Popen] = []
     pumps: List[threading.Thread] = []
+    outcome = LaunchOutcome()
     for rank, (host, cmd) in enumerate(zip(hosts, cmds)):
         if prefix_on:
             # errors="replace": a worker emitting non-UTF-8 bytes (a
@@ -239,10 +296,12 @@ def launch_and_wait(cmds: List[List[str]], hosts: List[str],
         procs.append(p)
 
     if watch_dir:
-        from ..monitor.heartbeat import (format_watch_table,
+        from ..monitor.heartbeat import (annotate_stale,
+                                         format_watch_table,
                                          read_heartbeats,
                                          resolve_heartbeat_dir)
         next_render = time.monotonic()  # render immediately, then every
+        stale_streak: Dict[int, int] = {}
         while any(p.poll() is None for p in procs):
             if time.monotonic() >= next_render:
                 next_render = time.monotonic() + max(1.0, watch_interval)
@@ -251,12 +310,27 @@ def launch_and_wait(cmds: List[List[str]], hosts: List[str],
                     # <output_path>/<job_name>/heartbeat dir may only
                     # appear once workers reach their first window
                     hb_dir = resolve_heartbeat_dir(watch_dir)
+                    beats = read_heartbeats(hb_dir)
                     table = format_watch_table(
-                        read_heartbeats(hb_dir),
-                        stale_after_s=watch_stale_s,
+                        beats, stale_after_s=watch_stale_s,
                         expected_procs=len(cmds))
                     print(f"--- dslaunch --watch {hb_dir} ---\n{table}",
                           flush=True)
+                    if watch_fail_after > 0:
+                        tripped = _track_stale_streaks(
+                            annotate_stale(beats, watch_stale_s),
+                            stale_streak, watch_fail_after, hosts)
+                        if tripped:
+                            outcome.stale = tripped
+                            for pidx, host in tripped:
+                                logger.error(
+                                    f"dslaunch --watch_fail_after: "
+                                    f"worker {pidx} ({host!r}) heartbeat "
+                                    f"stale for {watch_fail_after} "
+                                    "consecutive renders — terminating "
+                                    "workers")
+                            _terminate_all(procs)
+                            break
                 except Exception as e:  # noqa: BLE001 — a status render
                     # must never take down the launcher (and its
                     # rc-aggregation) while workers are alive
@@ -281,11 +355,58 @@ def launch_and_wait(cmds: List[List[str]], hosts: List[str],
               if h not in {f[0] for f in failed}]
         logger.error(f"dslaunch: {len(failed)}/{len(procs)} worker(s) "
                      f"failed; clean exits on: {ok}")
-    return rc
+    if outcome.stale:
+        # terminated-by-gate workers exit on our signal: the liveness
+        # verdict (not their SIGTERM rc) is the reported failure.  That
+        # covers the HEALTHY workers _terminate_all killed too — only
+        # the stale hosts are bad; keeping a gate-terminated survivor in
+        # `failed` would make --elastic drop the whole fleet.
+        rc = WATCH_FAIL_RC
+        gate_rcs = {-_signal.SIGTERM, -_signal.SIGKILL}
+        failed = [f for f in failed
+                  if f[0] not in {h for _, h in outcome.stale}
+                  and f[2] not in gate_rcs]
+    outcome.rc = rc
+    outcome.failed = failed
+    return outcome
 
 
-def main(argv=None) -> int:
-    args = parse_args(argv)
+def _track_stale_streaks(beats, streaks: Dict[int, int],
+                         fail_after: int, hosts: List[str]) -> List[tuple]:
+    """Consecutive-render stale accounting; returns the (process_index,
+    host) pairs whose streak reached `fail_after` this render."""
+    stale_now = {hb.get("process_index") for hb in beats
+                 if hb.get("stale")
+                 and hb.get("process_index") is not None}
+    for pidx in list(streaks):
+        if pidx not in stale_now:
+            del streaks[pidx]
+    tripped = []
+    for pidx in sorted(stale_now):
+        streaks[pidx] = streaks.get(pidx, 0) + 1
+        if streaks[pidx] >= fail_after:
+            host = hosts[pidx] if pidx < len(hosts) else f"p{pidx}"
+            tripped.append((pidx, host))
+    return tripped
+
+
+def _terminate_all(procs: List[subprocess.Popen],
+                   grace_s: float = 5.0) -> None:
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    deadline = time.monotonic() + grace_s
+    for p in procs:
+        while p.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.1)
+        if p.poll() is None:
+            p.kill()
+
+
+def _resolve_active(args):
+    """(active resources, labels) for one launch attempt — re-run per
+    --elastic relaunch so a --tpu pod re-discovers its CURRENT worker
+    set (preempted workers vanish, replacements appear = regrow)."""
     labels: Dict[str, str] = {}
     if args.tpu:
         from .tpu_discovery import discover
@@ -304,18 +425,58 @@ def main(argv=None) -> int:
         resources = OrderedDict(localhost=1)
     if args.num_nodes > 0:
         resources = OrderedDict(list(resources.items())[:args.num_nodes])
-    active = parse_resource_filter(resources, args.include, args.exclude)
+    return parse_resource_filter(resources, args.include,
+                                 args.exclude), labels
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    active, labels = _resolve_active(args)
     logger.info(f"dslaunch world: { {h: s for h, s in active.items()} }")
-    cmds = build_host_commands(active, args)
     if args.dry_run:
-        for c in cmds:
+        for c in build_host_commands(active, args):
             print(" ".join(map(shlex.quote, c)))
         return 0
-    return launch_and_wait(cmds,
-                           [labels.get(h, h) for h in active],
-                           watch_dir=args.watch,
-                           watch_interval=args.watch_interval,
-                           watch_stale_s=args.watch_stale_s)
+
+    relaunch = 0
+    bad_hosts: set = set()  # hosts that failed the PREVIOUS attempt
+    while True:
+        host_labels = [labels.get(h, h) for h in active]
+        outcome = launch_and_collect(
+            build_host_commands(active, args), host_labels,
+            watch_dir=args.watch, watch_interval=args.watch_interval,
+            watch_stale_s=args.watch_stale_s,
+            watch_fail_after=args.watch_fail_after)
+        if outcome.rc == 0 or not args.elastic:
+            return outcome.rc
+        if relaunch >= args.max_relaunches:
+            logger.error(
+                f"dslaunch --elastic: max_relaunches="
+                f"{args.max_relaunches} exhausted — exiting "
+                f"rc={outcome.rc}")
+            return outcome.rc
+        relaunch += 1
+        # labels back to ssh hosts: ranks line up with `active`'s order
+        by_label = {label: host
+                    for label, host in zip(host_labels, active)}
+        bad_hosts = {by_label.get(h, h) for h in outcome.bad_hosts}
+        # regrow: re-discover capacity (a --tpu pod's replacement
+        # workers join here); hosts that just failed sit out ONE attempt
+        refreshed, labels = _resolve_active(args)
+        survivors = OrderedDict(
+            (h, s) for h, s in refreshed.items() if h not in bad_hosts)
+        if len(survivors) < max(1, args.elastic_min_nodes):
+            logger.error(
+                f"dslaunch --elastic: only {len(survivors)} host(s) "
+                f"survive (min {args.elastic_min_nodes}) after dropping "
+                f"{sorted(bad_hosts)} — exiting rc={outcome.rc}")
+            return outcome.rc
+        logger.error(
+            f"dslaunch --elastic: relaunch {relaunch}/"
+            f"{args.max_relaunches} on {len(survivors)} host(s) "
+            f"(dropped {sorted(bad_hosts)}); the engine resumes from "
+            "the newest checkpoint and reshards onto the new world")
+        active = survivors
 
 
 if __name__ == "__main__":

@@ -289,16 +289,24 @@ class DeepSpeedEngine:
                 anomaly_budget=res.sentinel.anomaly_budget,
                 monitor_grad_norm=res.sentinel.monitor_grad_norm)
         self._preemption = None
+        # serializes the normal boundary emergency save against the
+        # grace-deadline forced save (which runs on a timer thread)
+        import threading
+        self._emergency_lock = threading.Lock()
         if res.preemption.enabled:
             from .resilience.preemption import PreemptionHandler
             self._preemption = PreemptionHandler(
                 signals=res.preemption.signals,
-                reraise=res.preemption.reraise).install()
+                reraise=res.preemption.reraise,
+                grace_s=res.preemption.grace_s,
+                on_deadline=self._forced_emergency_save).install()
         # rewind target + default emergency-save dir, tracked across
         # save_checkpoint/load_checkpoint
         self._last_good_ckpt = None
         self._last_save_dir = None
         self._grad_norm_fn = None
+        # lazily-traced collective lockstep signature (reshard re-verify)
+        self._lockstep_sig_cache = None
 
         # ---- compiled programs --------------------------------------- #
         self._build_functions()
@@ -1462,22 +1470,71 @@ class DeepSpeedEngine:
             triggered = bool(flags.max())
         if not triggered:
             return
+        # the boundary was reached: disarm a pending grace deadline, then
+        # wait out a forced save already in flight on the timer thread
+        self._preemption.boundary_reached()
+        pre = self.resilience.preemption
+        with self._emergency_lock:
+            forced = self._preemption.forced_tag
+        tag = None
+        if forced is not None:
+            # the grace deadline already saved this step's state — don't
+            # save a second tag for the same boundary
+            tag = forced
+        else:
+            save_dir = pre.save_dir or self._last_save_dir
+            if save_dir is not None:
+                tag = f"{pre.emergency_tag_prefix}_step{self.global_steps}"
+                try:
+                    with self._emergency_lock:
+                        self.save_checkpoint(save_dir, tag=tag)
+                except Exception as e:  # noqa: BLE001 — still stop cleanly
+                    logger.error(
+                        f"preemption: emergency checkpoint failed: {e}")
+                    tag = None
+            else:
+                logger.error(
+                    "preemption: no emergency save dir known (no prior "
+                    "save_checkpoint and resilience.preemption.save_dir "
+                    "unset) — stopping without an emergency checkpoint")
+        self._preemption.finalize(emergency_tag=tag)
+
+    def _forced_emergency_save(self) -> Optional[str]:
+        """Grace-deadline callback (resilience.preemption.grace_s): the
+        signal landed but no step boundary arrived within the window —
+        save the LAST COMPLETED step's state from the timer thread.
+
+        self.params/opt_state are only reassigned at step boundaries, so
+        between boundaries they hold the last completed step — exactly
+        the state the boundary save would have written.  Multi-process
+        saves are collective (shard barriers) and cannot run off-thread
+        while peers sit in the training loop, so the forced save is
+        single-process only; a pod relies on the collective stop
+        protocol instead."""
+        if jax.process_count() > 1:
+            logger.error(
+                "preemption: grace deadline expired but forced emergency "
+                "saves are single-process only (a multi-process save is "
+                "collective) — the pod keeps waiting for the step "
+                "boundary")
+            return None
         pre = self.resilience.preemption
         save_dir = pre.save_dir or self._last_save_dir
-        tag = None
-        if save_dir is not None:
-            tag = f"{pre.emergency_tag_prefix}_step{self.global_steps}"
-            try:
-                self.save_checkpoint(save_dir, tag=tag)
-            except Exception as e:  # noqa: BLE001 — still stop cleanly
-                logger.error(f"preemption: emergency checkpoint failed: {e}")
-                tag = None
-        else:
+        if save_dir is None:
             logger.error(
-                "preemption: no emergency save dir known (no prior "
-                "save_checkpoint and resilience.preemption.save_dir unset) "
-                "— stopping without an emergency checkpoint")
-        self._preemption.finalize(emergency_tag=tag)
+                "preemption: grace deadline expired but no emergency "
+                "save dir is known (resilience.preemption.save_dir "
+                "unset, no prior save_checkpoint)")
+            return None
+        tag = f"{pre.emergency_tag_prefix}_step{self.global_steps}_forced"
+        try:
+            with self._emergency_lock:
+                self.save_checkpoint(save_dir, tag=tag)
+            return tag
+        except Exception as e:  # noqa: BLE001 — report, keep the loop's
+            # own boundary path as the remaining chance
+            logger.error(f"preemption: forced emergency save failed: {e}")
+            return None
 
     def _block_hvp(self, key):
         """Compiled-once per-block Hessian-vector product: (params, v,
@@ -1779,6 +1836,50 @@ class DeepSpeedEngine:
             return bool(cfg)
         return jax.process_count() > 1
 
+    def lockstep_signature(self) -> Optional[str]:
+        """Collective lockstep signature of this engine's step programs
+        (analysis/signature.py).  Reuses the init-time audit when the
+        analysis block ran; otherwise traced lazily ONCE (abstract trace,
+        never executed) and cached — save/resume verification must not
+        re-trace on every checkpoint."""
+        if self.program_audit is not None and \
+                self.program_audit.signature is not None:
+            return self.program_audit.signature
+        if self._lockstep_sig_cache is None:
+            try:
+                from ..analysis.auditor import engine_targets
+                from ..analysis.signature import (combine_signatures,
+                                                  lockstep_signature)
+                sigs = [lockstep_signature(t.closed_jaxpr)[0]
+                        for t in engine_targets(self)]
+                self._lockstep_sig_cache = combine_signatures(sigs)
+            except Exception as e:  # noqa: BLE001 — a failed trace must
+                # degrade to "no signature" (verification skips), never
+                # block a checkpoint save
+                logger.warning(
+                    f"lockstep signature trace failed ({e}) — resume "
+                    "re-verification will be skipped for this engine")
+                self._lockstep_sig_cache = ""
+        return self._lockstep_sig_cache or None
+
+    def _partition_topology(self) -> Dict[str, Any]:
+        """The saved-partition-topology descriptor recorded in every
+        checkpoint's client state (resilience/reshard.py): the contract
+        that makes checkpoints mesh-shape-portable — loads validate the
+        saved topology against the target mesh and fail loudly instead
+        of resuming a scrambled layout."""
+        from .resilience.reshard import TOPOLOGY_FORMAT_VERSION
+        lbc = self.config.zero_config.low_bandwidth
+        topo = self.zero_partitioner.topology(
+            hpz_group_size=(lbc.hpz_group_size or 0) if lbc.enabled else 0)
+        topo.update({
+            "format_version": TOPOLOGY_FORMAT_VERSION,
+            "process_count": int(jax.process_count()),
+            "layout": ("sharded" if self._sharded_checkpoints()
+                       else "consolidated"),
+        })
+        return topo
+
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True):
         if tag is None:
@@ -1807,6 +1908,18 @@ class DeepSpeedEngine:
                 jax.random.key_data(self._rng)).tolist(),
             "engine_rng_impl": str(jax.random.key_impl(self._rng)),
         })
+        # mesh-shape portability: record the partition topology this tag
+        # was saved on (reshard-on-load validates against it), plus the
+        # collective lockstep signature for the resume re-verify.  The
+        # signature needs an abstract trace, so it is only computed when
+        # the resilience block (which consumes it on resume) is on or
+        # the analysis block already traced it for free.
+        from .resilience import reshard as reshard_mod
+        client[reshard_mod.TOPOLOGY_KEY] = self._partition_topology()
+        if self.resilience.enabled or self.program_audit is not None:
+            sig = self.lockstep_signature()
+            if sig:
+                client[reshard_mod.SIGNATURE_KEY] = sig
         if self.sentinel is not None:
             if self._fused_step_fn is not None:
                 # fold the in-program loss EWMA + pending verdicts into the
@@ -1926,6 +2039,21 @@ class DeepSpeedEngine:
         resolved_tag = tag or ckpt_mod.read_latest_tag(load_dir)
         if self.resilience.verify_enabled:
             resolved_tag = self._resolve_verified_tag(load_dir, tag)
+        # ---- mesh-shape portability + lockstep re-verify -------------- #
+        # Validate BEFORE any array assembly: a topology-ambiguous or
+        # signature-mismatched load must fail loudly (named tag, saved vs
+        # requested topology), not resume (resilience/reshard.py).
+        from .resilience import reshard as reshard_mod
+        saved_client = reshard_mod.read_saved_client_state(
+            load_dir, str(resolved_tag))
+        resharded = reshard_mod.check_reshard(
+            str(resolved_tag), saved_client, self._partition_topology(),
+            current_world_size=self.world_size)
+        if self.resilience.lockstep_resume_enabled and (
+                saved_client.get(reshard_mod.SIGNATURE_KEY) or resharded):
+            reshard_mod.verify_lockstep_resume(
+                str(resolved_tag), saved_client, self.lockstep_signature(),
+                resharded)
         sharded_index = os.path.join(load_dir, str(resolved_tag),
                                      "model_index.json")
         if os.path.isfile(sharded_index):

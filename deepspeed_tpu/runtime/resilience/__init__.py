@@ -1,7 +1,10 @@
 """Fault-tolerance subsystem: atomic checksummed checkpoints, verified
-load with fallback + retention GC, preemption-safe saves, and a
-training-health sentinel.  Wired through the engine behind the
-``resilience`` config block (all off by default); see docs/resilience.md.
+load with fallback + retention GC, preemption-safe saves, a
+training-health sentinel, mesh-shape-portable checkpoint validation
+(reshard-on-load + lockstep re-verify), and the elastic fleet
+supervisor that closes the observe→decide→act loop.  Wired through the
+engine behind the ``resilience`` config block (all off by default); see
+docs/resilience.md and docs/elastic_fleet.md.
 """
 
 from .atomic import (cleanup_tmp_dirs, commit_tag_dir, file_crc32,
@@ -11,14 +14,23 @@ from .atomic import (cleanup_tmp_dirs, commit_tag_dir, file_crc32,
 from .preemption import PreemptionHandler, TrainingInterrupted
 from .recovery import (gc_checkpoints, list_tags, rescue_renamed_aside,
                        resolve_intact_tag, tag_problems, tag_step)
+from .reshard import (LockstepResumeError, ReshardError, check_reshard,
+                      read_saved_client_state, verify_lockstep_resume)
 from .sentinel import SentinelAbort, TrainingSentinel
+from .supervisor import (CycleResult, FleetDecision, FleetSupervisor,
+                         ResumePlan, SupervisorPolicy, choose_world_size,
+                         plan_resume)
 
 __all__ = [
-    "MANIFEST_FILE", "PreemptionHandler", "SentinelAbort",
-    "TrainingInterrupted", "TrainingSentinel", "cleanup_tmp_dirs",
-    "commit_tag_dir", "file_crc32", "gc_checkpoints", "has_manifest",
-    "is_tmp_dir", "is_working_dir", "list_tags", "rescue_renamed_aside",
+    "CycleResult", "FleetDecision", "FleetSupervisor",
+    "LockstepResumeError", "MANIFEST_FILE", "PreemptionHandler",
+    "ReshardError", "ResumePlan", "SentinelAbort", "SupervisorPolicy",
+    "TrainingInterrupted", "TrainingSentinel", "check_reshard",
+    "choose_world_size", "cleanup_tmp_dirs", "commit_tag_dir",
+    "file_crc32", "gc_checkpoints", "has_manifest", "is_tmp_dir",
+    "is_working_dir", "list_tags", "plan_resume",
+    "read_saved_client_state", "rescue_renamed_aside",
     "resolve_intact_tag", "retry_io", "tag_problems", "tag_step",
-    "tmp_tag_dir", "verify_manifest", "write_latest_atomic",
-    "write_manifest",
+    "tmp_tag_dir", "verify_lockstep_resume", "verify_manifest",
+    "write_latest_atomic", "write_manifest",
 ]

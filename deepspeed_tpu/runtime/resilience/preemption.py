@@ -8,11 +8,21 @@ window.  The handler turns that into a deterministic protocol:
   checkpoint under a distinct tag → ``TrainingInterrupted`` is raised
   (and, for ``reraise=True``, the original disposition is restored and
   the signal re-delivered so process supervisors see the real exit).
+
+With ``grace_s`` set (resilience.preemption.grace_s), the handler also
+arms a deadline when the signal lands: if the training loop does NOT
+reach a step boundary within the grace window (a wedged collective, a
+pathologically slow step), the ``on_deadline`` callback fires from a
+daemon timer thread and force-saves the LAST COMPLETED step's state —
+losing one in-flight step instead of the whole tag.  The engine cancels
+the deadline the moment a boundary is reached (``boundary_reached``),
+so a healthy loop never sees it.
 """
 
 import os
 import signal
-from typing import Iterable, Optional
+import threading
+from typing import Callable, Iterable, Optional
 
 from ...utils.logging import logger
 
@@ -50,13 +60,22 @@ class PreemptionHandler:
     `triggered` at step boundaries (the only safe place to checkpoint —
     mid-step state spans donated device buffers)."""
 
-    def __init__(self, signals=("SIGTERM", "SIGINT"), reraise: bool = True):
+    def __init__(self, signals=("SIGTERM", "SIGINT"), reraise: bool = True,
+                 grace_s: float = 0.0,
+                 on_deadline: Optional[Callable[[], Optional[str]]] = None):
         self.signals = _resolve_signals(signals)
         self.reraise = reraise
         self.triggered = False
         self.signum: Optional[int] = None
         self._prev = {}
         self._installed = False
+        # grace deadline: force-save the last completed step if no step
+        # boundary is reached within grace_s of the signal
+        self.grace_s = float(grace_s or 0.0)
+        self.on_deadline = on_deadline
+        self.deadline_fired = False
+        self.forced_tag: Optional[str] = None
+        self._deadline_timer: Optional[threading.Timer] = None
 
     def install(self) -> "PreemptionHandler":
         for sig in self.signals:
@@ -65,6 +84,7 @@ class PreemptionHandler:
         return self
 
     def uninstall(self) -> None:
+        self.boundary_reached()  # never leave a grace timer behind
         if not self._installed:
             return
         for sig, prev in self._prev.items():
@@ -77,15 +97,55 @@ class PreemptionHandler:
 
     def _on_signal(self, signum, frame) -> None:
         # async-signal context: just record; everything else happens at
-        # the step boundary
+        # the step boundary.  threading.Timer start is signal-safe
+        # enough for CPython (it only creates a thread object) and the
+        # grace window is useless if armed any later.
         self.triggered = True
         self.signum = signum
+        self._arm_deadline()
 
     def request_stop(self, signum: int = signal.SIGTERM) -> None:
         """Programmatic trigger (tests, cluster agents with their own
         preemption notice channel)."""
         self.triggered = True
         self.signum = signum
+        self._arm_deadline()
+
+    # -- grace deadline ------------------------------------------------ #
+    def _arm_deadline(self) -> None:
+        if (self.grace_s <= 0 or self.on_deadline is None
+                or self._deadline_timer is not None or self.deadline_fired):
+            return
+        t = threading.Timer(self.grace_s, self._deadline_expired)
+        t.daemon = True
+        t.name = "ds-preemption-grace"
+        t.start()
+        self._deadline_timer = t
+
+    def _deadline_expired(self) -> None:
+        self.deadline_fired = True
+        logger.error(
+            f"preemption: no step boundary within grace_s={self.grace_s}s "
+            "of the signal — force-saving the last completed step")
+        try:
+            self.forced_tag = self.on_deadline()
+        except Exception as e:  # noqa: BLE001 — a failed forced save must
+            # not kill the timer thread silently mid-teardown
+            logger.error(f"preemption: forced emergency save failed: {e}")
+
+    def boundary_reached(self) -> None:
+        """The engine reached a step boundary: the normal emergency path
+        takes over, so a pending grace deadline is disarmed.  If the
+        deadline ALREADY fired, ``forced_tag`` carries its result — the
+        join below waits out a callback still running on the timer
+        thread, so the boundary path never reads a stale ``forced_tag``
+        and double-saves the same step (a cancelled-before-firing timer
+        joins immediately)."""
+        t = self._deadline_timer
+        if t is not None:
+            self._deadline_timer = None
+            t.cancel()
+            t.join()
 
     def finalize(self, emergency_tag: Optional[str] = None) -> None:
         """Restore handlers and raise; with reraise, re-deliver the signal

@@ -1,0 +1,230 @@
+"""Mesh-shape-portable checkpoints: reshard-on-load validation.
+
+A checkpoint saved at world size W must load onto any valid W' — the
+Frontier-style scenario (arXiv:2501.04266) where ZeRO/hpZ partitions
+follow the surviving worker set after a preemption.  The MECHANISM
+already exists: the sharded layout (runtime/sharded_checkpoint.py) keys
+every stored block by its GLOBAL slice and assembles, per leaf and per
+device of the NEW topology, exactly the local slice it needs — a
+consolidate-then-repartition that streams one leaf at a time, so peak
+host memory stays ~one partition group regardless of W or W'.  The
+consolidated (.npz) layout stores full leaves and device_puts them onto
+the new shardings, trivially portable.
+
+What was MISSING is the contract: nothing recorded which topology a tag
+was saved on, so an incompatible load (tensor-parallel resize, a legacy
+tag with no provenance crossing world sizes) proceeded silently and
+produced scrambled weights or a wedged pod.  This module is that
+contract:
+
+  * ``partition_topology`` (engine-side) is written into the tag's
+    ``ds_meta.json`` client state at save — mesh axis sizes, zero
+    stage, hpZ group, world/process counts, layout, and the collective
+    lockstep signature of the step program that produced it.
+  * ``check_reshard`` validates a load: same topology → silent; a
+    ZeRO-axes-only resize → allowed and logged as a reshard; a non-ZeRO
+    axis resize, or a world-size change on a tag that recorded NO
+    topology (pre-portability checkpoints — ambiguous) → ``ReshardError``
+    naming the tag and both topologies.
+  * ``verify_lockstep_resume`` is the PR-5 re-verify before the first
+    post-resume step: same-topology resumes must reproduce the SAVED
+    lockstep signature bit-for-bit (config drift between save and resume
+    — a qwZ flag flipped, a streaming mode changed — would otherwise
+    corrupt the run or deadlock the pod at the first diverged
+    collective); changed-topology resumes get a fresh multihost
+    agreement check instead (the signature legitimately changes with
+    the mesh).
+"""
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from ...utils.logging import logger
+from ..zero.partition import topologies_equal, topology_reshard_problems
+
+# client-state key under which save_checkpoint records the topology
+TOPOLOGY_KEY = "partition_topology"
+SIGNATURE_KEY = "lockstep_signature"
+TOPOLOGY_FORMAT_VERSION = 1
+
+
+class ReshardError(RuntimeError):
+    """A checkpoint cannot be mapped onto the requested topology — or
+    the mapping would be ambiguous.  Carries tag + both topologies so
+    the operator sees saved-vs-requested without re-running."""
+
+    def __init__(self, tag: str, saved: Optional[Dict[str, Any]],
+                 requested: Dict[str, Any], problems: List[str]):
+        self.tag = str(tag)
+        self.saved_topology = saved
+        self.requested_topology = requested
+        self.problems = list(problems)
+        super().__init__(
+            f"checkpoint tag {self.tag!r} cannot be resharded onto the "
+            f"requested topology: {'; '.join(self.problems)} "
+            f"[saved topology: {_topo_str(saved)}; requested topology: "
+            f"{_topo_str(requested)}]")
+
+
+class LockstepResumeError(RuntimeError):
+    """The resumed step program's collective lockstep signature does not
+    match the one the checkpoint was saved with, on an UNCHANGED
+    topology — config drift that would silently diverge (or deadlock) a
+    resumed pod.  Aborts before the first post-resume step."""
+
+    def __init__(self, tag: str, saved_signature: str,
+                 current_signature: str, topology: Dict[str, Any],
+                 peer_divergent: bool = False):
+        self.tag = str(tag)
+        self.saved_signature = saved_signature
+        self.current_signature = current_signature
+        self.peer_divergent = bool(peer_divergent)
+        if peer_divergent:
+            msg = (
+                f"lockstep re-verify failed resuming checkpoint tag "
+                f"{self.tag!r}: processes DISAGREE on the resumed "
+                f"program's signature (this process traces "
+                f"{current_signature[:12]}) after a topology reshard — a "
+                "mixed-config relaunch; make every host resume with the "
+                "identical config, or the pod deadlocks at the first "
+                "diverged collective.")
+        else:
+            msg = (
+                f"lockstep re-verify failed resuming checkpoint tag "
+                f"{self.tag!r}: saved signature {saved_signature[:12]} != "
+                f"current {current_signature[:12]} on an unchanged "
+                f"topology ({_topo_str(topology)}) — the resumed config "
+                "traces a DIFFERENT collective schedule than the one that "
+                "saved this checkpoint. Diff the configs (python -m "
+                "deepspeed_tpu.analysis --dump-sequence) and fix the "
+                "drift; resuming would corrupt the run or deadlock the "
+                "pod.")
+        super().__init__(msg)
+
+
+def _topo_str(topo: Optional[Dict[str, Any]]) -> str:
+    if not topo:
+        return "<none recorded>"
+    mesh = topo.get("mesh") or {}
+    live = {a: s for a, s in mesh.items() if int(s) > 1} or {"total": 1}
+    parts = [f"mesh={live}", f"zero_stage={topo.get('zero_stage')}"]
+    if topo.get("hpz_group_size"):
+        parts.append(f"hpz={topo.get('hpz_group_size')}")
+    if topo.get("process_count"):
+        parts.append(f"procs={topo.get('process_count')}")
+    return " ".join(parts)
+
+
+def read_saved_client_state(load_dir: str, tag: str) -> Dict[str, Any]:
+    """The tag's ds_meta.json client state ({} when absent) — read FIRST
+    on load so topology/lockstep validation fails before any array
+    assembly work starts."""
+    meta = os.path.join(load_dir, str(tag), "ds_meta.json")
+    if not os.path.isfile(meta):
+        return {}
+    try:
+        with open(meta) as f:
+            return json.load(f).get("client_state", {}) or {}
+    except (OSError, ValueError) as e:
+        logger.warning(f"checkpoint tag {tag!r}: unreadable ds_meta.json "
+                       f"({e}) — topology validation skipped")
+        return {}
+
+
+def check_reshard(tag: str, saved_client: Dict[str, Any],
+                  current_topology: Dict[str, Any],
+                  current_world_size: Optional[int] = None) -> bool:
+    """Validate loading `tag` onto `current_topology`.
+
+    Returns True when the load is a RESHARD (topology changed but the
+    change is ZeRO-axes-only), False when topologies match.  Raises
+    ``ReshardError`` on a non-portable change, or on an AMBIGUOUS load:
+    a tag with no recorded topology whose recorded dp world size (the
+    legacy provenance field) differs from the current one."""
+    saved_topo = saved_client.get(TOPOLOGY_KEY)
+    if not saved_topo:
+        saved_w = saved_client.get("dp_world_size")
+        if (saved_w is not None and current_world_size is not None
+                and int(saved_w) != int(current_world_size)):
+            raise ReshardError(
+                tag, None, current_topology,
+                [f"tag records no {TOPOLOGY_KEY} but was saved at dp "
+                 f"world size {saved_w} != current {current_world_size} "
+                 "— the saved partition layout is ambiguous; re-save "
+                 "with this version (which records topology) or load at "
+                 "the original world size and re-save"])
+        return False  # legacy tag, same world — nothing to validate
+    if saved_topo.get("layout") == "consolidated":
+        # full-leaf (.npz) layout: every stored value is an unsharded
+        # global leaf, device_put onto whatever shardings the new mesh
+        # asks for — mesh-independent, so even non-ZeRO axis resizes are
+        # well-defined (a structural mismatch still fails loudly at
+        # template assembly)
+        problems = []
+    else:
+        problems = topology_reshard_problems(saved_topo, current_topology)
+    if problems:
+        raise ReshardError(tag, saved_topo, current_topology, problems)
+    if topologies_equal(saved_topo, current_topology):
+        return False
+    if int(saved_topo.get("zero_stage") or 0) != int(
+            current_topology.get("zero_stage") or 0):
+        logger.warning(
+            f"checkpoint tag {tag!r}: zero stage changes "
+            f"{saved_topo.get('zero_stage')} -> "
+            f"{current_topology.get('zero_stage')} on load — stored "
+            "values are stage-agnostic global slices, repartitioning "
+            "under the new stage's shardings")
+    logger.warning(
+        f"resharding checkpoint tag {tag!r}: saved "
+        f"[{_topo_str(saved_topo)}] -> requested "
+        f"[{_topo_str(current_topology)}] (ZeRO-axes resize; "
+        "per-leaf streaming consolidate-then-repartition)")
+    return True
+
+
+def verify_lockstep_resume(tag: str, saved_client: Dict[str, Any],
+                           current_signature: Optional[str],
+                           resharded: bool) -> None:
+    """The before-first-step re-verify (PR 5's machinery).
+
+    Same topology: the saved and current signatures must match exactly
+    — a mismatch means the resumed config traces a different collective
+    schedule (LockstepResumeError).  Resharded: the signature
+    legitimately changes with the mesh, so instead every process must
+    agree on the NEW signature (multihost allgather; no-op on one
+    process) — the divergence a mixed-config relaunch would smuggle in.
+    """
+    saved_sig = saved_client.get(SIGNATURE_KEY)
+    if current_signature is None:
+        return
+    if not resharded:
+        if saved_sig and saved_sig != current_signature:
+            raise LockstepResumeError(
+                tag, saved_sig, current_signature,
+                saved_client.get(TOPOLOGY_KEY) or {})
+        return
+    _verify_multihost_agreement(tag, current_signature)
+    if saved_sig:
+        logger.info(
+            f"lockstep re-verify (tag {tag!r}): resharded resume — "
+            f"signature {saved_sig[:12]} -> {current_signature[:12]} "
+            "(expected to change with the mesh; multihost agreement "
+            "verified)")
+
+
+def _verify_multihost_agreement(tag: str, signature: str) -> None:
+    import jax
+    if jax.process_count() <= 1:
+        return
+    import hashlib
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+    digest = np.frombuffer(
+        hashlib.sha256(signature.encode()).digest()[:8], dtype=np.int64)
+    all_digests = np.asarray(multihost_utils.process_allgather(digest))
+    if not (all_digests == digest.reshape(1, -1)).all():
+        raise LockstepResumeError(tag, "<peer-divergent>", signature, {},
+                                  peer_divergent=True)

@@ -1,0 +1,354 @@
+"""Fleet supervisor — the decide+act half of the self-healing loop.
+
+The OBSERVE side already exists: fleet observability flags stragglers
+with lane attribution (monitor/health.py), heartbeat files name dark
+workers (monitor/heartbeat.py), and the preemption handler turns
+SIGTERM into an emergency checkpoint at the next step boundary
+(preemption.py).  The ACT side was a human.  This module closes the
+loop:
+
+  observe  — structured health events, stale heartbeats, preemption
+             interrupts, worker exit codes
+  decide   — ``SupervisorPolicy``: which workers are dead or evicted,
+             whether surviving capacity still supports a valid world
+             size, when to abort instead of thrash
+  act      — ``plan_resume``: recompute the batch triple for the new
+             world size via elasticity.py and name the checkpoint to
+             resume from; ``FleetSupervisor.run``: drive the
+             kill→shrink→resume→regrow cycle through injectable
+             ``discover_fn``/``launch_fn`` callables — the CPU
+             fault-injection harness in tests, tpu_discovery + dslaunch
+             in production (``dslaunch --elastic``).
+
+The engine enforces the rest of the contract on resume: reshard-on-load
+maps the saved ZeRO/hpZ partitions onto the new mesh and the
+lockstep-signature re-verify aborts a silently-divergent program shape
+before the first post-resume step (resilience/reshard.py).
+
+This module is deliberately jax-free: the launcher imports it on
+controller boxes that never initialize a backend.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ... import constants as C
+from ...elasticity import compute_elastic_config
+from ...utils.logging import logger
+
+# health-event names consumed from monitor/record.py (string-matched so
+# this module stays importable without the monitor package)
+EVENT_STRAGGLER = "straggler"
+EVENT_DIVERGENCE = "divergence"
+# supervisor-native event: a worker whose heartbeat went stale / whose
+# process exited — dead NOW, no strike accumulation
+EVENT_DEAD = "dead_worker"
+
+
+class FleetAbort(RuntimeError):
+    """The supervisor decided training cannot continue (capacity below
+    the floor, cycle budget exhausted, or an unrecoverable verdict)."""
+
+
+@dataclass
+class FleetDecision:
+    action: str                       # "continue" | "reshape" | "abort"
+    drop: Tuple[Any, ...] = ()        # worker ids to exclude
+    reason: str = ""
+
+
+@dataclass
+class ResumePlan:
+    """Everything a relaunch needs: the new world size, the recomputed
+    batch triple, the surviving worker set, and the tag to resume."""
+    world_size: int
+    micro_batch: int
+    gradient_accumulation_steps: int
+    train_batch_size: int
+    load_dir: Optional[str] = None
+    tag: Optional[str] = None
+    workers: Tuple[Any, ...] = ()
+    reason: str = ""
+    cycle: int = 0
+
+    def apply_to_config(self, ds_config: Dict[str, Any]) -> Dict[str, Any]:
+        """A copy of `ds_config` with the batch triple pinned to this
+        plan.  Configs with a live elasticity block are returned
+        unchanged (minus any stale batch keys): the engine re-derives
+        the identical triple from its own world size, which doubles as a
+        consistency check."""
+        cfg = dict(ds_config)
+        elastic = cfg.get(C.ELASTICITY) or {}
+        if elastic.get(C.ENABLED, C.ENABLED_DEFAULT):
+            return cfg
+        cfg[C.TRAIN_BATCH_SIZE] = self.train_batch_size
+        cfg[C.TRAIN_MICRO_BATCH_SIZE_PER_GPU] = self.micro_batch
+        cfg[C.GRADIENT_ACCUMULATION_STEPS] = (
+            self.gradient_accumulation_steps)
+        return cfg
+
+
+@dataclass
+class CycleResult:
+    """What one launch cycle reports back to the supervisor."""
+    status: str                       # "completed" | "interrupted" | "failed"
+    emergency_tag: Optional[str] = None
+    health_events: Tuple[Dict[str, Any], ...] = ()
+    dead_workers: Tuple[Any, ...] = ()
+    error: Optional[str] = None
+    steps_done: int = 0
+
+
+def choose_world_size(valid_sizes: Sequence[int], capacity: int,
+                      minimum: int = 1) -> Optional[int]:
+    """Largest valid world size that fits the surviving capacity (None
+    when nothing in [minimum, capacity] is valid)."""
+    fits = [w for w in valid_sizes if minimum <= w <= capacity]
+    return max(fits) if fits else None
+
+
+def _batch_valid_world_sizes(train_batch: int) -> List[int]:
+    """World sizes a fixed global batch supports: W must divide
+    train_batch (plan_resume solves micro/gas for the chosen W)."""
+    return [w for w in range(1, train_batch + 1) if train_batch % w == 0]
+
+
+def plan_resume(ds_config: Dict[str, Any], capacity: int,
+                load_dir: Optional[str] = None, tag: Optional[str] = None,
+                min_world_size: int = 1,
+                train_batch_size: Optional[int] = None,
+                cycle: int = 0, reason: str = "") -> ResumePlan:
+    """Solve the batch triple for the largest valid world size the
+    surviving capacity supports.
+
+    With an elasticity block, candidate world sizes come from
+    ``compute_elastic_config`` (and the chosen W gets its micro batch
+    from the same solver).  Without one, the GLOBAL batch is held fixed
+    — loss-trajectory parity across the reshape — and W must divide it;
+    the configured gas is kept when it still divides, else gas
+    collapses to 1.  Raises ``FleetAbort`` naming capacity and the
+    valid sizes when nothing fits."""
+    elastic = (ds_config.get(C.ELASTICITY) or {})
+    if elastic.get(C.ENABLED, C.ENABLED_DEFAULT):
+        final_batch, valid = compute_elastic_config(ds_config)[:2]
+        world = choose_world_size(valid, capacity, min_world_size)
+        if world is None:
+            raise FleetAbort(
+                f"no valid elastic world size fits the surviving "
+                f"capacity {capacity} (floor {min_world_size}; valid "
+                f"chip counts: {valid})")
+        _, _, micro = compute_elastic_config(ds_config, world_size=world)
+        gas = final_batch // (micro * world)
+        return ResumePlan(world_size=world, micro_batch=micro,
+                          gradient_accumulation_steps=gas,
+                          train_batch_size=final_batch, load_dir=load_dir,
+                          tag=tag, cycle=cycle, reason=reason)
+
+    gas = int(ds_config.get(C.GRADIENT_ACCUMULATION_STEPS) or 1)
+    train_batch = int(train_batch_size
+                      or ds_config.get(C.TRAIN_BATCH_SIZE) or 0)
+    if train_batch <= 0:
+        raise FleetAbort(
+            "plan_resume needs the global batch to hold fixed across "
+            "the reshape — set train_batch_size in the config, pass "
+            "train_batch_size=, or enable the elasticity block")
+    valid = _batch_valid_world_sizes(train_batch)
+    world = choose_world_size(valid, capacity, min_world_size)
+    if world is None:
+        raise FleetAbort(
+            f"global batch {train_batch} supports world sizes {valid} "
+            f"but surviving capacity is {capacity} "
+            f"(floor {min_world_size})")
+    if train_batch % (gas * world) != 0:
+        gas = 1  # keep the global batch; fold accumulation into micro
+    micro = train_batch // (gas * world)
+    return ResumePlan(world_size=world, micro_batch=micro,
+                      gradient_accumulation_steps=gas,
+                      train_batch_size=train_batch, load_dir=load_dir,
+                      tag=tag, cycle=cycle, reason=reason)
+
+
+class SupervisorPolicy:
+    """Deterministic eviction policy over the observe-side signals.
+
+    * a DEAD signal (stale heartbeat past the threshold, preemption on a
+      worker, nonzero exit) evicts immediately;
+    * a straggler verdict must persist ``straggler_strikes`` CONSECUTIVE
+      observed windows before evicting — one slow window (GC pause,
+      NVMe hiccup) never reshapes the fleet;
+    * divergence is a state problem, not a capacity problem: restart
+      from the last good checkpoint on the same workers;
+    * capacity below ``min_world_size`` aborts rather than thrashes.
+
+    Straggler evictions persist for the supervisor's lifetime (the
+    platform re-offering a host does not clear a slowness verdict);
+    ``readmit`` clears one explicitly.
+    """
+
+    def __init__(self, min_world_size: int = 1,
+                 straggler_strikes: int = 3):
+        self.min_world_size = int(min_world_size)
+        self.straggler_strikes = int(straggler_strikes)
+        self.evicted: set = set()
+        self._strikes: Dict[Any, int] = {}
+        self._pending_dead: set = set()
+        self._divergence: Optional[str] = None
+
+    # -- observe ------------------------------------------------------- #
+    def observe_window(self, events: Sequence[Dict[str, Any]]) -> None:
+        """One fleet window's health events.  Stragglers flagged this
+        window gain a strike; processes NOT flagged reset (the verdict
+        must be persistent, not cumulative)."""
+        flagged = set()
+        for ev in events:
+            kind = ev.get("event")
+            worker = ev.get("process_index", ev.get("host"))
+            if kind == EVENT_STRAGGLER and worker is not None:
+                flagged.add(worker)
+            elif kind == EVENT_DEAD and worker is not None:
+                self._pending_dead.add(worker)
+            elif kind == EVENT_DIVERGENCE:
+                self._divergence = ev.get("detail") or "replica divergence"
+        for worker in list(self._strikes):
+            if worker not in flagged:
+                self._strikes.pop(worker)
+        for worker in flagged:
+            self._strikes[worker] = self._strikes.get(worker, 0) + 1
+
+    def observe_stale_heartbeats(self, beats: Sequence[Dict[str, Any]]
+                                 ) -> None:
+        """annotate_stale output (monitor/heartbeat.py): a RUNNING
+        worker whose file stopped moving is presumed dark."""
+        for hb in beats:
+            if hb.get("stale") and hb.get("process_index") is not None:
+                self._pending_dead.add(hb["process_index"])
+
+    def observe_dead(self, worker: Any) -> None:
+        self._pending_dead.add(worker)
+
+    def readmit(self, worker: Any) -> None:
+        self.evicted.discard(worker)
+        self._strikes.pop(worker, None)
+        self._pending_dead.discard(worker)
+
+    # -- decide -------------------------------------------------------- #
+    def decide(self, world_size: int) -> FleetDecision:
+        drop = set(self._pending_dead)
+        reasons = [f"dead worker {w}" for w in sorted(drop, key=str)]
+        for worker, strikes in sorted(self._strikes.items(), key=str):
+            if strikes >= self.straggler_strikes and worker not in drop:
+                drop.add(worker)
+                reasons.append(
+                    f"persistent straggler {w_label(worker)} "
+                    f"({strikes} consecutive windows)")
+        self._pending_dead.clear()
+        for worker in drop:
+            self.evicted.add(worker)
+            self._strikes.pop(worker, None)
+        if drop:
+            survivors = world_size - len(drop)
+            if survivors < self.min_world_size:
+                return FleetDecision(
+                    "abort", tuple(sorted(drop, key=str)),
+                    f"capacity after dropping {sorted(drop, key=str)} "
+                    f"is {survivors} < min_world_size="
+                    f"{self.min_world_size}")
+            return FleetDecision("reshape", tuple(sorted(drop, key=str)),
+                                 "; ".join(reasons))
+        if self._divergence is not None:
+            reason = self._divergence
+            self._divergence = None
+            return FleetDecision(
+                "reshape", (),
+                f"replica divergence — restart every worker from the "
+                f"last good checkpoint ({reason})")
+        return FleetDecision("continue", (), "fleet healthy")
+
+
+def w_label(worker: Any) -> str:
+    return f"p{worker}" if isinstance(worker, int) else str(worker)
+
+
+class FleetSupervisor:
+    """Drives kill→shrink→resume→regrow cycles.
+
+    ``discover_fn() -> Sequence[worker]`` is the platform's CURRENT
+    capacity view (tpu_discovery on a pod; a schedule in tests) — a
+    preempted worker vanishes from it, a replacement reappears, which
+    is what makes regrow automatic.  ``launch_fn(plan) -> CycleResult``
+    builds/loads/trains on the plan's mesh and reports how the cycle
+    ended.  The supervisor evicts on the policy's verdicts, re-solves
+    the batch triple for every reshape, and resumes from the newest
+    known-good tag (the emergency tag when the cycle saved one, else
+    ``latest``)."""
+
+    def __init__(self, ds_config: Dict[str, Any], save_dir: str,
+                 discover_fn: Callable[[], Sequence[Any]],
+                 launch_fn: Callable[[ResumePlan], CycleResult],
+                 policy: Optional[SupervisorPolicy] = None,
+                 max_cycles: int = 8,
+                 train_batch_size: Optional[int] = None,
+                 resume_tag: Optional[str] = None):
+        self.ds_config = dict(ds_config)
+        self.save_dir = save_dir
+        self.discover_fn = discover_fn
+        self.launch_fn = launch_fn
+        self.policy = policy or SupervisorPolicy()
+        self.max_cycles = int(max_cycles)
+        self.train_batch_size = train_batch_size
+        self.resume_tag = resume_tag
+        self.history: List[Tuple[ResumePlan, CycleResult]] = []
+
+    def run(self) -> Dict[str, Any]:
+        tag = self.resume_tag
+        first = not self.history and tag is None
+        for cycle in range(self.max_cycles):
+            available = list(self.discover_fn())
+            healthy = [w for w in available
+                       if w not in self.policy.evicted]
+            plan = plan_resume(
+                self.ds_config, len(healthy),
+                load_dir=(None if first else self.save_dir), tag=tag,
+                min_world_size=self.policy.min_world_size,
+                train_batch_size=self.train_batch_size, cycle=cycle,
+                reason=("initial launch" if first else
+                        f"resume cycle {cycle}"))
+            plan.workers = tuple(healthy[:plan.world_size])
+            logger.warning(
+                f"fleet supervisor cycle {cycle}: W={plan.world_size} "
+                f"micro={plan.micro_batch} "
+                f"gas={plan.gradient_accumulation_steps} "
+                f"workers={list(plan.workers)} tag={plan.tag!r} "
+                f"({plan.reason})")
+            result = self.launch_fn(plan)
+            self.history.append((plan, result))
+            first = False
+            if result.status == "completed":
+                return self.summary("completed")
+            self.policy.observe_window(result.health_events)
+            for worker in result.dead_workers:
+                self.policy.observe_dead(worker)
+            decision = self.policy.decide(plan.world_size)
+            if decision.action == "abort":
+                raise FleetAbort(
+                    f"fleet supervisor aborting after cycle {cycle}: "
+                    f"{decision.reason}")
+            logger.warning(
+                f"fleet supervisor decision after cycle {cycle}: "
+                f"{decision.action} drop={list(decision.drop)} — "
+                f"{decision.reason}")
+            tag = result.emergency_tag  # None → resume from `latest`
+        raise FleetAbort(
+            f"fleet supervisor exhausted max_cycles={self.max_cycles} "
+            f"without completing; world-size history: "
+            f"{[p.world_size for p, _ in self.history]}")
+
+    def summary(self, status: str) -> Dict[str, Any]:
+        return {
+            "status": status,
+            "cycles": len(self.history),
+            "world_sizes": [p.world_size for p, _ in self.history],
+            "tags": [p.tag for p, _ in self.history],
+            "evicted": sorted(self.policy.evicted, key=str),
+            "steps_done": sum(r.steps_done for _, r in self.history),
+        }

@@ -17,13 +17,13 @@ replicated, mirroring stage3's persistence threshold
 """
 
 import math
-from typing import Any, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
-from ...parallel.mesh import MeshContext, ZERO_AXES
+from ...parallel.mesh import MESH_AXES, MeshContext, ZERO_AXES
 
 
 def zero_partition_spec(shape: Tuple[int, ...], axis_sizes: dict,
@@ -121,6 +121,52 @@ def resolve_hpz_axes(axis_sizes: dict, group_size: int) -> Tuple[str, ...]:
 
 def _leaf_shape(leaf) -> Tuple[int, ...]:
     return tuple(getattr(leaf, "shape", ()) or ())
+
+
+# ---------------------------------------------------------------------- #
+# partition topology: the saved-vs-requested contract behind
+# mesh-shape-portable checkpoints (runtime/resilience/reshard.py)
+# ---------------------------------------------------------------------- #
+def topology_reshard_problems(saved: Dict[str, Any],
+                              current: Dict[str, Any]) -> List[str]:
+    """Problems mapping a partition topology saved at one mesh shape onto
+    the current one ([] = reshardable).
+
+    ZeRO resharding is well-defined only along the ZeRO (data/expert)
+    axes: every stored value is keyed by its GLOBAL slice, so a dp
+    resize is pure re-slicing.  The non-ZeRO axes (pipe/seq/model)
+    change WHICH values a leaf's dimensions hold (tensor-parallel
+    layouts, pipeline stage ownership) — a checkpoint saved there is a
+    different program family, not a resize, and loading it silently
+    would scramble weights.  The zero stage may legitimately differ
+    (stored data is stage-agnostic full values); callers log that."""
+    problems: List[str] = []
+    saved_mesh = dict(saved.get("mesh") or {})
+    cur_mesh = dict(current.get("mesh") or {})
+    for axis in MESH_AXES:
+        if axis in ZERO_AXES:
+            continue
+        s = int(saved_mesh.get(axis, 1))
+        c = int(cur_mesh.get(axis, 1))
+        if s != c:
+            problems.append(
+                f"mesh axis {axis!r} resized {s} -> {c} — only the ZeRO "
+                f"axes {ZERO_AXES} are reshape-portable (a non-ZeRO axis "
+                "resize changes which values each shard holds)")
+    return problems
+
+
+def topologies_equal(saved: Dict[str, Any], current: Dict[str, Any]) -> bool:
+    """True when the saved partition topology matches the current one in
+    every field that shapes the step program's collective schedule (mesh
+    axis sizes, zero stage, hpZ group) — the precondition for the strict
+    lockstep-signature compare on resume."""
+    def key(t):
+        mesh = {a: int((t.get("mesh") or {}).get(a, 1)) for a in MESH_AXES}
+        return (tuple(sorted(mesh.items())),
+                int(t.get("zero_stage") or 0),
+                int(t.get("hpz_group_size") or 0))
+    return key(saved) == key(current)
 
 
 class ZeroPartitioner:
@@ -251,6 +297,20 @@ class ZeroPartitioner:
         even "persistent" (always-gathered) params keep sharded Adam moments,
         like the reference keeps fp32 optimizer shards for every param."""
         return zero_partition_spec(shape, self.axis_sizes, 0, existing)
+
+    # -- partition topology ------------------------------------------- #
+    def topology(self, hpz_group_size: int = 0) -> Dict[str, Any]:
+        """The partition-topology descriptor a checkpoint records so a
+        later load at a DIFFERENT world size can decide — loudly —
+        whether a reshard is well-defined (topology_reshard_problems)."""
+        return {
+            "mesh": {a: int(self.ctx.axis_size(a)) for a in MESH_AXES},
+            "world_size": int(self.ctx.world_size),
+            "zero_stage": int(self.stage),
+            "zero_world_size": int(self.zero_size),
+            "hpz_group_size": int(hpz_group_size or 0),
+            "persistence_threshold": int(self.persistence_threshold),
+        }
 
     # -- memory estimation -------------------------------------------- #
     def estimate_memory(self, params: Any, bytes_per_param: int = 4,

@@ -1,0 +1,647 @@
+"""Self-healing elastic fleet (ISSUE 11): mesh-shape-portable
+checkpoints, the kill→shrink→resume→regrow supervisor cycle, the
+lockstep-signature re-verify on resume, the preemption grace deadline,
+and the launcher liveness gate — all CPU-only via the fault-injection
+harness (request_stop, rigged slow steps, poisoned heartbeat files).
+
+Acceptance (ISSUE 11): a checkpoint saved at W loads and trains at W-ish
+and back at W with loss parity vs uninterrupted training; reshard
+round-trips ZeRO-1/2/3 + hpZ bitwise across two (W, W') pairs; a
+topology-ambiguous or signature-mismatched load fails loudly.  Fast
+lane.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.runtime.resilience import supervisor as sup
+from deepspeed_tpu.runtime.resilience.preemption import TrainingInterrupted
+from deepspeed_tpu.runtime.resilience.reshard import (LockstepResumeError,
+                                                      ReshardError,
+                                                      SIGNATURE_KEY,
+                                                      TOPOLOGY_KEY)
+from tests.unit.simple_model import (base_engine_config, random_dataset,
+                                     simple_model_apply, simple_model_params)
+
+HIDDEN = 16
+GLOBAL_BATCH = 8
+TOTAL_STEPS = 9
+
+
+def _mesh(n=None, **axes):
+    ds.reset_mesh_context()
+    devices = jax.devices() if n is None else jax.devices()[:n]
+    return ds.initialize_mesh(**(axes or {"data": -1}), devices=devices)
+
+
+def _batches(nsteps, seed=12):
+    """One fixed global batch per step — every world size consumes the
+    IDENTICAL sample sequence, so loss parity across reshapes is exact
+    up to reduction order."""
+    data = random_dataset(nsteps * GLOBAL_BATCH, HIDDEN, seed=seed)
+    out = []
+    for i in range(nsteps):
+        chunk = data[i * GLOBAL_BATCH:(i + 1) * GLOBAL_BATCH]
+        out.append((np.stack([x for x, _ in chunk]),
+                    np.stack([y for _, y in chunk])))
+    return out
+
+
+def make_engine(n_devices, micro_batch, gas=1, stage=2, res_extra=None,
+                **overrides):
+    mesh = _mesh(n_devices)
+    cfg = base_engine_config(
+        micro_batch=micro_batch, gas=gas,
+        **{"zero_optimization": {"stage": stage},
+           "checkpoint": {"sharded": True},
+           "resilience": dict({"enabled": True}, **(res_extra or {})),
+           **overrides})
+    engine, _, _, _ = ds.initialize(
+        model=simple_model_apply, config=cfg,
+        model_parameters=simple_model_params(HIDDEN), mesh=mesh)
+    return engine
+
+
+def np_tree(tree):
+    return jax.tree.map(np.asarray, tree)
+
+
+def assert_tree_equal(a, b):
+    jax.tree.map(np.testing.assert_array_equal, a, b)
+
+
+# --------------------------------------------------------------------- #
+# reshard-on-load round-trips: ZeRO 1/2/3 across two (W, W') pairs
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("stage", [1, 2, 3])
+@pytest.mark.parametrize("w_pair", [(8, 4), (4, 2)])
+def test_reshard_roundtrip_bitwise(stage, w_pair, tmp_path):
+    """Save at W, load at W' (bitwise), save from W', load back at W
+    (bitwise) — params AND optimizer state, per zero stage."""
+    w, w_prime = w_pair
+    batches = _batches(2)
+    e = make_engine(w, GLOBAL_BATCH // w, stage=stage)
+    for x, y in batches:
+        e.backward(e.forward(x, y))
+        e.step()
+    e.save_checkpoint(str(tmp_path), tag="t0")
+    ref_p, ref_o = np_tree(e.params), np_tree(e.opt_state)
+
+    e2 = make_engine(w_prime, GLOBAL_BATCH // w_prime, stage=stage)
+    e2.load_checkpoint(str(tmp_path), tag="t0")
+    assert e2.global_steps == 2
+    assert_tree_equal(ref_p, np_tree(e2.params))
+    assert_tree_equal(ref_o, np_tree(e2.opt_state))
+    e2.save_checkpoint(str(tmp_path), tag="t1")
+
+    e3 = make_engine(w, GLOBAL_BATCH // w, stage=stage)
+    e3.load_checkpoint(str(tmp_path), tag="t1")
+    assert_tree_equal(ref_p, np_tree(e3.params))
+    assert_tree_equal(ref_o, np_tree(e3.opt_state))
+    ds.reset_mesh_context()
+
+
+def _gpt2_hpz_engine(data, expert, tmp_path=None):
+    from deepspeed_tpu.models import GPT2Config, GPT2Model
+    ds.reset_mesh_context()
+    mesh = ds.initialize_mesh(data=data, expert=expert,
+                              devices=jax.devices()[:data * expert])
+    cfg = GPT2Config(vocab_size=64, n_positions=16, hidden_size=32,
+                     num_layers=2, num_heads=4, bf16=False,
+                     embd_dropout=0.0, attn_dropout=0.0,
+                     hidden_dropout=0.0)
+    model = GPT2Model(cfg)
+    engine, _, _, _ = ds.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {
+                    "stage": 3, "stage3_param_persistence_threshold": 0,
+                    "stage3_max_live_parameters": 1,
+                    "stage3_prefetch_bucket_size": 0,
+                    "low_bandwidth": {"hpz_group_size": 2}},
+                "checkpoint": {"sharded": True},
+                "resilience": {"enabled": True},
+                "steps_per_print": 10 ** 9},
+        model_parameters=model.init_params(jax.random.PRNGKey(0)),
+        mesh=mesh, rng=jax.random.PRNGKey(7))
+    return engine
+
+
+@pytest.mark.parametrize("shrink", [(2, 1), (1, 2)],
+                         ids=["data2x2_to_1x2", "back_1x2_to_2x2"])
+def test_reshard_roundtrip_hpz(shrink, tmp_path):
+    """hpZ (secondary partition on the inner expert axis) survives a
+    data-axis resize bitwise in BOTH directions: (data=2,expert=2) <->
+    (data=1,expert=2) — the hpz group stays a valid inner suffix on
+    both meshes, which is exactly the Frontier low-bandwidth scenario's
+    surviving-worker constraint."""
+    d_save, d_load = shrink
+    ids = np.asarray(jax.random.randint(jax.random.PRNGKey(1), (4, 16),
+                                        0, 64), np.int32)
+    e = _gpt2_hpz_engine(d_save, 2)
+    e.backward(e.forward(ids))
+    e.step()
+    e.save_checkpoint(str(tmp_path), tag="h0")
+    ref_p, ref_o = np_tree(e.params), np_tree(e.opt_state)
+
+    e2 = _gpt2_hpz_engine(d_load, 2)
+    e2.load_checkpoint(str(tmp_path), tag="h0")
+    assert_tree_equal(ref_p, np_tree(e2.params))
+    assert_tree_equal(ref_o, np_tree(e2.opt_state))
+    ds.reset_mesh_context()
+
+
+# --------------------------------------------------------------------- #
+# fail-loudly: topology ambiguity + non-ZeRO-axis resize + lockstep drift
+# --------------------------------------------------------------------- #
+def test_topology_ambiguous_load_fails_loudly(tmp_path):
+    """A tag with NO recorded topology (pre-portability checkpoint)
+    loading across a world-size change must refuse, naming the tag —
+    the saved partition layout is ambiguous."""
+    e = make_engine(4, 2)
+    x, y = _batches(1)[0]
+    e.backward(e.forward(x, y))
+    e.step()
+    e.save_checkpoint(str(tmp_path), tag="legacy")
+    # simulate a pre-PR checkpoint: strip the topology record (and
+    # re-manifest so the CRC verify still passes)
+    meta_path = tmp_path / "legacy" / "ds_meta.json"
+    meta = json.loads(meta_path.read_text())
+    del meta["client_state"][TOPOLOGY_KEY]
+    meta["client_state"].pop(SIGNATURE_KEY, None)
+    meta_path.write_text(json.dumps(meta))
+    from deepspeed_tpu.runtime.resilience.atomic import write_manifest
+    write_manifest(str(tmp_path / "legacy"))
+
+    e2 = make_engine(2, 4)
+    with pytest.raises(ReshardError) as ei:
+        e2.load_checkpoint(str(tmp_path), tag="legacy")
+    msg = str(ei.value)
+    assert "'legacy'" in msg and "no partition_topology" in msg
+    assert "saved topology" in msg and "requested topology" in msg
+    # same world size stays loadable (nothing ambiguous to resolve)
+    e3 = make_engine(4, 2)
+    e3.load_checkpoint(str(tmp_path), tag="legacy")
+    ds.reset_mesh_context()
+
+
+def test_non_zero_axis_resize_rejected(tmp_path):
+    """model-parallel resize is NOT a ZeRO reshard: the topology check
+    names the offending axis and both topologies."""
+    ds.reset_mesh_context()
+    mesh = ds.initialize_mesh(data=2, model=2, devices=jax.devices()[:4])
+    cfg = base_engine_config(
+        micro_batch=4, gas=1,
+        **{"checkpoint": {"sharded": True}, "resilience": {"enabled": True}})
+    e, _, _, _ = ds.initialize(model=simple_model_apply, config=cfg,
+                               model_parameters=simple_model_params(HIDDEN),
+                               mesh=mesh)
+    x, y = _batches(1)[0]
+    e.backward(e.forward(x, y))
+    e.step()
+    e.save_checkpoint(str(tmp_path), tag="mp2")
+
+    e2 = make_engine(4, 2)  # model=1 now
+    with pytest.raises(ReshardError) as ei:
+        e2.load_checkpoint(str(tmp_path), tag="mp2")
+    msg = str(ei.value)
+    assert "'model'" in msg and "2 -> 1" in msg and "'mp2'" in msg
+    ds.reset_mesh_context()
+
+
+def test_consolidated_layout_portable_across_model_resize(tmp_path):
+    """The consolidated (.npz) layout stores full unsharded leaves —
+    mesh-independent, so even a model-parallel resize loads (the
+    non-ZeRO-axis rejection applies to the SHARDED layout only)."""
+    ds.reset_mesh_context()
+    mesh = ds.initialize_mesh(data=2, model=2, devices=jax.devices()[:4])
+    cfg = base_engine_config(
+        micro_batch=4, gas=1,
+        **{"checkpoint": {"sharded": False},
+           "resilience": {"enabled": True}})
+    e, _, _, _ = ds.initialize(model=simple_model_apply, config=cfg,
+                               model_parameters=simple_model_params(HIDDEN),
+                               mesh=mesh)
+    x, y = _batches(1)[0]
+    e.backward(e.forward(x, y))
+    e.step()
+    e.save_checkpoint(str(tmp_path), tag="mp2c")
+    ref = np_tree(e.params)
+
+    ds.reset_mesh_context()
+    mesh2 = ds.initialize_mesh(data=4, devices=jax.devices()[:4])
+    cfg2 = base_engine_config(
+        micro_batch=2, gas=1,
+        **{"checkpoint": {"sharded": False},
+           "resilience": {"enabled": True}})
+    e2, _, _, _ = ds.initialize(model=simple_model_apply, config=cfg2,
+                                model_parameters=simple_model_params(HIDDEN),
+                                mesh=mesh2)
+    e2.load_checkpoint(str(tmp_path), tag="mp2c")  # model 2 -> 1: OK
+    assert_tree_equal(ref, np_tree(e2.params))
+    ds.reset_mesh_context()
+
+
+_Z3_STREAM = {"stage": 3, "stage3_param_persistence_threshold": 0,
+              "stage3_max_live_parameters": 1,
+              "stage3_prefetch_bucket_size": 0}
+
+
+def _gpt2_stream_engine(zero_cfg, n=4):
+    from deepspeed_tpu.models import GPT2Config, GPT2Model
+    ds.reset_mesh_context()
+    mesh = ds.initialize_mesh(data=-1, devices=jax.devices()[:n])
+    cfg = GPT2Config(vocab_size=64, n_positions=16, hidden_size=32,
+                     num_layers=2, num_heads=4, bf16=False,
+                     embd_dropout=0.0, attn_dropout=0.0,
+                     hidden_dropout=0.0)
+    model = GPT2Model(cfg)
+    engine, _, _, _ = ds.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": zero_cfg,
+                "checkpoint": {"sharded": True},
+                "resilience": {"enabled": True},
+                "steps_per_print": 10 ** 9},
+        model_parameters=model.init_params(jax.random.PRNGKey(0)),
+        mesh=mesh, rng=jax.random.PRNGKey(7))
+    return engine
+
+
+def test_lockstep_reverify_rejects_config_drift_on_resume(tmp_path):
+    """Same topology, drifted config (qwZ flipped on): the resumed
+    program traces a DIFFERENT collective schedule — the re-verify
+    aborts before the first post-resume step, naming tag + signatures.
+    The identical config resumes cleanly."""
+    ids = np.asarray(jax.random.randint(jax.random.PRNGKey(1), (4, 16),
+                                        0, 64), np.int32)
+    e = _gpt2_stream_engine(dict(_Z3_STREAM))
+    e.backward(e.forward(ids))
+    e.step()
+    e.save_checkpoint(str(tmp_path), tag="s0")
+
+    drifted = _gpt2_stream_engine(
+        dict(_Z3_STREAM, low_bandwidth={"qwz_bits": 8}))
+    with pytest.raises(LockstepResumeError) as ei:
+        drifted.load_checkpoint(str(tmp_path), tag="s0")
+    msg = str(ei.value)
+    assert "'s0'" in msg and "saved signature" in msg
+    assert "unchanged topology" in msg
+
+    same = _gpt2_stream_engine(dict(_Z3_STREAM))
+    same.load_checkpoint(str(tmp_path), tag="s0")
+    assert same.global_steps == 1
+    ds.reset_mesh_context()
+
+
+# --------------------------------------------------------------------- #
+# preemption grace deadline (satellite): rigged slow step
+# --------------------------------------------------------------------- #
+def _grace_engine(tmp_path, grace_s):
+    return make_engine(
+        4, 2, res_extra={
+            "atomic_checkpoints": True,
+            "preemption": {"enabled": True, "reraise": False,
+                           "grace_s": grace_s,
+                           "save_dir": str(tmp_path)}})
+
+
+def test_grace_deadline_forces_last_completed_step_save(tmp_path):
+    """Signal lands, the step wedges (rigged: the loop simply never
+    reaches another boundary): after grace_s the timer thread saves the
+    LAST COMPLETED step under the _forced tag; the eventual boundary
+    finalizes with that tag instead of double-saving."""
+    e = _grace_engine(tmp_path, grace_s=0.15)
+    batches = _batches(3)
+    for x, y in batches[:2]:
+        e.backward(e.forward(x, y))
+        e.step()
+    e._preemption.request_stop()
+    deadline = time.monotonic() + 5.0
+    while (e._preemption.forced_tag is None
+           and time.monotonic() < deadline):
+        time.sleep(0.05)  # the rigged slow step: no boundary reached
+    assert e._preemption.deadline_fired
+    forced = e._preemption.forced_tag
+    assert forced == "emergency_step2_forced"
+    assert os.path.isdir(tmp_path / forced)
+    # manifest is intact — the forced save used the atomic protocol
+    from deepspeed_tpu.runtime.resilience.atomic import verify_manifest
+    assert verify_manifest(str(tmp_path / forced)) == []
+
+    # the loop limps to one more boundary: finalize carries the forced
+    # tag; the normal per-boundary tag is NOT saved again
+    x, y = batches[2]
+    with pytest.raises(TrainingInterrupted) as ei:
+        e.backward(e.forward(x, y))
+        e.step()
+    assert ei.value.emergency_tag == forced
+    assert not os.path.isdir(tmp_path / "emergency_step3")
+
+    # the forced tag resumes: last completed step was 2
+    e2 = make_engine(4, 2)
+    e2.load_checkpoint(str(tmp_path), tag=forced)
+    assert e2.global_steps == 2
+    ds.reset_mesh_context()
+
+
+def test_grace_deadline_cancelled_at_boundary(tmp_path):
+    """A healthy loop (boundary inside the grace window) never sees the
+    forced path: normal emergency tag, timer disarmed."""
+    e = _grace_engine(tmp_path, grace_s=30.0)
+    batches = _batches(2)
+    x, y = batches[0]
+    e.backward(e.forward(x, y))
+    e.step()
+    e._preemption.request_stop()
+    x, y = batches[1]
+    with pytest.raises(TrainingInterrupted) as ei:
+        e.backward(e.forward(x, y))
+        e.step()
+    assert not e._preemption.deadline_fired
+    assert e._preemption._deadline_timer is None  # disarmed, not leaked
+    assert ei.value.emergency_tag == "emergency_step2"
+    assert os.path.isdir(tmp_path / "emergency_step2")
+    ds.reset_mesh_context()
+
+
+def test_boundary_waits_for_inflight_forced_save():
+    """Race regression: the boundary reached WHILE the deadline callback
+    is still saving must wait for its forced_tag instead of reading None
+    and double-saving the same step."""
+    import threading
+
+    from deepspeed_tpu.runtime.resilience.preemption import PreemptionHandler
+    started, release = threading.Event(), threading.Event()
+
+    def on_deadline():
+        started.set()
+        release.wait(5)
+        return "tag_forced"
+
+    h = PreemptionHandler(grace_s=0.01, on_deadline=on_deadline)
+    h.request_stop()
+    assert started.wait(5)           # timer fired, callback mid-save
+    boundary = threading.Thread(target=h.boundary_reached)
+    boundary.start()
+    time.sleep(0.1)
+    assert boundary.is_alive()       # boundary waits out the callback
+    assert h.forced_tag is None
+    release.set()
+    boundary.join(5)
+    assert not boundary.is_alive()
+    assert h.forced_tag == "tag_forced"
+
+
+# --------------------------------------------------------------------- #
+# supervisor policy + planning units
+# --------------------------------------------------------------------- #
+def test_policy_straggler_needs_consecutive_strikes():
+    pol = sup.SupervisorPolicy(min_world_size=1, straggler_strikes=3)
+    ev = {"event": "straggler", "process_index": 2, "lane": "compute"}
+    pol.observe_window([ev])
+    pol.observe_window([ev])
+    assert pol.decide(4).action == "continue"
+    # a clean window resets the streak — one-off slowness never evicts
+    pol.observe_window([])
+    pol.observe_window([ev])
+    pol.observe_window([ev])
+    assert pol.decide(4).action == "continue"
+    pol.observe_window([ev])
+    d = pol.decide(4)
+    assert d.action == "reshape" and d.drop == (2,)
+    assert "straggler" in d.reason and 2 in pol.evicted
+
+
+def test_policy_stale_heartbeat_and_floor():
+    pol = sup.SupervisorPolicy(min_world_size=2)
+    pol.observe_stale_heartbeats([
+        {"process_index": 0, "stale": False},
+        {"process_index": 3, "stale": True}])
+    d = pol.decide(4)
+    assert d.action == "reshape" and d.drop == (3,)
+    # dropping below the floor aborts instead of thrashing
+    pol2 = sup.SupervisorPolicy(min_world_size=2)
+    pol2.observe_dead(0)
+    assert pol2.decide(2).action == "abort"
+
+
+def test_policy_divergence_restarts_same_workers():
+    pol = sup.SupervisorPolicy()
+    pol.observe_window([{"event": "divergence", "detail": "loss spread"}])
+    d = pol.decide(4)
+    assert d.action == "reshape" and d.drop == ()
+    assert "divergence" in d.reason
+
+
+def test_plan_resume_fixed_global_batch():
+    cfg = {"train_micro_batch_size_per_gpu": 2,
+           "gradient_accumulation_steps": 1}
+    plan = sup.plan_resume(cfg, capacity=3, train_batch_size=8)
+    assert (plan.world_size, plan.micro_batch,
+            plan.gradient_accumulation_steps) == (2, 4, 1)
+    # gas preserved when it still divides
+    plan = sup.plan_resume({"gradient_accumulation_steps": 2}, capacity=4,
+                           train_batch_size=16)
+    assert (plan.world_size, plan.micro_batch,
+            plan.gradient_accumulation_steps) == (4, 2, 2)
+    with pytest.raises(sup.FleetAbort):
+        sup.plan_resume(cfg, capacity=0, train_batch_size=8)
+
+
+def test_plan_resume_elastic_block():
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 32,
+                          "micro_batch_sizes": [1, 2, 4],
+                          "min_gpus": 1, "max_gpus": 8, "version": 0.1}}
+    plan = sup.plan_resume(cfg, capacity=5)
+    assert plan.world_size == 4
+    assert (plan.micro_batch * plan.gradient_accumulation_steps
+            * plan.world_size == plan.train_batch_size)
+    # apply_to_config leaves elastic configs to the engine's own solve
+    assert "train_batch_size" not in plan.apply_to_config(cfg)
+    non_elastic = sup.plan_resume({}, capacity=4, train_batch_size=8)
+    assert non_elastic.apply_to_config({})["train_batch_size"] == 8
+
+
+# --------------------------------------------------------------------- #
+# THE acceptance sweep: kill → shrink(W→W') → resume → regrow(→W),
+# loss parity vs an uninterrupted run
+# --------------------------------------------------------------------- #
+def test_kill_shrink_resume_regrow_loss_parity(tmp_path):
+    batches = _batches(TOTAL_STEPS)
+
+    # ---- uninterrupted baseline at W=4 --------------------------- #
+    base = make_engine(4, 2)
+    base_losses = []
+    for x, y in batches:
+        loss = base.forward(x, y)
+        base.backward(loss)
+        base.step()
+        base_losses.append(float(loss))
+    base_params = np_tree(base.params)
+
+    # ---- elastic run: cycle 0 killed at step 5, shrink to W=2,
+    #      capacity returns, regrow to W=4 ------------------------- #
+    save_dir = str(tmp_path / "elastic")
+    # worker 3 dies in cycle 0; a REPLACEMENT (id 4) joins by cycle 2 —
+    # regrow is new capacity appearing in discovery, not the dead worker
+    # un-dying (its eviction is permanent for this supervisor)
+    schedule = [[0, 1, 2, 3], [0, 1, 2], [0, 1, 2, 4]]
+    calls = {"n": 0}
+
+    def discover():
+        i = min(calls["n"], len(schedule) - 1)
+        calls["n"] += 1
+        return schedule[i]
+
+    elastic_losses = {}
+
+    def launch(plan):
+        cfg = base_engine_config(
+            micro_batch=plan.micro_batch,
+            gas=plan.gradient_accumulation_steps,
+            **{"zero_optimization": {"stage": 2},
+               "checkpoint": {"sharded": True},
+               "resilience": {
+                   "enabled": True,
+                   "preemption": {"enabled": True, "reraise": False,
+                                  "save_dir": save_dir}}})
+        mesh = _mesh(plan.world_size)
+        engine, _, _, _ = ds.initialize(
+            model=simple_model_apply, config=cfg,
+            model_parameters=simple_model_params(HIDDEN), mesh=mesh)
+        try:
+            if plan.load_dir is not None:
+                engine.load_checkpoint(plan.load_dir, tag=plan.tag)
+            start = engine.global_steps
+            while engine.global_steps < TOTAL_STEPS:
+                i = engine.global_steps
+                x, y = batches[i]
+                loss = engine.forward(x, y)
+                engine.backward(loss)
+                # recorded pre-step: the kill's TrainingInterrupted
+                # fires INSIDE step()'s boundary check, after the
+                # update applied — the step is completed, not lost
+                elastic_losses[i] = float(loss)
+                engine.step()
+                if plan.cycle == 0 and engine.global_steps == 4:
+                    # the kill: worker 3 preempted mid-run — emergency
+                    # save fires at the NEXT step boundary
+                    engine._preemption.request_stop()
+                if (plan.cycle == 1
+                        and engine.global_steps - start >= 2):
+                    # replacement capacity arrived: checkpoint and hand
+                    # control back so the supervisor can regrow
+                    engine.save_checkpoint(save_dir)
+                    return sup.CycleResult(
+                        "interrupted",
+                        steps_done=engine.global_steps - start)
+            return sup.CycleResult(
+                "completed", steps_done=engine.global_steps - start)
+        except TrainingInterrupted as ti:
+            return sup.CycleResult(
+                "interrupted", emergency_tag=ti.emergency_tag,
+                dead_workers=(3,),
+                steps_done=engine.global_steps)
+        finally:
+            if engine._preemption is not None:
+                engine._preemption.uninstall()
+
+    fleet = sup.FleetSupervisor(
+        {"train_micro_batch_size_per_gpu": 2}, save_dir,
+        discover_fn=discover, launch_fn=launch,
+        policy=sup.SupervisorPolicy(min_world_size=1),
+        max_cycles=5, train_batch_size=GLOBAL_BATCH)
+    summary = fleet.run()
+
+    assert summary["status"] == "completed"
+    assert summary["world_sizes"] == [4, 2, 4]  # kill→shrink→regrow
+    assert 3 in summary["evicted"]
+    # the shrink cycle resumed from the emergency tag the kill produced
+    assert fleet.history[1][0].tag == "emergency_step5"
+    # every step of the elastic run matches the uninterrupted baseline
+    assert sorted(elastic_losses) == list(range(TOTAL_STEPS))
+    for i, ref in enumerate(base_losses):
+        assert elastic_losses[i] == pytest.approx(ref, rel=1e-4), (
+            i, elastic_losses[i], ref)
+    # final params parity: reload the last checkpointed state at W=4
+    ds.reset_mesh_context()
+    verify = make_engine(4, 2)
+    # the completed cycle never saved after its last step — compare the
+    # baseline against a fresh W=4 resume of `latest` plus a replay of
+    # the remaining steps
+    verify.load_checkpoint(save_dir)
+    for i in range(verify.global_steps, TOTAL_STEPS):
+        x, y = batches[i]
+        verify.backward(verify.forward(x, y))
+        verify.step()
+    for a, b in zip(jax.tree.leaves(base_params),
+                    jax.tree.leaves(np_tree(verify.params))):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+    ds.reset_mesh_context()
+
+
+def test_supervisor_abort_on_capacity_floor():
+    def discover():
+        return [0]
+
+    def launch(plan):
+        return sup.CycleResult("failed", dead_workers=(0,))
+
+    fleet = sup.FleetSupervisor(
+        {}, "/tmp/nowhere", discover_fn=discover, launch_fn=launch,
+        policy=sup.SupervisorPolicy(min_world_size=1),
+        max_cycles=3, train_batch_size=8)
+    with pytest.raises(sup.FleetAbort):
+        fleet.run()
+
+
+# --------------------------------------------------------------------- #
+# launcher liveness gate (satellite): --watch_fail_after
+# --------------------------------------------------------------------- #
+def test_watch_fail_after_exits_nonzero_naming_worker(tmp_path, caplog):
+    from deepspeed_tpu.launcher.runner import (WATCH_FAIL_RC,
+                                               launch_and_collect)
+    from deepspeed_tpu.monitor.heartbeat import (HEARTBEAT_DIR,
+                                                 HeartbeatWriter,
+                                                 heartbeat_path)
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+    hb_dir = os.path.join(str(tmp_path), HEARTBEAT_DIR)
+    HeartbeatWriter(hb_dir, 0, 2, host="h0").beat(step=5)
+    HeartbeatWriter(hb_dir, 1, 2, host="h1").beat(step=5)
+    # worker 1 went dark long ago (poisoned heartbeat)
+    path = heartbeat_path(hb_dir, 1)
+    hb = json.loads(open(path).read())
+    hb["time"] -= 9999.0
+    hb["interval_s"] = 1.0
+    with open(path, "w") as f:
+        json.dump(hb, f)
+
+    ds_logger.addHandler(caplog.handler)
+    try:
+        outcome = launch_and_collect(
+            [[sys.executable, "-c", "import time; time.sleep(60)"],
+             [sys.executable, "-c", "import time; time.sleep(60)"]],
+            ["hostA", "hostB"], watch_dir=str(tmp_path),
+            watch_interval=0.2, watch_stale_s=5.0, watch_fail_after=2)
+    finally:
+        ds_logger.removeHandler(caplog.handler)
+    assert outcome.rc == WATCH_FAIL_RC
+    assert outcome.stale == [(1, "hostB")]
+    assert "hostB" in outcome.bad_hosts
+    # the gate's own SIGTERM killed the HEALTHY worker too — it must not
+    # count as failed, or --elastic would drop the whole fleet instead
+    # of only the stale host
+    assert "hostA" not in outcome.bad_hosts
+    messages = " ".join(r.getMessage() for r in caplog.records)
+    assert "'hostB'" in messages and "stale" in messages

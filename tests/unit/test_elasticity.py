@@ -49,6 +49,37 @@ def test_invalid_world_size():
         el.compute_elastic_config(base_ds_config(), world_size=bad)
 
 
+def test_invalid_world_size_error_is_actionable():
+    """The incompatible-world-size error names the nearest valid world
+    sizes WITH the micro-batch/gas each would run at — an operator (or
+    the fleet supervisor) picks a target from the message instead of
+    bisecting chip counts against a bare exception."""
+    final_batch, valid_gpus = el.compute_elastic_config(base_ds_config())
+    bad = max(valid_gpus) + 1
+    while bad in valid_gpus:
+        bad += 1
+    with pytest.raises(el.ElasticityIncompatibleWorldSize) as ei:
+        el.compute_elastic_config(base_ds_config(), world_size=bad)
+    msg = str(ei.value)
+    assert f"World size ({bad})" in msg
+    assert "Nearest valid world sizes" in msg
+    for g in el.nearest_valid_world_sizes(valid_gpus, bad):
+        # each suggestion carries a consistent (micro, gas) solve
+        assert f"{g} chips (micro_batch=" in msg
+        start = msg.index(f"{g} chips (micro_batch=") + len(f"{g} chips (")
+        fields = dict(kv.split("=") for kv in
+                      msg[start:msg.index(")", start)].split(", "))
+        assert (int(fields["micro_batch"]) * int(fields["gas"]) * g
+                == final_batch)
+
+
+def test_nearest_valid_world_sizes_ordering():
+    assert el.nearest_valid_world_sizes([2, 4, 8, 16], 7) == [8, 4, 2]
+    # ties resolve smaller-first; k bounds the list
+    assert el.nearest_valid_world_sizes([4, 8], 6) == [4, 8]
+    assert el.nearest_valid_world_sizes([1, 2, 3], 10, k=2) == [3, 2]
+
+
 def test_future_version_rejected():
     d = base_ds_config()
     d["elasticity"]["version"] = 0.2
