@@ -44,8 +44,11 @@ import traceback
 import numpy as np
 
 REFERENCE_TFLOPS = 64.0  # BASELINE.md: BERT-large seq128, 1xV100
-PEAK_TFLOPS = {"v5 lite": 197.0, "v5e": 197.0, "v4": 275.0, "v5p": 459.0,
-               "v6e": 918.0}
+# Per-chip-kind bf16 peaks for MFU.  The v5e number is single-sourced
+# from constants.ANALYSIS_HW_PEAK_TFLOPS_DEFAULT (the cost model's
+# canonical default) at lookup time in _peak_tflops — only the
+# non-default chip kinds live here.
+PEAK_TFLOPS = {"v4": 275.0, "v5p": 459.0, "v6e": 918.0}
 
 _PROBE_CODE = (
     "import os, jax\n"
@@ -258,9 +261,12 @@ def _git_head():
 
 def _peak_tflops():
     import jax
+    from deepspeed_tpu import constants as C
 
+    v5e = C.ANALYSIS_HW_PEAK_TFLOPS_DEFAULT
+    table = dict(PEAK_TFLOPS, **{"v5 lite": v5e, "v5e": v5e})
     kind = jax.devices()[0].device_kind.lower()
-    return next((v for k, v in PEAK_TFLOPS.items() if k in kind), 197.0)
+    return next((v for k, v in table.items() if k in kind), v5e)
 
 
 def _time_steps(step, warmup=3, iters=30, align=1, final_sync=None):
@@ -1327,7 +1333,109 @@ def bench_gpt2_large():
                       batch=4, grads_half=True)
 
 
+def bench_autotune():
+    """Ladder ingestion of one autotune leaderboard row (docs/
+    autotuner.md — ROADMAP item 5's "validate on chip once" half).
+    DS_BENCH_AUTOTUNE_RESULTS names the autotune_results.json a search
+    emitted (default autotune_out/autotune_results.json) and
+    DS_BENCH_AUTOTUNE_RANK picks the leaderboard entry (default 1); one
+    bench invocation per rank turns the top-K into a ladder.  The row
+    runs the emitted bench-ready config VERBATIM on the exact model
+    shape the search ranked, and embeds the search's prediction next to
+    the measurement — _program_audit_fields' reconciliation then feeds
+    `python -m deepspeed_tpu.analysis calibrate --records <row.json>`,
+    closing the calibration loop even off a stale-marked row."""
+    import jax
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.analysis.autotuner import (RESULTS_FILENAME,
+                                                  validate_results)
+    from deepspeed_tpu.models import GPT2Config, GPT2Model
+
+    results_path = os.environ.get(
+        "DS_BENCH_AUTOTUNE_RESULTS",
+        os.path.join("autotune_out", RESULTS_FILENAME))
+    rank = int(os.environ.get("DS_BENCH_AUTOTUNE_RANK", "1"))
+    with open(results_path) as f:
+        payload = json.load(f)
+    validate_results(payload)
+    entry = next((e for e in payload["leaderboard"]
+                  if e["rank"] == rank), None)
+    if entry is None:
+        raise RuntimeError(
+            f"no rank {rank} in {results_path} (leaderboard has "
+            f"{len(payload['leaderboard'])} entries)")
+    cfg_path = os.path.join(os.path.dirname(os.path.abspath(results_path)),
+                            entry["config_file"])
+    with open(cfg_path) as f:
+        config = json.load(f)
+
+    chips = int(payload["chips"])
+    if jax.device_count() != chips:
+        # the emitted config pins a mesh factorization of `chips`; a
+        # different world would silently build a different program than
+        # the one the search ranked
+        raise RuntimeError(
+            f"autotune row wants the searched {chips}-chip mesh, "
+            f"backend has {jax.device_count()} device(s) — rerun the "
+            f"search with --chips {jax.device_count()} or run on the "
+            "searched slice")
+    mk = payload["model"]
+    mcfg = GPT2Config(
+        hidden_size=mk["hidden"], num_layers=mk["layers"],
+        num_heads=mk["heads"], n_positions=mk["seq"],
+        vocab_size=mk["vocab"],
+        bf16=bool(config.get("bf16", {}).get("enabled", False)))
+    model = GPT2Model(mcfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    engine, _, _, _ = ds.initialize(model=model, config=config,
+                                    model_parameters=params)
+
+    micro = engine.train_micro_batch_size_per_gpu()
+    gas = engine.gradient_accumulation_steps()
+    dp = engine.mesh_ctx.data_parallel_world_size
+    seq = mk["seq"]
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, mk["vocab"],
+                      size=(micro * dp, seq)).astype(np.int32)
+
+    def batch_iter():
+        while True:
+            yield (ids,)
+
+    it = batch_iter()
+
+    def step():
+        return engine.train_batch(it)  # one optimizer step (gas micros)
+
+    import jax.numpy as jnp
+
+    def param_sync():
+        leaf = jax.tree.leaves(engine.params)[0]
+        float(jnp.asarray(leaf).ravel()[0])
+
+    dt, final_loss, n = _time_steps(step, warmup=2, iters=8,
+                                    final_sync=param_sync)
+    tokens_per_step = gas * micro * dp * seq
+    measured_step_s = dt / n
+    predicted = float(entry["predicted_step_time_lb_s"])
+    return {
+        "metric": "autotune_candidate_train_tokens_per_sec",
+        "value": round(n * tokens_per_step / dt, 1),
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,  # candidate rows compare to their siblings
+        "autotune_rank": rank,
+        "autotune_name": entry["name"],
+        "autotune_results": os.path.abspath(results_path),
+        "autotune_predicted_step_time_lb_s": predicted,
+        "autotune_measured_over_predicted": round(
+            measured_step_s / predicted, 3) if predicted > 0 else None,
+        "final_loss": round(final_loss, 4),
+        **_program_audit_fields(engine, measured_step_s=measured_step_s),
+    }
+
+
 BENCHES = {"gpt2": bench_gpt2, "smoke": bench_smoke,
+           "autotune": bench_autotune,
            "gpt2_gas4": bench_gpt2_gas4,
            "gpt2_gas4_fused": bench_gpt2_gas4_fused,
            "gpt2_zero3_stream": bench_gpt2_zero3_stream,
@@ -1342,6 +1450,7 @@ BENCHES = {"gpt2": bench_gpt2, "smoke": bench_smoke,
            "infinity": bench_infinity,
            "infinity_stream": bench_infinity_stream}
 METRIC_NAMES = {  # error-path metric must match the success-path name
+    "autotune": ("autotune_candidate_train_tokens_per_sec", "tokens/s"),
     "gpt2": ("gpt2_124m_train_tokens_per_sec_1chip", "tokens/s"),
     "gpt2_gas4": ("gpt2_124m_gas4_modular_train_tokens_per_sec_1chip",
                   "tokens/s"),

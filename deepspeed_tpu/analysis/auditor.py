@@ -229,8 +229,12 @@ class ProgramAuditor:
     def __init__(self, cfg):
         self.cfg = cfg
 
-    def run(self, targets: List[AuditTarget],
-            gas: int = 1) -> AuditReport:
+    def run(self, targets: List[AuditTarget], gas: int = 1,
+            swap=None) -> AuditReport:
+        """``swap`` is an optional offload-tier traffic model
+        (cost_model.swap_lane) folded into the step-time lower bound —
+        a config streaming params/optimizer state from NVMe must not
+        rank as if they were HBM-resident."""
         report = AuditReport(targets=[t.label for t in targets])
         for target in targets:
             for _rule_id, rule in STATIC_RULES:
@@ -294,7 +298,7 @@ class ProgramAuditor:
                 liveness.total_bytes, label,
                 report.peak_hbm_contributors, self.cfg))
         report.step_time = build_step_time_model(
-            total_flops, io_bytes, all_records, self.cfg)
+            total_flops, io_bytes, all_records, self.cfg, swap=swap)
         return report
 
 
@@ -323,13 +327,33 @@ def verify_multihost_lockstep(report: AuditReport) -> List[Finding]:
                  "every process must trace the identical step program")]
 
 
+def engine_swap_lane(engine, swap=None):
+    """Offload-tier traffic model for a built engine: when the config
+    targets NVMe for the optimizer sweep, the step-time bound must pay
+    the disk trips at the measured sweep ceiling.  An explicit ``swap``
+    (the autotuner's resident-twin path for offload_param candidates)
+    wins; returns None for purely HBM/host-resident configs."""
+    if swap is not None:
+        return swap
+    from .cost_model import swap_lane
+    try:
+        return swap_lane(engine.config.zero_config,
+                         engine.config.aio_config,
+                         param_bytes=_tree_bytes(engine.params),
+                         opt_state_bytes=_tree_bytes(engine.opt_state))
+    except Exception:  # noqa: BLE001 — the lane is provenance, never fatal
+        return None
+
+
 def audit_engine(engine, sample_batch: Optional[Tuple] = None,
-                 cfg=None, multihost: bool = True) -> AuditReport:
+                 cfg=None, multihost: bool = True,
+                 swap=None) -> AuditReport:
     """Full static audit of a built engine.  Never executes the step."""
     cfg = cfg if cfg is not None else engine.config.analysis_config
     targets = engine_targets(engine, sample_batch)
     report = ProgramAuditor(cfg).run(
-        targets, gas=engine.gradient_accumulation_steps())
+        targets, gas=engine.gradient_accumulation_steps(),
+        swap=engine_swap_lane(engine, swap))
     if multihost:
         report.findings.extend(verify_multihost_lockstep(report))
     return report

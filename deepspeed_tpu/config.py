@@ -706,6 +706,25 @@ class MonitorConfig:
         return cfg
 
 
+def validate_hw_constants(hw: Dict[str, Any],
+                          context: str = "analysis") -> Dict[str, float]:
+    """Positivity gate for the canonical hardware-model constants
+    (C.ANALYSIS_HW_KEYS: hw_peak_tflops / hw_hbm_gbps / hw_ici_gbps).
+    Single-sourced so the ``analysis`` config block and the autotuner's
+    calibration file validate the SAME names the same way — returns the
+    validated subset as floats."""
+    out: Dict[str, float] = {}
+    for key in C.ANALYSIS_HW_KEYS:
+        if key not in hw or hw[key] is None:
+            continue
+        val = float(hw[key])
+        if val <= 0:
+            raise DeepSpeedConfigError(
+                f"{context}.{key} must be > 0, got {val}")
+        out[key] = val
+    return out
+
+
 @dataclass
 class AnalysisConfig:
     """Program Auditor block (docs/program_auditor.md): static jaxpr lint
@@ -792,12 +811,174 @@ class AnalysisConfig:
             raise DeepSpeedConfigError(
                 "analysis.overlap_min_hidden_fraction must be in (0, 1], "
                 f"got {cfg.overlap_min_hidden_fraction}")
-        for knob, val in (("hw_peak_tflops", cfg.hw_peak_tflops),
-                          ("hw_hbm_gbps", cfg.hw_hbm_gbps),
-                          ("hw_ici_gbps", cfg.hw_ici_gbps)):
-            if val <= 0:
+        validate_hw_constants({
+            C.ANALYSIS_HW_PEAK_TFLOPS: cfg.hw_peak_tflops,
+            C.ANALYSIS_HW_HBM_GBPS: cfg.hw_hbm_gbps,
+            C.ANALYSIS_HW_ICI_GBPS: cfg.hw_ici_gbps})
+        return cfg
+
+    def hw_overridden(self, hw: Dict[str, Any]) -> "AnalysisConfig":
+        """A copy with the canonical hardware constants replaced from a
+        validated mapping (the autotuner's calibration-file hook) — keys
+        outside C.ANALYSIS_HW_KEYS are rejected by the shared gate."""
+        from dataclasses import replace
+        valid = validate_hw_constants(hw, context="calibration")
+        return replace(
+            self,
+            hw_peak_tflops=valid.get(C.ANALYSIS_HW_PEAK_TFLOPS,
+                                     self.hw_peak_tflops),
+            hw_hbm_gbps=valid.get(C.ANALYSIS_HW_HBM_GBPS,
+                                  self.hw_hbm_gbps),
+            hw_ici_gbps=valid.get(C.ANALYSIS_HW_ICI_GBPS,
+                                  self.hw_ici_gbps))
+
+
+def _as_tuple(val, cast) -> tuple:
+    """Coerce a config axis (scalar or list) to a tuple of `cast`."""
+    if isinstance(val, (list, tuple)):
+        return tuple(cast(v) for v in val)
+    return (cast(val),)
+
+
+@dataclass
+class AutotuningConfig:
+    """Config-autotuner block (docs/autotuner.md): the offline search
+    bounds, fixed knobs, and budget for ``python -m
+    deepspeed_tpu.analysis tune``.  Purely a SEARCH description — the
+    engine never reads it, so a bench-ready emitted config can carry the
+    block that produced it as provenance."""
+    chips: Optional[int] = C.AUTOTUNING_CHIPS_DEFAULT
+    global_batch: Optional[int] = C.AUTOTUNING_GLOBAL_BATCH_DEFAULT
+    top_k: int = C.AUTOTUNING_TOP_K_DEFAULT
+    hbm_budget_mb: Optional[float] = C.AUTOTUNING_HBM_BUDGET_MB_DEFAULT
+    max_candidates: int = C.AUTOTUNING_MAX_CANDIDATES_DEFAULT
+    mesh_model: tuple = C.AUTOTUNING_MESH_MODEL_DEFAULT
+    mesh_expert: tuple = C.AUTOTUNING_MESH_EXPERT_DEFAULT
+    zero_stages: tuple = C.AUTOTUNING_ZERO_STAGES_DEFAULT
+    stage3_variants: tuple = C.AUTOTUNING_STAGE3_VARIANTS_DEFAULT
+    prefetch_modes: tuple = C.AUTOTUNING_PREFETCH_MODES_DEFAULT
+    stage3_bucket_sizes: tuple = C.AUTOTUNING_STAGE3_BUCKET_SIZES_DEFAULT
+    micro_batches: Optional[tuple] = C.AUTOTUNING_MICRO_BATCHES_DEFAULT
+    qwz_bits: tuple = C.AUTOTUNING_QWZ_BITS_DEFAULT
+    qgz_bits: tuple = C.AUTOTUNING_QGZ_BITS_DEFAULT
+    hpz_group_sizes: tuple = C.AUTOTUNING_HPZ_GROUP_SIZES_DEFAULT
+    fused: tuple = C.AUTOTUNING_FUSED_DEFAULT
+    offload: tuple = C.AUTOTUNING_OFFLOAD_TIERS_DEFAULT
+    nvme_prefetch_depths: tuple = C.AUTOTUNING_NVME_PREFETCH_DEPTHS_DEFAULT
+    opt_pipeline_depths: tuple = C.AUTOTUNING_OPT_PIPELINE_DEPTHS_DEFAULT
+    fixed: Optional[Dict[str, Any]] = C.AUTOTUNING_FIXED_DEFAULT
+    calibration_file: Optional[str] = C.AUTOTUNING_CALIBRATION_FILE_DEFAULT
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "AutotuningConfig":
+        d = d or {}
+        chips = get_scalar_param(d, C.AUTOTUNING_CHIPS,
+                                 C.AUTOTUNING_CHIPS_DEFAULT)
+        gb = get_scalar_param(d, C.AUTOTUNING_GLOBAL_BATCH,
+                              C.AUTOTUNING_GLOBAL_BATCH_DEFAULT)
+        budget = get_scalar_param(d, C.AUTOTUNING_HBM_BUDGET_MB,
+                                  C.AUTOTUNING_HBM_BUDGET_MB_DEFAULT)
+        micro = d.get(C.AUTOTUNING_MICRO_BATCHES,
+                      C.AUTOTUNING_MICRO_BATCHES_DEFAULT)
+        cfg = AutotuningConfig(
+            chips=None if chips is None else int(chips),
+            global_batch=None if gb is None else int(gb),
+            top_k=int(get_scalar_param(d, C.AUTOTUNING_TOP_K,
+                                       C.AUTOTUNING_TOP_K_DEFAULT)),
+            hbm_budget_mb=None if budget is None else float(budget),
+            max_candidates=int(get_scalar_param(
+                d, C.AUTOTUNING_MAX_CANDIDATES,
+                C.AUTOTUNING_MAX_CANDIDATES_DEFAULT)),
+            mesh_model=_as_tuple(d.get(
+                C.AUTOTUNING_MESH_MODEL,
+                C.AUTOTUNING_MESH_MODEL_DEFAULT), int),
+            mesh_expert=_as_tuple(d.get(
+                C.AUTOTUNING_MESH_EXPERT,
+                C.AUTOTUNING_MESH_EXPERT_DEFAULT), int),
+            zero_stages=_as_tuple(d.get(
+                C.AUTOTUNING_ZERO_STAGES,
+                C.AUTOTUNING_ZERO_STAGES_DEFAULT), int),
+            stage3_variants=_as_tuple(d.get(
+                C.AUTOTUNING_STAGE3_VARIANTS,
+                C.AUTOTUNING_STAGE3_VARIANTS_DEFAULT), str),
+            prefetch_modes=_as_tuple(d.get(
+                C.AUTOTUNING_PREFETCH_MODES,
+                C.AUTOTUNING_PREFETCH_MODES_DEFAULT), str),
+            stage3_bucket_sizes=_as_tuple(d.get(
+                C.AUTOTUNING_STAGE3_BUCKET_SIZES,
+                C.AUTOTUNING_STAGE3_BUCKET_SIZES_DEFAULT), int),
+            micro_batches=(None if micro is None
+                           else _as_tuple(micro, int)),
+            qwz_bits=_as_tuple(d.get(C.AUTOTUNING_QWZ_BITS,
+                                     C.AUTOTUNING_QWZ_BITS_DEFAULT), int),
+            qgz_bits=_as_tuple(d.get(C.AUTOTUNING_QGZ_BITS,
+                                     C.AUTOTUNING_QGZ_BITS_DEFAULT), int),
+            hpz_group_sizes=_as_tuple(d.get(
+                C.AUTOTUNING_HPZ_GROUP_SIZES,
+                C.AUTOTUNING_HPZ_GROUP_SIZES_DEFAULT), int),
+            fused=_as_tuple(d.get(C.AUTOTUNING_FUSED,
+                                  C.AUTOTUNING_FUSED_DEFAULT), bool),
+            offload=_as_tuple(d.get(C.AUTOTUNING_OFFLOAD_TIERS,
+                                    C.AUTOTUNING_OFFLOAD_TIERS_DEFAULT),
+                              str),
+            nvme_prefetch_depths=_as_tuple(d.get(
+                C.AUTOTUNING_NVME_PREFETCH_DEPTHS,
+                C.AUTOTUNING_NVME_PREFETCH_DEPTHS_DEFAULT), int),
+            opt_pipeline_depths=_as_tuple(d.get(
+                C.AUTOTUNING_OPT_PIPELINE_DEPTHS,
+                C.AUTOTUNING_OPT_PIPELINE_DEPTHS_DEFAULT), int),
+            fixed=d.get(C.AUTOTUNING_FIXED, C.AUTOTUNING_FIXED_DEFAULT),
+            calibration_file=get_scalar_param(
+                d, C.AUTOTUNING_CALIBRATION_FILE,
+                C.AUTOTUNING_CALIBRATION_FILE_DEFAULT),
+        )
+        for knob, val, floor in ((C.AUTOTUNING_CHIPS, cfg.chips, 1),
+                                 (C.AUTOTUNING_GLOBAL_BATCH,
+                                  cfg.global_batch, 1),
+                                 (C.AUTOTUNING_TOP_K, cfg.top_k, 1),
+                                 (C.AUTOTUNING_MAX_CANDIDATES,
+                                  cfg.max_candidates, 1)):
+            if val is not None and val < floor:
                 raise DeepSpeedConfigError(
-                    f"analysis.{knob} must be > 0, got {val}")
+                    f"autotuning.{knob} must be >= {floor}, got {val}")
+        if cfg.hbm_budget_mb is not None and cfg.hbm_budget_mb <= 0:
+            raise DeepSpeedConfigError(
+                "autotuning.hbm_budget_mb must be > 0, got "
+                f"{cfg.hbm_budget_mb}")
+        for knob, vals, floor in (
+                (C.AUTOTUNING_MESH_MODEL, cfg.mesh_model, 1),
+                (C.AUTOTUNING_MESH_EXPERT, cfg.mesh_expert, 1),
+                (C.AUTOTUNING_STAGE3_BUCKET_SIZES,
+                 cfg.stage3_bucket_sizes, 1),
+                (C.AUTOTUNING_NVME_PREFETCH_DEPTHS,
+                 cfg.nvme_prefetch_depths, 1),
+                (C.AUTOTUNING_OPT_PIPELINE_DEPTHS,
+                 cfg.opt_pipeline_depths, 2),
+                (C.AUTOTUNING_HPZ_GROUP_SIZES, cfg.hpz_group_sizes, 0),
+                (C.AUTOTUNING_MICRO_BATCHES, cfg.micro_batches or (1,),
+                 1)):
+            if not vals or any(v < floor for v in vals):
+                raise DeepSpeedConfigError(
+                    f"autotuning.{knob} must be a non-empty list of "
+                    f"ints >= {floor}, got {list(vals)}")
+        for knob, vals, allowed in (
+                (C.AUTOTUNING_ZERO_STAGES, cfg.zero_stages, (1, 2, 3)),
+                (C.AUTOTUNING_STAGE3_VARIANTS, cfg.stage3_variants,
+                 C.AUTOTUNING_STAGE3_VARIANTS_ALL),
+                (C.AUTOTUNING_PREFETCH_MODES, cfg.prefetch_modes,
+                 C.ZERO_OPTIMIZATION_PREFETCH_MODES),
+                (C.AUTOTUNING_QWZ_BITS, cfg.qwz_bits, (0, 4, 8)),
+                (C.AUTOTUNING_QGZ_BITS, cfg.qgz_bits, (0, 4, 8)),
+                (C.AUTOTUNING_OFFLOAD_TIERS, cfg.offload,
+                 C.AUTOTUNING_OFFLOAD_TIERS_ALL)):
+            if not vals or any(v not in allowed for v in vals):
+                raise DeepSpeedConfigError(
+                    f"autotuning.{knob} values must be from "
+                    f"{list(allowed)}, got {list(vals)}")
+        if cfg.fixed is not None and not isinstance(cfg.fixed, dict):
+            raise DeepSpeedConfigError(
+                "autotuning.fixed must be a config-overlay dict, got "
+                f"{type(cfg.fixed).__name__}")
         return cfg
 
 
@@ -1240,6 +1421,8 @@ class DeepSpeedConfig:
         self.fused_step_config = FusedStepConfig.from_dict(
             pd.get(C.FUSED_STEP))
         self.analysis_config = AnalysisConfig.from_dict(pd.get(C.ANALYSIS))
+        self.autotuning_config = AutotuningConfig.from_dict(
+            pd.get(C.AUTOTUNING))
         self.monitor_config = MonitorConfig.from_dict(pd.get(C.MONITOR))
         self.eigenvalue_config = EigenvalueConfig.from_dict(pd.get(C.EIGENVALUE))
         self.pld_config = PLDConfig.from_dict(pd.get(C.PROGRESSIVE_LAYER_DROP))

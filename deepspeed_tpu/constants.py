@@ -194,13 +194,100 @@ ANALYSIS_REQUIRE_OVERLAP_DEFAULT = False
 ANALYSIS_OVERLAP_MIN_HIDDEN = "overlap_min_hidden_fraction"
 ANALYSIS_OVERLAP_MIN_HIDDEN_DEFAULT = 0.5
 # hardware model for the static step-time lower bound (defaults: one
-# TPU v5e chip — bf16 peak, HBM bandwidth, per-chip ICI bandwidth)
+# TPU v5e chip — bf16 peak, HBM bandwidth, per-chip ICI bandwidth).
+# These THREE names are the canonical hardware-constant vocabulary:
+# the analysis config block, the autotuner's calibration file, and the
+# cost model's report payload all key off ANALYSIS_HW_KEYS /
+# ANALYSIS_HW_DEFAULTS so a constant can never be overridden under one
+# spelling and read under another.
 ANALYSIS_HW_PEAK_TFLOPS = "hw_peak_tflops"
 ANALYSIS_HW_PEAK_TFLOPS_DEFAULT = 197.0
 ANALYSIS_HW_HBM_GBPS = "hw_hbm_gbps"
 ANALYSIS_HW_HBM_GBPS_DEFAULT = 819.0
 ANALYSIS_HW_ICI_GBPS = "hw_ici_gbps"
 ANALYSIS_HW_ICI_GBPS_DEFAULT = 90.0
+ANALYSIS_HW_KEYS = (ANALYSIS_HW_PEAK_TFLOPS, ANALYSIS_HW_HBM_GBPS,
+                    ANALYSIS_HW_ICI_GBPS)
+ANALYSIS_HW_DEFAULTS = {
+    ANALYSIS_HW_PEAK_TFLOPS: ANALYSIS_HW_PEAK_TFLOPS_DEFAULT,
+    ANALYSIS_HW_HBM_GBPS: ANALYSIS_HW_HBM_GBPS_DEFAULT,
+    ANALYSIS_HW_ICI_GBPS: ANALYSIS_HW_ICI_GBPS_DEFAULT,
+}
+
+#############################################
+# Config autotuner (TPU-native addition; docs/autotuner.md)
+#
+# Offline cost-model-driven search over the real config decision space
+# (mesh factorization, ZeRO stage/variant, gas/micro splits, qwZ/qgZ/
+# hpZ, fused vs modular, offload tier) — prune on hard constraints,
+# trace survivors on a simulated mesh, rank by the static step-time
+# lower bound, emit the top-K as bench-ready configs.  The block only
+# configures `python -m deepspeed_tpu.analysis tune`; it never changes
+# engine behavior.
+#############################################
+AUTOTUNING = "autotuning"
+AUTOTUNING_CHIPS = "chips"
+AUTOTUNING_CHIPS_DEFAULT = None          # required via block or --chips
+AUTOTUNING_GLOBAL_BATCH = "global_batch"
+AUTOTUNING_GLOBAL_BATCH_DEFAULT = None   # default: base config train_batch
+AUTOTUNING_TOP_K = "top_k"
+AUTOTUNING_TOP_K_DEFAULT = 3
+AUTOTUNING_HBM_BUDGET_MB = "hbm_budget_mb"
+AUTOTUNING_HBM_BUDGET_MB_DEFAULT = None  # default: analysis.hbm_budget_mb
+AUTOTUNING_MAX_CANDIDATES = "max_candidates"
+AUTOTUNING_MAX_CANDIDATES_DEFAULT = 64
+# search axes: each is the list of values the enumeration sweeps
+AUTOTUNING_MESH_MODEL = "mesh_model"
+AUTOTUNING_MESH_MODEL_DEFAULT = (1,)
+AUTOTUNING_MESH_EXPERT = "mesh_expert"
+AUTOTUNING_MESH_EXPERT_DEFAULT = (1,)
+AUTOTUNING_ZERO_STAGES = "zero_stages"
+AUTOTUNING_ZERO_STAGES_DEFAULT = (1, 2, 3)
+AUTOTUNING_STAGE3_VARIANTS = "stage3_variants"
+AUTOTUNING_STAGE3_VARIANT_RESIDENT = "resident"
+AUTOTUNING_STAGE3_VARIANT_STREAMED = "streamed"
+AUTOTUNING_STAGE3_VARIANTS_ALL = (AUTOTUNING_STAGE3_VARIANT_RESIDENT,
+                                  AUTOTUNING_STAGE3_VARIANT_STREAMED)
+AUTOTUNING_STAGE3_VARIANTS_DEFAULT = AUTOTUNING_STAGE3_VARIANTS_ALL
+AUTOTUNING_PREFETCH_MODES = "prefetch_modes"
+AUTOTUNING_PREFETCH_MODES_DEFAULT = ("carried", "off")
+AUTOTUNING_STAGE3_BUCKET_SIZES = "stage3_bucket_sizes"
+AUTOTUNING_STAGE3_BUCKET_SIZES_DEFAULT = (200_000,)
+AUTOTUNING_MICRO_BATCHES = "micro_batches"
+AUTOTUNING_MICRO_BATCHES_DEFAULT = None  # None = every divisor split
+AUTOTUNING_QWZ_BITS = "qwz_bits"
+AUTOTUNING_QWZ_BITS_DEFAULT = (0,)
+AUTOTUNING_QGZ_BITS = "qgz_bits"
+AUTOTUNING_QGZ_BITS_DEFAULT = (0,)
+AUTOTUNING_HPZ_GROUP_SIZES = "hpz_group_sizes"
+AUTOTUNING_HPZ_GROUP_SIZES_DEFAULT = (0,)
+AUTOTUNING_FUSED = "fused"
+AUTOTUNING_FUSED_DEFAULT = (False,)
+AUTOTUNING_OFFLOAD_TIERS = "offload"
+AUTOTUNING_OFFLOAD_TIER_NONE = "none"
+AUTOTUNING_OFFLOAD_TIER_CPU = "cpu"
+AUTOTUNING_OFFLOAD_TIER_NVME = "nvme"
+AUTOTUNING_OFFLOAD_TIERS_ALL = (AUTOTUNING_OFFLOAD_TIER_NONE,
+                                AUTOTUNING_OFFLOAD_TIER_CPU,
+                                AUTOTUNING_OFFLOAD_TIER_NVME)
+AUTOTUNING_OFFLOAD_TIERS_DEFAULT = (AUTOTUNING_OFFLOAD_TIER_NONE,)
+AUTOTUNING_NVME_PREFETCH_DEPTHS = "nvme_prefetch_depths"
+AUTOTUNING_NVME_PREFETCH_DEPTHS_DEFAULT = (2,)
+AUTOTUNING_OPT_PIPELINE_DEPTHS = "opt_pipeline_depths"
+AUTOTUNING_OPT_PIPELINE_DEPTHS_DEFAULT = (2,)
+# raw config overlay applied to every candidate (fixed knobs)
+AUTOTUNING_FIXED = "fixed"
+AUTOTUNING_FIXED_DEFAULT = None
+AUTOTUNING_CALIBRATION_FILE = "calibration_file"
+AUTOTUNING_CALIBRATION_FILE_DEFAULT = None
+# schema tags of the machine-readable artifacts
+AUTOTUNE_RESULTS_SCHEMA = "ds_autotune_results_v1"
+HW_CALIBRATION_SCHEMA = "ds_hw_calibration_v1"
+# NVMe swap-lane fallback bandwidth (GB/s) when no aio sweep ceiling
+# artifact exists on this host — deliberately conservative (a cheap
+# consumer NVMe read floor) so an uncalibrated search never flatters a
+# streamed config
+AUTOTUNE_NVME_FALLBACK_GBPS = 3.0
 
 #############################################
 # Runtime telemetry monitor (TPU-native addition; docs/telemetry.md)

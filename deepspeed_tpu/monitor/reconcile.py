@@ -48,7 +48,10 @@ ATTR_SWAP = "io-bound (swap exposed)"
 ATTR_HOST_GAP = "host-gap"
 
 _LANE_ATTR = {"compute": ATTR_COMPUTE, "memory": ATTR_IO,
-              "hidden_comm": ATTR_COMM_HIDDEN}
+              "hidden_comm": ATTR_COMM_HIDDEN,
+              # the cost model's offload-tier lane (swap traffic priced
+              # at the aio sweep ceiling) attributes as swap-exposed io
+              "swap": ATTR_SWAP}
 
 
 @dataclass
@@ -76,8 +79,12 @@ def attribute_gap(lanes: Dict[str, Any],
             return ATTR_SWAP
     if not lanes:
         return "unattributed"
-    binding = max(("compute", "memory", "hidden_comm"),
-                  key=lambda k: float(lanes.get(k) or 0.0))
+    # "swap" joins the binding set only when the static model priced an
+    # offload tier (older payloads / non-offload configs carry no key)
+    cands = ["compute", "memory", "hidden_comm"]
+    if float(lanes.get("swap") or 0.0) > 0.0:
+        cands.append("swap")
+    binding = max(cands, key=lambda k: float(lanes.get(k) or 0.0))
     exposed = float(lanes.get("exposed_comm") or 0.0)
     if exposed > float(lanes.get(binding) or 0.0):
         return ATTR_COMM_EXPOSED

@@ -42,6 +42,10 @@ def test_resilience_package_imports_cleanly():
             "deepspeed_tpu.analysis",
             "deepspeed_tpu.analysis.cli",
             "deepspeed_tpu.analysis.__main__",
+            # config autotuner: lazily imported by the tune/calibrate
+            # subcommands and bench.py's autotune ladder row
+            "deepspeed_tpu.analysis.search_space",
+            "deepspeed_tpu.analysis.autotuner",
             # telemetry monitor: lazily imported by the engines (only
             # when the monitor block is on)
             "deepspeed_tpu.monitor",
