@@ -20,12 +20,15 @@ diagnostic JSON line instead of a bare traceback.
 
 Ladder: `python bench.py --config
 {gpt2|gpt2_gas4|gpt2_gas4_fused|gpt2_zero3_stream|
-gpt2_zero3_stream_carried|bert_z2|bert_s512|decode|moe|gpt_moe|
-longseq|sparse_longseq|offload|infinity}` selects other BASELINE.md anchor
-points; default is the flagship gpt2.  The gas4 pair A/Bs the fused
-whole-step program (1 dispatch/step) against the modular loop (2N); the
-zero3_stream pair A/Bs the carried double-buffer prefetch against
-serialized at-use gathers (needs a >1-chip ZeRO world).
+gpt2_zero3_stream_carried|gpt2_zero3_stream_fcm|bert_z2|bert_s512|
+decode|moe|gpt_moe|longseq|sparse_longseq|offload|infinity}` selects
+other BASELINE.md anchor points; default is the flagship gpt2.  The
+gas4 pair A/Bs the fused whole-step program (1 dispatch/step) against
+the modular loop (2N); the zero3_stream pair A/Bs the carried
+double-buffer prefetch against serialized at-use gathers; the fcm row
+A/Bs the per-tile fused collective-matmul transports against the
+modular qwZ/qgZ collectives in one row (all three need a >1-chip ZeRO
+world).
 DS_BENCH_ITERS overrides the timing iteration count (CI smoke).
 DS_BENCH_WALL_BUDGET caps total bench wall-clock (default 1500 s): the
 watchdog emits the (stale-marked) result JSON and exits 0 before a driver
@@ -562,65 +565,71 @@ def bench_gpt2_gas4_fused():
     return _bench_gpt2_gas(fused=True)
 
 
-def _bench_gpt2_zero3_stream(carried, batch=8):
-    """Streamed-ZeRO-3 A/B (ISSUE 7): the carried double-buffer prefetch
-    (stage3_prefetch_mode=carried — layer i+1's gather issued into the
-    scan carry under layer i's compute, backward re-gather likewise)
-    against the serialized at-use gather baseline, same model/precision
-    and the SAME group size (2 layers/gather — max_live is set per mode
-    so both plans land on g=2; the carried row legitimately holds two
-    groups live, that IS the double buffer), so the measured delta
-    isolates the prefetch, not a gather-granularity change.  Every row
-    embeds overlap_efficiency / peak_hbm_bytes / predicted_step_time_lb
-    from the static Schedule Auditor, so the measured delta is
-    attributable against the model's prediction.  Requires a >1-device
-    ZeRO world — on a single chip the streamed region cannot engage and
-    the row fails loudly (the watchdog's stale-marking path) rather
-    than publishing a non-streamed number."""
+def _zero3_stream_setup(row_name, batch, seq=1024):
+    """Shared scaffolding of the zero3_stream rows (the carried pair
+    and the fcm A/B): mesh + >1-device guard + model + data.  Requires
+    a >1-device ZeRO world — on a single chip the streamed region
+    cannot engage and the row fails loudly (the watchdog's
+    stale-marking path) rather than publishing a non-streamed number."""
     import jax
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import GPT2Config, GPT2Model
 
-    seq = 1024
     mesh = ds.initialize_mesh(data=-1)
     zero_world = mesh.data_parallel_world_size
     if zero_world < 2:
         raise RuntimeError(
-            "gpt2_zero3_stream needs a >1-device ZeRO world (explicit "
+            f"{row_name} needs a >1-device ZeRO world (explicit "
             f"streaming is a no-op on {zero_world} device) — run on a "
             "multichip host")
     cfg = GPT2Config(n_positions=seq, bf16=True)
     model = GPT2Model(cfg)
-    params = model.init_params(jax.random.PRNGKey(0))
-    per_layer = sum(int(np.prod(l.shape[1:]))
-                    for l in jax.tree.leaves(params["h"]))
+    per_layer = sum(
+        int(np.prod(l.shape[1:])) for l in jax.tree.leaves(
+            model.init_params(jax.random.PRNGKey(0))["h"]))
+    rng = np.random.RandomState(0)
+    global_batch = max(1, batch // zero_world) * zero_world
+    ids = rng.randint(0, cfg.vocab_size,
+                      size=(global_batch, seq)).astype(np.int32)
+    return mesh, zero_world, cfg, model, per_layer, ids, global_batch
+
+
+def _zero3_stream_run(setup, batch, carried, low_bandwidth=None,
+                      row_name="zero3_stream"):
+    """Build + time ONE streamed engine at the A/B-pinned group size
+    (both modes plan groups of 2 layers — carried halves its budget for
+    the prefetched group: 4x/2 -> 2; off takes 2x directly — so every
+    A/B over this helper holds gather granularity fixed and varies only
+    the schedule/transport).  Returns (dt, final_loss, n, plan, engine)
+    and raises loudly when the requested plan did not engage."""
+    import jax
+    import deepspeed_tpu as ds
+
+    mesh, zero_world, cfg, model, per_layer, ids, _ = setup
+    zero_cfg = {
+        "stage": 3,
+        "stage3_param_persistence_threshold": 0,
+        "stage3_max_live_parameters": (4 * per_layer if carried
+                                       else 2 * per_layer),
+        "stage3_prefetch_bucket_size": (2 * per_layer if carried
+                                        else 0),
+        "stage3_prefetch_mode": "carried" if carried else "off",
+    }
+    if low_bandwidth is not None:
+        zero_cfg["low_bandwidth"] = dict(low_bandwidth)
     config = {
         "train_micro_batch_size_per_gpu": max(1, batch // zero_world),
         "gradient_accumulation_steps": 1,
         "optimizer": {"type": "AdamW",
                       "params": {"lr": 6e-4, "weight_decay": 0.1}},
         "bf16": {"enabled": True},
-        "zero_optimization": {
-            "stage": 3,
-            "stage3_param_persistence_threshold": 0,
-            # both modes plan groups of 2 layers (carried halves its
-            # budget for the prefetched group: 4x/2 -> 2 layers; off
-            # takes 2x directly) so the A/B holds gather granularity
-            # fixed and varies only the schedule
-            "stage3_max_live_parameters": (4 * per_layer if carried
-                                           else 2 * per_layer),
-            "stage3_prefetch_bucket_size": (2 * per_layer if carried
-                                            else 0),
-            "stage3_prefetch_mode": "carried" if carried else "off",
-        },
+        "zero_optimization": zero_cfg,
         "steps_per_print": 10 ** 9,
     }
-    engine, _, _, _ = ds.initialize(model=model, config=config,
-                                    model_parameters=params, mesh=mesh)
-    rng = np.random.RandomState(0)
-    global_batch = max(1, batch // zero_world) * zero_world
-    ids = rng.randint(0, cfg.vocab_size,
-                      size=(global_batch, seq)).astype(np.int32)
+    engine, _, _, _ = ds.initialize(
+        model=model, config=config,
+        model_parameters=model.init_params(jax.random.PRNGKey(0)),
+        mesh=mesh)
 
     def step():
         loss = engine.forward(ids)
@@ -632,9 +641,29 @@ def _bench_gpt2_zero3_stream(carried, batch=8):
     plan = engine._zero3_stream.last_plan
     if plan is None or (carried and plan.mode != "carried"):
         raise RuntimeError(
-            f"zero3_stream row fell back to plan={plan} — the streamed "
+            f"{row_name} row fell back to plan={plan} — the streamed "
             "region did not engage" +
             (" the carried prefetch" if carried else ""))
+    return dt, final_loss, n, plan, engine
+
+
+def _bench_gpt2_zero3_stream(carried, batch=8):
+    """Streamed-ZeRO-3 A/B (ISSUE 7): the carried double-buffer prefetch
+    (stage3_prefetch_mode=carried — layer i+1's gather issued into the
+    scan carry under layer i's compute, backward re-gather likewise)
+    against the serialized at-use gather baseline, same model/precision
+    and the SAME group size (2 layers/gather — see _zero3_stream_run;
+    the carried row legitimately holds two groups live, that IS the
+    double buffer), so the measured delta isolates the prefetch, not a
+    gather-granularity change.  Every row embeds overlap_efficiency /
+    peak_hbm_bytes / predicted_step_time_lb from the static Schedule
+    Auditor, so the measured delta is attributable against the model's
+    prediction."""
+    seq = 1024
+    setup = _zero3_stream_setup("gpt2_zero3_stream", batch, seq)
+    _, zero_world, cfg, _, _, _, global_batch = setup
+    dt, final_loss, n, plan, engine = _zero3_stream_run(
+        setup, batch, carried)
     tokens_per_sec = n * global_batch * seq / dt
     tflops = tokens_per_sec * cfg.flops_per_token() / 1e12
     peak = _peak_tflops()
@@ -660,6 +689,83 @@ def bench_gpt2_zero3_stream():
 
 def bench_gpt2_zero3_stream_carried():
     return _bench_gpt2_zero3_stream(carried=True)
+
+
+def bench_gpt2_zero3_stream_fcm(batch=8):
+    """Fused-collective-matmul A/B (ISSUE 13): the per-tile fused qwZ/qgZ
+    transports (ops/collective_matmul.py, low_bandwidth.fused_collective_
+    matmul) against the modular monolithic collectives, at the IDENTICAL
+    group size (g=2, carried prefetch in both modes — _zero3_stream_run)
+    and identical qwZ/qgZ bits (8/8) — the measured delta isolates the
+    per-tile transport schedule, nothing else.  Both runs' losses must
+    agree (the fused gather is bitwise-identical and the fused scatter
+    keeps the modular accumulation-order contract; only dense-fallback
+    skinny leaves may reassociate) — the row fails loudly if they don't,
+    and embeds overlap_efficiency + the exposed/hidden comm lanes for
+    BOTH modes so the reconciliation attributes the win.  Requires a
+    >1-device ZeRO world, like the carried pair."""
+    seq = 1024
+    setup = _zero3_stream_setup("gpt2_zero3_stream_fcm", batch, seq)
+    _, zero_world, cfg, _, _, _, global_batch = setup
+
+    def run(fcm):
+        dt, final_loss, n, plan, engine = _zero3_stream_run(
+            setup, batch, carried=True,
+            low_bandwidth={"qwz_bits": 8, "qgz_bits": 8,
+                           "fused_collective_matmul": bool(fcm)},
+            row_name=f"gpt2_zero3_stream_fcm (fcm={fcm})")
+        if fcm and not engine._zero3_stream.fcm:
+            raise RuntimeError(
+                "zero3_stream_fcm: fused_collective_matmul did not "
+                "engage on the streaming context")
+        audit = _program_audit_fields(engine, measured_step_s=dt / n)
+        return dt, final_loss, n, plan, audit
+
+    dt_mod, loss_mod, n_mod, plan_mod, audit_mod = run(fcm=False)
+    dt_fcm, loss_fcm, n_fcm, plan_fcm, audit_fcm = run(fcm=True)
+    if plan_fcm.layers_per_step != plan_mod.layers_per_step:
+        raise RuntimeError(
+            f"A/B group sizes diverged: fused g={plan_fcm.layers_per_step}"
+            f" vs modular g={plan_mod.layers_per_step}")
+    # identical-loss gate: same init, same data, same quantizers — only
+    # dense-fallback skinny leaves may reassociate their fp32 grad sums
+    if not np.isclose(loss_fcm, loss_mod, rtol=1e-2, atol=1e-3):
+        raise RuntimeError(
+            f"fused-vs-modular loss divergence: fcm={loss_fcm:.6f} vs "
+            f"modular={loss_mod:.6f} — the fused transport changed the "
+            "numerics, not just the schedule")
+
+    tokens_per_sec = n_fcm * global_batch * seq / dt_fcm
+    tokens_mod = n_mod * global_batch * seq / dt_mod
+    tflops = tokens_per_sec * cfg.flops_per_token() / 1e12
+    peak = _peak_tflops()
+
+    def _lanes(audit):
+        rec = (audit.get("reconciliation") or {})
+        lanes = rec.get("lanes") or {}
+        return {"exposed_comm": lanes.get("exposed_comm"),
+                "hidden_comm": lanes.get("hidden_comm"),
+                "overlap_efficiency": audit.get("overlap_efficiency")}
+
+    return {
+        "metric": "gpt2_124m_zero3_stream_fcm_train_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tflops / REFERENCE_TFLOPS, 3),
+        "tflops_per_chip": round(tflops / zero_world, 2),
+        "mfu": round(tflops / (peak * zero_world), 4),
+        "final_loss": round(loss_fcm, 4),
+        "zero_world": zero_world,
+        "stream_plan": {"layers_per_step": plan_fcm.layers_per_step,
+                        "prefetch": plan_fcm.prefetch,
+                        "mode": plan_fcm.mode, "fcm": True},
+        "modular_tokens_per_sec": round(tokens_mod, 1),
+        "modular_final_loss": round(loss_mod, 4),
+        "fcm_speedup": round(tokens_per_sec / tokens_mod, 4),
+        "lanes_modular": _lanes(audit_mod),
+        "lanes_fcm": _lanes(audit_fcm),
+        **audit_fcm,
+    }
 
 
 def bench_smoke():
@@ -1440,6 +1546,7 @@ BENCHES = {"gpt2": bench_gpt2, "smoke": bench_smoke,
            "gpt2_gas4_fused": bench_gpt2_gas4_fused,
            "gpt2_zero3_stream": bench_gpt2_zero3_stream,
            "gpt2_zero3_stream_carried": bench_gpt2_zero3_stream_carried,
+           "gpt2_zero3_stream_fcm": bench_gpt2_zero3_stream_fcm,
            "gpt2_b16": bench_gpt2_b16, "gpt2_b32": bench_gpt2_b32,
            "gpt2_medium": bench_gpt2_medium, "gpt2_large": bench_gpt2_large,
            "bert_z2": bench_bert_z2, "bert_s512": bench_bert_s512,
@@ -1460,6 +1567,8 @@ METRIC_NAMES = {  # error-path metric must match the success-path name
                           "_per_sec", "tokens/s"),
     "gpt2_zero3_stream_carried": ("gpt2_124m_zero3_stream_carried_train_"
                                   "tokens_per_sec", "tokens/s"),
+    "gpt2_zero3_stream_fcm": ("gpt2_124m_zero3_stream_fcm_train_tokens"
+                              "_per_sec", "tokens/s"),
     "gpt2_b16": ("gpt2_124m_b16_train_tokens_per_sec_1chip", "tokens/s"),
     "gpt2_b32": ("gpt2_124m_b32_train_tokens_per_sec_1chip", "tokens/s"),
     "gpt2_medium": ("gpt2_355m_train_tokens_per_sec_1chip", "tokens/s"),

@@ -12,7 +12,11 @@ genuine lower bound:
             least once, whatever XLA fuses in between — at HBM bandwidth
   comm      overlap-adjusted wire time: the hidden fraction of each
             collective (analysis/overlap.py) rides under compute, the
-            exposed remainder is added on top
+            exposed remainder is added on top.  Fused collective-matmul
+            transports (per-tile wire under the producer/consumer GEMM,
+            ops/collective_matmul.py) are hidden by construction — they
+            price entirely in the hidden lane and are broken out as
+            ``wire_bytes_fused`` for attribution
   swap      offload-tier traffic (params/optimizer state streamed from
             NVMe) at the MEASURED aio sweep ceiling, not HBM speed — a
             double-buffered stream (prefetch/pipeline depth >= 2) rides
@@ -166,6 +170,12 @@ def build_step_time_model(total_flops: int, io_bytes: int,
                        for r in records)
     exposed_bytes = sum(r.wire_bytes * r.mult * (1.0 - r.hidden_fraction)
                         for r in records)
+    # fused collective-matmul transports (per-tile wire under the
+    # producer/consumer GEMM) ride at hidden_fraction 1.0 — broken out
+    # so the reconciliation can attribute a fused config's win to the
+    # hidden-comm lane explicitly
+    fused_bytes = sum(r.wire_bytes * r.mult for r in records
+                      if getattr(r, "fused", False))
     t_hidden = hidden_bytes / wire_bw
     t_exposed = exposed_bytes / wire_bw
     t_swap_hidden = float(swap["t_hidden_s"]) if swap else 0.0
@@ -180,6 +190,7 @@ def build_step_time_model(total_flops: int, io_bytes: int,
         "io_bytes_per_step": int(io_bytes),
         "wire_bytes_hidden": int(hidden_bytes),
         "wire_bytes_exposed": int(exposed_bytes),
+        "wire_bytes_fused": int(fused_bytes),
         "t_compute_s": t_compute,
         "t_memory_s": t_memory,
         "t_comm_hidden_s": t_hidden,

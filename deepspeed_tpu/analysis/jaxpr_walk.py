@@ -93,6 +93,22 @@ def eqn_scope(eqn, prefix: str = "") -> str:
     return prefix or stack
 
 
+def scope_has_component(scope: str, name: str) -> bool:
+    """True when ``name`` appears as a whole path COMPONENT of a
+    name-scope path — possibly wrapped by transform tags (``jvp(name)``,
+    ``transpose(jvp(name))``), which jax's name stack applies to scoped
+    eqns under autodiff.  A bare substring test would let an unrelated
+    user scope like ``name_block`` match; component boundaries are
+    ``/`` and the transform parentheses."""
+    import re
+    pat = getattr(scope_has_component, "_cache", {}).get(name)
+    if pat is None:
+        pat = re.compile(r"(?:^|[/(])" + re.escape(name) + r"(?:$|[/)])")
+        scope_has_component._cache = {
+            **getattr(scope_has_component, "_cache", {}), name: pat}
+    return bool(pat.search(scope))
+
+
 class EqnCtx(NamedTuple):
     """One equation with its structural context inside the whole program.
 

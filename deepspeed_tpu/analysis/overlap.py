@@ -27,6 +27,13 @@ budget accounts) this module computes:
   carried          the result escapes the enclosing body (scan carry /
                    region output) instead of being consumed in-body:
                    the double-buffer property, verified
+  fused            the collective is a per-tile transport of a fused
+                   collective-matmul (ops/collective_matmul.py, traced
+                   under the ``constants.FCM_SCOPE`` name scope): the
+                   wire is interleaved tile-by-tile with the producer/
+                   consumer GEMM by construction, so it is hidden as a
+                   STATIC property — the carried-like classification T3
+                   fusion earns, gateable via ``require_overlap``
   hidden_fraction  min(1, slack_time / wire_time) under the configured
                    hardware model — how much of the wire the scheduler
                    CAN hide, which upper-bounds what it will
@@ -43,11 +50,19 @@ them anyway.
 from dataclasses import asdict, dataclass
 from typing import Any, Dict, List
 
+from .. import constants as C
 from .findings import Finding, RULE_OVERLAP
-from .jaxpr_walk import as_jaxpr, aval_bytes, eqn_scope, sub_jaxprs
+from .jaxpr_walk import (as_jaxpr, aval_bytes, eqn_scope,
+                         scope_has_component, sub_jaxprs)
 from .rules import _WIRE_GATHER_PRIMS, _WIRE_REDUCE_PRIMS
 
 _WIRE_PRIMS = _WIRE_GATHER_PRIMS + _WIRE_REDUCE_PRIMS
+
+# ppermute is deliberately NOT a generic wire-mover (ring attention uses
+# it for lockstep-relevant but overlap-managed hops; see rules.py) —
+# EXCEPT inside the fused-collective-matmul scope, where the per-tile
+# ring permutes ARE the qwZ/qgZ payload movers and must be priced
+_FCM_TRANSPORT_PRIMS = ("ppermute",)
 
 # shape-only ops a collective result flows through unchanged — following
 # the dtype-hazard rule's provenance convention, plus the convert a
@@ -99,6 +114,7 @@ class CollectiveOverlap:
     wire_time_s: float
     hidden_fraction: float  # min(1, slack_time / wire_time)
     serialized: bool        # on the critical path (per configured floor)
+    fused: bool = False     # per-tile fused collective-matmul transport
 
 
 def _eqn_wire_bytes(eqn) -> int:
@@ -134,6 +150,16 @@ def _finalize(rec: CollectiveOverlap, cfg, carried: bool) -> None:
     # iteration's remaining compute — the double-buffer property
     rec.serialized = ((not carried) and
                       rec.hidden_fraction < cfg.overlap_min_hidden_fraction)
+
+
+def _finalize_fused(rec: CollectiveOverlap, cfg) -> None:
+    """A fused transport's hiddenness is structural (per-tile under the
+    GEMM), not slack-derived: full hidden fraction, never serialized.
+    The wire time still feeds the cost model's hidden-comm lane."""
+    rec.wire_time_s = (rec.wire_bytes / (cfg.hw_ici_gbps * 1e9)
+                       if cfg.hw_ici_gbps > 0 else 0.0)
+    rec.hidden_fraction = 1.0
+    rec.serialized = False
 
 
 def _analyze(jaxpr, cfg, target_label, _scope, _mult, _loop_depth):
@@ -207,9 +233,25 @@ def _analyze(jaxpr, cfg, target_label, _scope, _mult, _loop_depth):
                 chase.rec.slack_flops += flops
                 still_active.append(chase)
         active = still_active + started_here
-        if eqn.primitive.name in _WIRE_PRIMS:
+        prim = eqn.primitive.name
+        in_fcm = scope_has_component(scope, C.FCM_SCOPE)
+        if in_fcm and (prim in _WIRE_PRIMS
+                       or prim in _FCM_TRANSPORT_PRIMS):
+            # fused collective-matmul transport: the tile's wire is
+            # interleaved with the producer/consumer GEMM by
+            # construction (the op traces it per tile), so it is hidden
+            # as a static property — no chase; classified like carried
             rec = CollectiveOverlap(
-                prim=eqn.primitive.name, target=target_label,
+                prim=prim, target=target_label,
+                scope=scope, loop_depth=_loop_depth, mult=_mult,
+                wire_bytes=_eqn_wire_bytes(eqn), distance_eqns=0,
+                slack_flops=0, carried=False, wire_time_s=0.0,
+                hidden_fraction=0.0, serialized=False, fused=True)
+            _finalize_fused(rec, cfg)
+            records.append(rec)
+        elif prim in _WIRE_PRIMS:
+            rec = CollectiveOverlap(
+                prim=prim, target=target_label,
                 scope=scope, loop_depth=_loop_depth, mult=_mult,
                 wire_bytes=_eqn_wire_bytes(eqn), distance_eqns=0,
                 slack_flops=0, carried=False, wire_time_s=0.0,
@@ -265,6 +307,7 @@ def summarize_overlap(records: List[CollectiveOverlap]) -> Dict[str, Any]:
         "n_serialized_top_level": sum(
             1 for r in records if r.serialized and r.loop_depth == 0),
         "n_carried": sum(1 for r in records if r.carried),
+        "n_fused": sum(1 for r in records if r.fused),
         "records": [asdict(r) for r in records],
     }
 

@@ -10,6 +10,7 @@ a runtime counter and lives in analysis/recompile.py.
 from dataclasses import dataclass, field
 from typing import Any, List, Optional, Tuple
 
+from .. import constants as C
 from .findings import (Finding, RULE_COMM_BUDGET, RULE_DONATION,
                        RULE_DTYPE_HAZARD, RULE_HOST_SYNC, RULE_LOCKSTEP)
 from .jaxpr_walk import aval_bytes, as_jaxpr, iter_eqns
@@ -291,7 +292,12 @@ def _n_elems(aval) -> int:
 # families plus psum2 (what a psum traces to inside shard_map on jax
 # 0.4.x).  NOT signature.REDUCE_PRIMS: ppermute/pmax/pmin matter for
 # lockstep ordering but are excluded from wire volume, keeping this
-# comparable with collective_wire_bytes A/B numbers.
+# comparable with collective_wire_bytes A/B numbers.  One exception:
+# a ppermute traced inside the fused-collective-matmul scope
+# (constants.FCM_SCOPE, ops/collective_matmul.py) IS the qwZ/qgZ
+# payload riding a per-tile ring — those count operand bytes, so a
+# fused config's wire volume stays comparable with its modular twin
+# instead of reading as zero.
 _WIRE_GATHER_PRIMS = GATHER_PRIMS
 _WIRE_REDUCE_PRIMS = ("psum_scatter", "reduce_scatter", "all_to_all",
                       "psum", "psum2")
@@ -304,7 +310,8 @@ def step_wire_bytes(jaxpr) -> Tuple[int, List[Tuple[str, int]]]:
     which stays unweighted for same-structure A/B ratios).  cond
     branches contribute their MOST EXPENSIVE branch (only one executes),
     mirroring the flops counter."""
-    from .jaxpr_walk import as_jaxpr, eqn_scope, sub_jaxprs
+    from .jaxpr_walk import (as_jaxpr, eqn_scope, scope_has_component,
+                             sub_jaxprs)
     contributors: List[Tuple[str, int]] = []
 
     def walk(jx, scope, mult, out):
@@ -314,6 +321,11 @@ def step_wire_bytes(jaxpr) -> Tuple[int, List[Tuple[str, int]]]:
             if name in _WIRE_GATHER_PRIMS:
                 b = sum(aval_bytes(v) for v in eqn.outvars) * mult
             elif name in _WIRE_REDUCE_PRIMS:
+                b = sum(aval_bytes(v) for v in eqn.invars) * mult
+            elif (name == "ppermute" and scope_has_component(
+                    eqn_scope(eqn, scope), C.FCM_SCOPE)):
+                # fused collective-matmul ring hop: the quantized
+                # payload tile on the wire
                 b = sum(aval_bytes(v) for v in eqn.invars) * mult
             elif name == "cond":
                 probes = []

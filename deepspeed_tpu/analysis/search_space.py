@@ -7,9 +7,10 @@ stage 1/2/3 with the stage-3 resident-vs-streamed split and its
 prefetch mode + group size (docs/zero3_streaming.md), gas/micro splits
 of a FIXED global batch (the batch is a hyperparameter, its split is a
 schedule choice), the ZeRO++ transport knobs qwZ/qgZ/hpZ
-(docs/low_bandwidth_collectives.md), fused vs modular step
-(docs/fused_step.md), and the offload tier with its prefetch/pipeline
-depths (docs/zero_infinity.md).
+(docs/low_bandwidth_collectives.md), per-tile fused collective-matmul
+transports (docs/fused_collective_matmul.md — candidate names carry an
+``fcm`` tag), fused vs modular step (docs/fused_step.md), and the
+offload tier with its prefetch/pipeline depths (docs/zero_infinity.md).
 
 Enumeration is deterministic (nested loops in a documented order, names
 encode every knob) and GATED so the product only contains meaningful
@@ -102,7 +103,7 @@ def _deep_merge(dst: Dict[str, Any], overlay: Dict[str, Any]) -> None:
 
 def _candidate_name(stage, streamed, pmode, bucket, micro, gas, data,
                     model, expert, qwz, qgz, hpz, fused, offload,
-                    pdepth, odepth, multi_bucket) -> str:
+                    pdepth, odepth, multi_bucket, fcm=False) -> str:
     bits = [f"z{stage}" + ("s" if streamed else "")]
     if streamed:
         bits.append(pmode)
@@ -116,6 +117,8 @@ def _candidate_name(stage, streamed, pmode, bucket, micro, gas, data,
         bits.append(f"qgz{qgz}")
     if hpz:
         bits.append(f"hpz{hpz}")
+    if fcm:
+        bits.append("fcm")
     bits.append("fused" if fused else "mod")
     if offload == C.AUTOTUNING_OFFLOAD_TIER_NVME:
         # the depth axes only modulate the NVMe tier; the cpu tier has
@@ -129,7 +132,7 @@ def _candidate_name(stage, streamed, pmode, bucket, micro, gas, data,
 def _build_config(base: Dict[str, Any], *, stage, streamed, pmode,
                   bucket, micro, gas, data, model, expert, qwz, qgz,
                   hpz, fused, offload, pdepth, odepth,
-                  fixed) -> Dict[str, Any]:
+                  fixed, fcm=False) -> Dict[str, Any]:
     raw = copy.deepcopy(base)
     # candidates are bench-ready engine configs: the search description
     # itself must not ride along
@@ -163,6 +166,8 @@ def _build_config(base: Dict[str, Any], *, stage, streamed, pmode,
         lb[C.LOW_BANDWIDTH_QGZ_BITS] = qgz
     if hpz:
         lb[C.LOW_BANDWIDTH_HPZ_GROUP_SIZE] = hpz
+    if fcm:
+        lb[C.LOW_BANDWIDTH_FCM] = True
     if lb:
         zo[C.ZERO_OPTIMIZATION_LOW_BANDWIDTH] = lb
     if offload == C.AUTOTUNING_OFFLOAD_TIER_CPU:
@@ -265,13 +270,17 @@ def enumerate_candidates(base: Dict[str, Any], tune_cfg,
                 buckets = (tune_cfg.stage3_bucket_sizes if streamed
                            else (None,))
                 # qwZ/hpZ modulate the streamed stage-3 weight gathers;
-                # qgZ needs the stage >= 2 grad reduce-scatter
+                # qgZ needs the stage >= 2 grad reduce-scatter; the
+                # fused collective-matmul rides the streamed transports
                 qwzs = tune_cfg.qwz_bits if streamed else (0,)
                 hpzs = tuple(mesh_hpzs) if streamed else (0,)
                 qgzs = tune_cfg.qgz_bits if stage >= 2 else (0,)
-                for (pmode, bucket, micro_gas, qwz, qgz, hpz, offload
-                     ) in itertools.product(
-                        pmodes, buckets, splits, qwzs, qgzs, hpzs,
+                fcms = (tuple(sorted(set(
+                    tune_cfg.fused_collective_matmul)))
+                    if streamed else (False,))
+                for (pmode, bucket, micro_gas, qwz, qgz, hpz, fcm,
+                     offload) in itertools.product(
+                        pmodes, buckets, splits, qwzs, qgzs, hpzs, fcms,
                         tune_cfg.offload):
                     micro, gas = micro_gas
                     if (offload == C.AUTOTUNING_OFFLOAD_TIER_NVME
@@ -294,14 +303,16 @@ def enumerate_candidates(base: Dict[str, Any], tune_cfg,
                         name = _candidate_name(
                             stage, streamed, pmode, bucket, micro, gas,
                             data, model, expert, qwz, qgz, hpz, fused,
-                            offload, pdepth, odepth, multi_bucket)
+                            offload, pdepth, odepth, multi_bucket,
+                            fcm=fcm)
                         cfg = _build_config(
                             base, stage=stage, streamed=streamed,
                             pmode=pmode, bucket=bucket, micro=micro,
                             gas=gas, data=data, model=model,
                             expert=expert, qwz=qwz, qgz=qgz, hpz=hpz,
                             fused=fused, offload=offload, pdepth=pdepth,
-                            odepth=odepth, fixed=tune_cfg.fixed)
+                            odepth=odepth, fixed=tune_cfg.fixed,
+                            fcm=fcm)
                         import json as _json
                         key = _json.dumps(cfg, sort_keys=True)
                         if key in seen:
@@ -319,6 +330,7 @@ def enumerate_candidates(base: Dict[str, Any], tune_cfg,
                                          "expert": expert},
                                 "qwz_bits": qwz, "qgz_bits": qgz,
                                 "hpz_group_size": hpz,
+                                "fused_collective_matmul": bool(fcm),
                                 "fused_step": bool(fused),
                                 "offload": offload,
                                 "nvme_prefetch_depth": pdepth,

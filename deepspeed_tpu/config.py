@@ -186,16 +186,27 @@ class ZeroLowBandwidthConfig:
         partition (0/1 = off); must equal the product of a suffix of the
         ZeRO mesh axes (partition.resolve_hpz_axes).
     block_size: elements per quantization block (scale granularity).
+    fused_collective_matmul: T3-style per-tile fusion of the qwZ/qgZ
+        transports with the producer/consumer GEMM schedule
+        (ops/collective_matmul.py): the streamed-ZeRO-3 gathers and
+        grad scatters move tile-by-tile over a ring instead of as one
+        monolithic collective, and the Schedule Auditor classifies the
+        per-tile wire as fused/hidden.  Off by default.
     """
     qwz_bits: int = C.LOW_BANDWIDTH_QWZ_BITS_DEFAULT
     qgz_bits: int = C.LOW_BANDWIDTH_QGZ_BITS_DEFAULT
     hpz_group_size: int = C.LOW_BANDWIDTH_HPZ_GROUP_SIZE_DEFAULT
     block_size: int = C.LOW_BANDWIDTH_BLOCK_SIZE_DEFAULT
+    fused_collective_matmul: bool = C.LOW_BANDWIDTH_FCM_DEFAULT
 
     @property
     def enabled(self) -> bool:
+        # fused_collective_matmul alone engages the low-bandwidth
+        # context: the per-tile ring schedule applies at native width
+        # even with both quantizers off
         return bool(self.qwz_bits or self.qgz_bits or
-                    self.hpz_group_size > 1)
+                    self.hpz_group_size > 1 or
+                    self.fused_collective_matmul)
 
     @staticmethod
     def from_dict(d: Optional[Dict[str, Any]]) -> "ZeroLowBandwidthConfig":
@@ -211,6 +222,8 @@ class ZeroLowBandwidthConfig:
             block_size=int(get_scalar_param(
                 d, C.LOW_BANDWIDTH_BLOCK_SIZE,
                 C.LOW_BANDWIDTH_BLOCK_SIZE_DEFAULT)),
+            fused_collective_matmul=get_scalar_param(
+                d, C.LOW_BANDWIDTH_FCM, C.LOW_BANDWIDTH_FCM_DEFAULT),
         )
         for name, bits in ((C.LOW_BANDWIDTH_QWZ_BITS, cfg.qwz_bits),
                            (C.LOW_BANDWIDTH_QGZ_BITS, cfg.qgz_bits)):
@@ -222,6 +235,10 @@ class ZeroLowBandwidthConfig:
             raise DeepSpeedConfigError(
                 "zero_optimization.low_bandwidth.block_size must be >= 1, "
                 f"got {cfg.block_size}")
+        if not isinstance(cfg.fused_collective_matmul, bool):
+            raise DeepSpeedConfigError(
+                f"zero_optimization.low_bandwidth.{C.LOW_BANDWIDTH_FCM} "
+                f"must be a bool, got {cfg.fused_collective_matmul!r}")
         return cfg
 
 
@@ -863,6 +880,7 @@ class AutotuningConfig:
     qgz_bits: tuple = C.AUTOTUNING_QGZ_BITS_DEFAULT
     hpz_group_sizes: tuple = C.AUTOTUNING_HPZ_GROUP_SIZES_DEFAULT
     fused: tuple = C.AUTOTUNING_FUSED_DEFAULT
+    fused_collective_matmul: tuple = C.AUTOTUNING_FCM_DEFAULT
     offload: tuple = C.AUTOTUNING_OFFLOAD_TIERS_DEFAULT
     nvme_prefetch_depths: tuple = C.AUTOTUNING_NVME_PREFETCH_DEPTHS_DEFAULT
     opt_pipeline_depths: tuple = C.AUTOTUNING_OPT_PIPELINE_DEPTHS_DEFAULT
@@ -918,6 +936,8 @@ class AutotuningConfig:
                 C.AUTOTUNING_HPZ_GROUP_SIZES_DEFAULT), int),
             fused=_as_tuple(d.get(C.AUTOTUNING_FUSED,
                                   C.AUTOTUNING_FUSED_DEFAULT), bool),
+            fused_collective_matmul=_as_tuple(
+                d.get(C.AUTOTUNING_FCM, C.AUTOTUNING_FCM_DEFAULT), bool),
             offload=_as_tuple(d.get(C.AUTOTUNING_OFFLOAD_TIERS,
                                     C.AUTOTUNING_OFFLOAD_TIERS_DEFAULT),
                               str),

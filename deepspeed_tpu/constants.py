@@ -263,6 +263,8 @@ AUTOTUNING_HPZ_GROUP_SIZES = "hpz_group_sizes"
 AUTOTUNING_HPZ_GROUP_SIZES_DEFAULT = (0,)
 AUTOTUNING_FUSED = "fused"
 AUTOTUNING_FUSED_DEFAULT = (False,)
+AUTOTUNING_FCM = "fused_collective_matmul"
+AUTOTUNING_FCM_DEFAULT = (False,)
 AUTOTUNING_OFFLOAD_TIERS = "offload"
 AUTOTUNING_OFFLOAD_TIER_NONE = "none"
 AUTOTUNING_OFFLOAD_TIER_CPU = "cpu"
@@ -480,6 +482,18 @@ LOW_BANDWIDTH_HPZ_GROUP_SIZE = "hpz_group_size"  # secondary-partition size
 LOW_BANDWIDTH_HPZ_GROUP_SIZE_DEFAULT = 0
 LOW_BANDWIDTH_BLOCK_SIZE = "block_size"        # quantization block elements
 LOW_BANDWIDTH_BLOCK_SIZE_DEFAULT = 256
+# T3-style fused collective-matmul (ops/collective_matmul.py,
+# docs/fused_collective_matmul.md): the qwZ/qgZ transports move per-TILE
+# (quantized shard tiles ride a ring as the producer/consumer GEMM's
+# tiles complete) instead of as one monolithic collective
+LOW_BANDWIDTH_FCM = "fused_collective_matmul"
+LOW_BANDWIDTH_FCM_DEFAULT = False
+# name-scope marker the fused collective-matmul ops trace under; the
+# Schedule Auditor's overlap classifier (analysis/overlap.py) reads it
+# off eqn name stacks to classify the per-tile transports as
+# fused/hidden — single-sourced here so the op and the analyzer can
+# never disagree on the spelling
+FCM_SCOPE = "fcm_fused"
 
 #############################################
 # Offload (reference: runtime/zero/offload_constants.py)

@@ -498,8 +498,13 @@ def flash_attention_pallas(q, k, v, causal: bool = False,
     nq, nk = q_len // block_q, k_len // block_k
     if dropout_rate > 0.0 and interpret:
         raise ValueError(
-            "in-kernel dropout needs the TPU PRNG (pltpu.prng_seed has no "
-            "CPU lowering) — interpret-mode callers must use rate 0")
+            f"in-kernel dropout (dropout_rate={dropout_rate}) needs the "
+            "TPU PRNG — pltpu.prng_seed has no CPU lowering, so "
+            "interpret mode cannot generate the mask.  Fix: call with "
+            "dropout_rate=0 (parity tests compare the dropout-free "
+            "kernel), or take the XLA path — flash_attention("
+            "impl='xla') / mha_reference — whose jax.random dropout "
+            "runs on any backend")
     seed = _seed_arg(dropout_seed)
 
     if save_dropout_mask:
@@ -752,8 +757,13 @@ def flash_attention_bwd_pallas(q, k, v, out, lse, do, causal: bool = False,
         # — the unpack is plain vector ops, so interpret mode is legal
         # there (and is how the CPU lane tests the reuse numerics)
         raise ValueError(
-            "in-kernel dropout needs the TPU PRNG (pltpu.prng_seed has no "
-            "CPU lowering) — interpret-mode callers must use rate 0")
+            f"in-kernel dropout (dropout_rate={dropout_rate}) needs the "
+            "TPU PRNG — pltpu.prng_seed has no CPU lowering, so the "
+            "interpret-mode backward cannot regenerate the mask.  Fix: "
+            "call with dropout_rate=0, pass the forward's saved "
+            "dropout_mask (save_dropout_mask / set_dropout_mask_reuse("
+            "True) — the bit-unpack needs no PRNG), or take the XLA "
+            "path (flash_attention(impl='xla') / mha_reference)")
     seed = _seed_arg(dropout_seed)
 
     # delta_i = rowsum(dO_i * O_i)  (cheap elementwise; leave to XLA).

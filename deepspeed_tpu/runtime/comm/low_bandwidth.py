@@ -370,12 +370,17 @@ def collective_wire_bytes(jaxpr) -> dict:
     dispatcher in analysis/jaxpr_walk.py — and sum an approximate wire
     volume per collective family: output bytes for gathers (the payload
     that landed), operand bytes for reductions/all-to-alls (the payload
-    that left).  Loop trip counts are NOT multiplied in, so use this for
+    that left), plus ``fcm_bytes`` for per-tile fused-collective-matmul
+    ring hops (ppermutes traced under constants.FCM_SCOPE — a generic
+    ppermute stays excluded, ring attention's hops are lockstep-only).
+    Loop trip counts are NOT multiplied in, so use this for
     same-structure A/B ratios (quantized vs fp32 path), not absolute
     traffic — the Program Auditor's comm-budget lint
     (analysis/rules.py:step_wire_bytes) does the trip-weighted version."""
-    from ...analysis.jaxpr_walk import aval_bytes, iter_eqns
-    out = {"gather_bytes": 0, "reduce_bytes": 0}
+    from ... import constants as _C
+    from ...analysis.jaxpr_walk import (aval_bytes, iter_eqns,
+                                        scope_has_component)
+    out = {"gather_bytes": 0, "reduce_bytes": 0, "fcm_bytes": 0}
     for ctx in iter_eqns(jaxpr):
         name = ctx.eqn.primitive.name
         if name in _GATHER_PRIMS:
@@ -384,4 +389,8 @@ def collective_wire_bytes(jaxpr) -> dict:
         elif name in _REDUCE_PRIMS:
             out["reduce_bytes"] += sum(aval_bytes(v)
                                        for v in ctx.eqn.invars)
+        elif name == "ppermute" and scope_has_component(ctx.scope,
+                                                        _C.FCM_SCOPE):
+            out["fcm_bytes"] += sum(aval_bytes(v)
+                                    for v in ctx.eqn.invars)
     return out

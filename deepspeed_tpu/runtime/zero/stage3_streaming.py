@@ -69,6 +69,7 @@ from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec
 
 from ...constants import ZERO_OPTIMIZATION_PREFETCH_MODES as PREFETCH_MODES
+from ...ops.collective_matmul import fcm_all_gather, fcm_reduce_scatter
 from ...parallel.mesh import MeshContext, ZERO_AXES
 from ...utils.logging import log_dist
 from ..comm.low_bandwidth import (f32_psum_scatter, largest_divisor_at_most,
@@ -489,6 +490,13 @@ class Zero3StreamContext:
         # the hot-loop gathers to a sub-mesh via a secondary partition.
         self.lbc = (low_bandwidth if low_bandwidth is not None and
                     getattr(low_bandwidth, "enabled", False) else None)
+        # T3-style fused collective-matmul (ops/collective_matmul.py):
+        # the qwZ/qgZ transports move per-tile over a ring instead of as
+        # one monolithic collective — the Schedule Auditor classifies
+        # the per-tile wire as fused/hidden (docs/fused_collective_
+        # matmul.md)
+        self.fcm = bool(self.lbc is not None and getattr(
+            self.lbc, "fused_collective_matmul", False))
         self.param_manual = self.manual
         self.param_axis_sizes = dict(self.axis_sizes)
         # last StreamPlan actually applied by scan() — set during
@@ -595,8 +603,15 @@ class Zero3StreamContext:
     def _gather_leaf(self, leaf, axes, dim):
         """One tiled all-gather: quantized wire per direction when it
         pays (``_leaf_wire_bits``), the fp32-transpose gather
-        otherwise."""
+        otherwise.  With ``fused_collective_matmul`` on, float leaves
+        route through the per-tile ring transport instead — bitwise the
+        same values, but the wire moves tile-by-tile under the
+        consuming compute and classifies as fused/hidden in the
+        Schedule Auditor's overlap report."""
         qwz, qgz = self._leaf_wire_bits(leaf, dim)
+        if self.fcm and jnp.issubdtype(leaf.dtype, jnp.floating):
+            return fcm_all_gather(leaf, axes, dim, qwz, qgz,
+                                  self.lbc.block_size)
         if qwz or qgz:
             return low_bandwidth_all_gather(leaf, axes, dim, qwz, qgz,
                                             self.lbc.block_size)
@@ -679,7 +694,8 @@ class Zero3StreamContext:
                 hpz = (sorted(self.param_manual)
                        if self.lbc.hpz_group_size > 1 else "off")
                 lb = (f", low_bandwidth: qwz={self.lbc.qwz_bits}b "
-                      f"qgz={self.lbc.qgz_bits}b hpz={hpz}")
+                      f"qgz={self.lbc.qgz_bits}b hpz={hpz}"
+                      f"{' fcm' if self.fcm else ''}")
             log_dist(
                 f"ZeRO-3 streaming: {plan.num_layers} layers in groups of "
                 f"{plan.layers_per_step}, prefetch={plan.prefetch} "
@@ -820,15 +836,25 @@ class Zero3StreamContext:
                     gathers[k])
                 for k in range(len(p_leaves))]
 
+            fcm = self.fcm
+
             def scatter_grads(g_full):
                 out = []
                 for gk, plan_k, w in zip(g_full, transpose_plans, widen):
                     if w:  # transpose of gather_group's cast-back to dt
                         gk = gk.astype(jnp.float32)
                     for d, axes, qgz in reversed(plan_k):
-                        gk = (quantized_psum_scatter(gk, axes, d,
-                                                     bits=qgz, block=block)
-                              if qgz else f32_psum_scatter(gk, axes, d))
+                        if fcm and jnp.issubdtype(gk.dtype, jnp.floating):
+                            # per-tile ring scatter: the backward GEMM's
+                            # epilogue wire, classified fused/hidden
+                            gk = fcm_reduce_scatter(gk, axes, d,
+                                                    bits=qgz, block=block)
+                        elif qgz:
+                            gk = quantized_psum_scatter(gk, axes, d,
+                                                        bits=qgz,
+                                                        block=block)
+                        else:
+                            gk = f32_psum_scatter(gk, axes, d)
                     out.append(gk)
                 return out
 

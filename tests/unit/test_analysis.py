@@ -39,9 +39,13 @@ GOLDEN_STREAM = (REPO / "tests" / "unit" / "golden" /
                  "gpt2_zero3_stream_schedule.json")
 GOLDEN_STREAM_SERIALIZED = (REPO / "tests" / "unit" / "golden" /
                             "gpt2_zero3_stream_schedule_serialized.json")
+GOLDEN_STREAM_FCM = (REPO / "tests" / "unit" / "golden" /
+                     "gpt2_zero3_stream_fcm_schedule.json")
 EXAMPLE_CFG = REPO / "docs" / "examples" / "gpt2_analysis.json"
 EXAMPLE_STREAM_CFG = (REPO / "docs" / "examples" /
                       "gpt2_zero3_stream_analysis.json")
+EXAMPLE_FCM_CFG = (REPO / "docs" / "examples" /
+                   "gpt2_zero3_stream_fcm.json")
 
 
 def _cfg(**kw) -> AnalysisConfig:
@@ -934,6 +938,7 @@ def test_ci_gate_examples_error_mode(capsys):
     from deepspeed_tpu.analysis.cli import main as cli_main
     examples = sorted((REPO / "docs" / "examples").glob("*.json"))
     assert EXAMPLE_CFG in examples and EXAMPLE_STREAM_CFG in examples
+    assert EXAMPLE_FCM_CFG in examples
     golden_stream = json.loads(GOLDEN_STREAM.read_text())
     for cfg_path in examples:
         ds.reset_mesh_context()
@@ -971,6 +976,30 @@ def test_ci_gate_examples_error_mode(capsys):
             assert (payload["overlap_efficiency"]
                     > serialized["overlap"]["overlap_efficiency"])
             assert payload["findings"] == []
+        if cfg_path == EXAMPLE_FCM_CFG:
+            # the fused-collective-matmul schedule is pinned by its
+            # golden: every hot-loop qwZ/qgZ wire-mover classifies
+            # fused/hidden, ZERO exposed hot-loop bytes — the ISSUE 13
+            # acceptance bar (exposed-comm lane ~ 0), enforced here
+            # under the config's own require_overlap + mode=error
+            golden_fcm = json.loads(GOLDEN_STREAM_FCM.read_text())
+            assert payload["signature"] == golden_fcm["signature"]
+            assert (len(payload["collective_sequence"])
+                    == golden_fcm["collective_count"])
+            ovf = golden_fcm["overlap"]
+            assert payload["overlap"]["n_serialized_hot_loop"] == 0
+            assert (payload["overlap"]["n_fused"]
+                    == ovf["n_fused"] > 0)
+            exposed_hot = sum(
+                int(r["wire_bytes"] * r["mult"]
+                    * (1.0 - r["hidden_fraction"]))
+                for r in payload["overlap"]["records"]
+                if r["loop_depth"] > 0)
+            assert exposed_hot == 0
+            assert golden_fcm["wire_bytes_exposed_hot_loop"] == 0
+            assert (payload["step_time"]["wire_bytes_fused"]
+                    == golden_fcm["wire_bytes_fused"] > 0)
+            assert payload["findings"] == []
 
 
 @pytest.mark.slow
@@ -980,7 +1009,8 @@ def test_cli_update_golden_regenerates_checked_in_files(tmp_path):
     env_dir = str(tmp_path / "golden")
     for cfg_path, golden_path, extra in (
             (EXAMPLE_CFG, GOLDEN, ()),
-            (EXAMPLE_STREAM_CFG, GOLDEN_STREAM, ("--devices", "8"))):
+            (EXAMPLE_STREAM_CFG, GOLDEN_STREAM, ("--devices", "8")),
+            (EXAMPLE_FCM_CFG, GOLDEN_STREAM_FCM, ("--devices", "8"))):
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env["DS_ANALYSIS_GOLDEN_DIR"] = env_dir
@@ -1009,3 +1039,9 @@ def test_cli_update_golden_unknown_config_errors(tmp_path):
     payload2 = _golden_payload("gpt2_zero3_stream_schedule.json", rep)
     assert set(payload2) == {"_comment", "signature", "collective_count",
                              "overlap"}
+    payload3 = _golden_payload("gpt2_zero3_stream_fcm_schedule.json",
+                               rep)
+    assert set(payload3) == {"_comment", "signature", "collective_count",
+                             "overlap", "wire_bytes_exposed_hot_loop",
+                             "wire_bytes_fused"}
+    assert "n_fused" in payload3["overlap"]

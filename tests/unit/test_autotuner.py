@@ -209,6 +209,40 @@ def test_qwz_never_increases_wire_bytes(example_outcome):
     assert pairs >= 4  # the example space carries 4 qwz pairs
 
 
+def test_fcm_never_increases_wire_bytes():
+    """ISSUE 13 satellite: enabling fused_collective_matmul must never
+    INCREASE the predicted wire bytes of the otherwise-identical
+    candidate — the per-tile ring moves (W-1)/W of the monolithic
+    gather payload (and the fused hops ARE accounted: step_wire_bytes
+    counts FCM-scoped ppermutes), while the fused classification moves
+    the bytes to the hidden-comm lane."""
+    outcome = _search({
+        "zero_stages": [3], "stage3_variants": ["streamed"],
+        "prefetch_modes": ["carried"], "micro_batches": [2],
+        "qwz_bits": [8], "qgz_bits": [8],
+        "fused_collective_matmul": [False, True], "top_k": 2})
+    by_name = {rc.candidate.name: rc for rc in outcome.ranked}
+    pairs = 0
+    for name, rc in by_name.items():
+        if "-fcm-" not in name:
+            continue
+        twin = by_name.get(name.replace("-fcm-", "-"))
+        assert twin is not None, f"no fcm-off twin for {name}"
+        assert rc.candidate.knobs["fused_collective_matmul"] is True
+        assert twin.candidate.knobs["fused_collective_matmul"] is False
+        assert (rc.report.wire_bytes_per_step
+                <= twin.report.wire_bytes_per_step), (
+            f"{name} moved MORE wire than its modular twin")
+        # the fused candidate's hot wire prices hidden: its exposed-comm
+        # lane must not exceed the modular twin's
+        assert (rc.report.step_time["t_comm_exposed_s"]
+                <= twin.report.step_time["t_comm_exposed_s"] + 1e-12)
+        assert rc.report.step_time["wire_bytes_fused"] > 0
+        assert twin.report.step_time["wire_bytes_fused"] == 0
+        pairs += 1
+    assert pairs >= 1
+
+
 def test_shrinking_hbm_budget_never_adds_candidates(example_outcome):
     """Budget monotonicity, both pruning layers.  Traced layer: a full
     search under a mid budget must survive a strict SUBSET of the

@@ -46,6 +46,9 @@ def test_resilience_package_imports_cleanly():
             # subcommands and bench.py's autotune ladder row
             "deepspeed_tpu.analysis.search_space",
             "deepspeed_tpu.analysis.autotuner",
+            # fused collective-matmul kernels: lazily reachable through
+            # the streaming context's fcm routing and the bench fcm row
+            "deepspeed_tpu.ops.collective_matmul",
             # telemetry monitor: lazily imported by the engines (only
             # when the monitor block is on)
             "deepspeed_tpu.monitor",

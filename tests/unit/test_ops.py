@@ -38,6 +38,32 @@ def test_flash_attention_pallas_matches_reference(causal):
                                rtol=1e-5, atol=1e-5)
 
 
+def test_interpret_mode_dropout_error_is_actionable():
+    """ISSUE 13 satellite: the interpret-mode dropout refusal must name
+    the knob and the workarounds (rate 0 / impl='xla' / the saved
+    dropout_mask for the backward), not just state the PRNG limitation."""
+    from deepspeed_tpu.ops.flash_attention import flash_attention_bwd_pallas
+    q, k, v = _qkv()
+    with pytest.raises(ValueError) as ei:
+        flash_attention_pallas(q, k, v, block_q=64, block_k=64,
+                               interpret=True, dropout_rate=0.1,
+                               dropout_seed=0)
+    msg = str(ei.value)
+    assert "dropout_rate=0" in msg and "impl='xla'" in msg
+    assert "pltpu.prng_seed" in msg  # still explains WHY
+
+    out, lse = flash_attention_pallas(q, k, v, block_q=64, block_k=64,
+                                      interpret=True, return_lse=True)
+    do = jnp.ones_like(q)
+    with pytest.raises(ValueError) as ei:
+        flash_attention_bwd_pallas(q, k, v, out, lse, do, block_q=64,
+                                   block_k=64, interpret=True,
+                                   dropout_rate=0.1, dropout_seed=0)
+    msg = str(ei.value)
+    assert "dropout_rate=0" in msg and "dropout_mask" in msg
+    assert "set_dropout_mask_reuse" in msg
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_attention_bwd_pallas_matches_reference(causal):
     from deepspeed_tpu.ops.flash_attention import flash_attention_bwd_pallas
