@@ -341,6 +341,25 @@ def _program_audit_fields(engine, measured_step_s=None):
             "predicted_step_time_lb": (round(lb, 6)
                                        if lb is not None else None),
         })
+        if report.hlo:
+            # HLO-level SPMD cross-check (analysis/hlo_audit.py, round
+            # 18; runs when analysis.hlo_audit is on): the row carries
+            # the compiled program's wire story next to the jaxpr's, so
+            # a divergence regression is diffable from the row JSON
+            ratio = report.hlo_divergence_ratio
+            if ratio is not None:
+                # "inf" as a string: json.dumps would emit the bare
+                # token `Infinity`, which is not RFC-8259 JSON and
+                # breaks non-Python consumers of the JSONL ladder
+                # (matches cli.py's golden-payload spelling)
+                ratio = ("inf" if ratio == float("inf")
+                         else round(ratio, 4))
+            out.update({
+                "hlo_wire_bytes_per_step": report.hlo_wire_bytes_per_step,
+                "hlo_collective_count": report.hlo_collective_count,
+                "hlo_divergence_ratio": ratio,
+                "n_silent_reshards": report.hlo["n_silent_reshards"],
+            })
         if measured_step_s is not None and report.step_time is not None:
             out["reconciliation"] = _reconciliation_summary(
                 report, measured_step_s)

@@ -15,7 +15,11 @@ from .cost_model import (build_step_time_model, per_lane_predictions,
 from .findings import (ALL_RULES, AuditReport, Finding, ProgramAuditError,
                        RULE_COMM_BUDGET, RULE_DONATION, RULE_DTYPE_HAZARD,
                        RULE_HBM_BUDGET, RULE_HOST_SYNC, RULE_LOCKSTEP,
-                       RULE_OVERLAP, RULE_RECOMPILE)
+                       RULE_OVERLAP, RULE_RECOMPILE, RULE_SILENT_RESHARD,
+                       RULE_SPMD_DIVERGENCE)
+from .hlo_audit import (HloCollective, HloProgram, HloTargetAudit,
+                        SpmdWaiver, audit_target_hlo, summarize_hlo,
+                        walk_hlo_collectives)
 from .jaxpr_walk import (EqnCtx, SubJaxpr, as_jaxpr, aval_bytes,
                          eqn_scope, iter_eqns, sub_jaxprs)
 from .liveness import LivenessReport, estimate_liveness
@@ -30,11 +34,14 @@ from .signature import (collective_sequence, combine_signatures,
 
 __all__ = [
     "ALL_RULES", "ArgInfo", "AuditReport", "AuditTarget",
-    "CollectiveOverlap", "EqnCtx", "Finding", "LivenessReport",
+    "CollectiveOverlap", "EqnCtx", "Finding", "HloCollective",
+    "HloProgram", "HloTargetAudit", "LivenessReport",
     "ProgramAuditError", "ProgramAuditor", "RecompileGuard",
     "RULE_COMM_BUDGET", "RULE_DONATION", "RULE_DTYPE_HAZARD",
     "RULE_HBM_BUDGET", "RULE_HOST_SYNC", "RULE_LOCKSTEP", "RULE_OVERLAP",
-    "RULE_RECOMPILE", "STATIC_RULES",
+    "RULE_RECOMPILE", "RULE_SILENT_RESHARD", "RULE_SPMD_DIVERGENCE",
+    "SpmdWaiver", "STATIC_RULES", "audit_target_hlo", "summarize_hlo",
+    "walk_hlo_collectives",
     "SubJaxpr", "analyze_overlap", "as_jaxpr", "audit_engine",
     "aval_bytes",
     "batch_signature", "build_step_time_model", "collective_sequence",

@@ -22,6 +22,7 @@ import numpy as np
 
 from .cost_model import build_step_time_model, program_io_bytes
 from .findings import AuditReport, Finding, ProgramAuditError
+from .hlo_audit import SpmdWaiver, audit_target_hlo, summarize_hlo
 from .liveness import estimate_liveness, hbm_budget_finding
 from .overlap import (analyze_overlap, overlap_efficiency,
                       overlap_rule_findings, summarize_overlap)
@@ -121,6 +122,48 @@ def synthesize_sample_batch(engine) -> Optional[Tuple]:
     return (jax.ShapeDtypeStruct((batch, int(seq)), np.int32),)
 
 
+def _sharded_batch_structs(engine, sample_batch, stacked: bool):
+    """ShapeDtypeStructs carrying the shardings ``_shard_batch`` /
+    ``_shard_stacked_batch`` would place — the HLO audit must compile
+    the program TRAINING dispatches, and in/out shardings are part of
+    what the SPMD partitioner sees (an unsharded probe batch would
+    audit a different partitioning)."""
+    import jax
+    dp = engine.world_size
+    batch_dim = 1 if stacked else 0
+    data = (engine.mesh_ctx.sharding(
+        *([None] * batch_dim),
+        ("data", "expert")) if dp > 1 else engine.mesh_ctx.replicated())
+    rep = engine.mesh_ctx.replicated()
+
+    def place(s):
+        fits = (len(s.shape) > batch_dim
+                and s.shape[batch_dim] % dp == 0)
+        return jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                    sharding=data if fits else rep)
+    return tuple(place(s) for s in sample_batch)
+
+
+def _engine_spmd_waivers(engine, kind: str) -> Tuple[SpmdWaiver, ...]:
+    """Compiler-inserted gather wire the engine's sharding contract
+    PREDICTS, so the HLO cross-check can tell it from a silent reshard:
+    ZeRO stage >= 1 re-gathers the updated params at the optimizer
+    boundary (the apply program's GSPMD all-gathers ARE the DeepSpeed
+    wire model), and stage-3 leaves outside the explicit streamed path
+    are gathered at use in forward and backward."""
+    stage = engine.config.zero_config.stage
+    pbytes = _tree_bytes(engine.params)
+    slack = pbytes // 4 + (1 << 20)
+    waivers = []
+    if kind in ("apply", "fused") and stage >= 1:
+        waivers.append(SpmdWaiver("zero_param_regather", pbytes + slack,
+                                  ("all-gather",)))
+    if kind in ("grad", "fused") and stage >= 3:
+        waivers.append(SpmdWaiver("zero3_param_gather_at_use",
+                                  2 * pbytes + slack, ("all-gather",)))
+    return tuple(waivers)
+
+
 def engine_targets(engine, sample_batch: Optional[Tuple] = None
                    ) -> List[AuditTarget]:
     """Trace the engine's step program(s) abstractly.
@@ -167,10 +210,17 @@ def engine_targets(engine, sample_batch: Optional[Tuple] = None
                 (2 in donated, "scaler_state"),
                 (3 in donated, "sentinel_state"),
                 (False, "rng"), (False, "batch"), (False, "kwargs")])
+            sharded_stacked = _sharded_batch_structs(engine, stacked,
+                                                     stacked=True)
             targets.append(AuditTarget(
                 "fused_step", closed, args,
                 donated_invars=donated_invars, invar_labels=labels,
-                scan_info=_engine_scan_info(engine)))
+                scan_info=_engine_scan_info(engine),
+                lower=lambda: engine._fused_step_fn.lower(
+                    engine.params, engine.opt_state, engine.scaler_state,
+                    engine._fused_sent_state, engine._rng,
+                    sharded_stacked, {}).compile().as_text(),
+                spmd_waivers=_engine_spmd_waivers(engine, "fused")))
         return targets
 
     if sample_batch is not None:
@@ -190,11 +240,17 @@ def engine_targets(engine, sample_batch: Optional[Tuple] = None
             [(False, "params"), (False, "scaler_state"),
              (False, "rng"), (False, "batch")])
         # opt_state sits in HBM while the grad program runs
+        sharded_batch = _sharded_batch_structs(engine, sample_batch,
+                                               stacked=False)
         targets.append(AuditTarget(
             "grad_step", closed, args,
             donated_invars=donated_invars, invar_labels=labels,
             resident_extra_bytes=_tree_bytes(engine.opt_state),
-            scan_info=_engine_scan_info(engine)))
+            scan_info=_engine_scan_info(engine),
+            lower=lambda: engine._grad_fn.lower(
+                engine.params, engine.scaler_state, engine._rng,
+                *sharded_batch).compile().as_text(),
+            spmd_waivers=_engine_spmd_waivers(engine, "grad")))
 
     if engine._apply_core is not None:
         grads = _grads_template(engine)
@@ -216,10 +272,22 @@ def engine_targets(engine, sample_batch: Optional[Tuple] = None
              grads),
             [(0 in donated, "params"), (1 in donated, "opt_state"),
              (2 in donated, "scaler_state"), (3 in donated, "grads")])
+        grads_sharded = None
+        if engine._apply_fn is not None and engine.grad_shardings is not None:
+            grads_sharded = jax.tree.map(
+                lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                                   sharding=sh),
+                grads, engine.grad_shardings)
         targets.append(AuditTarget(
             "apply_step", closed, args,
             donated_invars=donated_invars, invar_labels=labels,
-            scan_info=_engine_scan_info(engine)))
+            scan_info=_engine_scan_info(engine),
+            lower=(None if grads_sharded is None else
+                   lambda: engine._apply_fn.lower(
+                       engine.params, engine.opt_state,
+                       engine.scaler_state,
+                       grads_sharded).compile().as_text()),
+            spmd_waivers=_engine_spmd_waivers(engine, "apply")))
     return targets
 
 
@@ -230,12 +298,16 @@ class ProgramAuditor:
         self.cfg = cfg
 
     def run(self, targets: List[AuditTarget], gas: int = 1,
-            swap=None) -> AuditReport:
+            swap=None, hlo: bool = False) -> AuditReport:
         """``swap`` is an optional offload-tier traffic model
         (cost_model.swap_lane) folded into the step-time lower bound —
         a config streaming params/optimizer state from NVMe must not
-        rank as if they were HBM-resident."""
+        rank as if they were HBM-resident.  ``hlo`` additionally lowers
+        each target through XLA's SPMD partitioner (compile-only) and
+        cross-checks the jaxpr wire story against the compiled program
+        (analysis/hlo_audit.py)."""
         report = AuditReport(targets=[t.label for t in targets])
+        hlo_audits = []
         for target in targets:
             for _rule_id, rule in STATIC_RULES:
                 report.findings.extend(rule(target, self.cfg))
@@ -257,6 +329,11 @@ class ProgramAuditor:
             report.wire_bytes_per_step += total * repeat
             contributors.extend((f"{target.label}:{k}", v * repeat)
                                 for k, v in contrib)
+            if hlo:
+                hlo_audit, hlo_findings = audit_target_hlo(
+                    target, self.cfg, jaxpr_wire_bytes=total)
+                hlo_audits.append((hlo_audit, repeat))
+                report.findings.extend(hlo_findings)
             # ---- schedule-level analyses -------------------------- #
             records = analyze_overlap(target.closed_jaxpr, self.cfg,
                                       target_label=target.label)
@@ -297,8 +374,16 @@ class ProgramAuditor:
             report.findings.extend(hbm_budget_finding(
                 liveness.total_bytes, label,
                 report.peak_hbm_contributors, self.cfg))
+        if hlo_audits:
+            report.hlo = summarize_hlo(hlo_audits)
+        # HLO-only wire (compiler-inserted collectives plus traced wire
+        # outside the jaxpr accounting) prices into the exposed-comm
+        # lane: predicted_step_time_lb must not undercount what the
+        # compiled program actually moves
         report.step_time = build_step_time_model(
-            total_flops, io_bytes, all_records, self.cfg, swap=swap)
+            total_flops, io_bytes, all_records, self.cfg, swap=swap,
+            hlo_only_wire_bytes=report.hlo.get(
+                "hlo_only_wire_bytes_per_step", 0))
         return report
 
 
@@ -347,13 +432,19 @@ def engine_swap_lane(engine, swap=None):
 
 def audit_engine(engine, sample_batch: Optional[Tuple] = None,
                  cfg=None, multihost: bool = True,
-                 swap=None) -> AuditReport:
-    """Full static audit of a built engine.  Never executes the step."""
+                 swap=None, hlo: Optional[bool] = None) -> AuditReport:
+    """Full static audit of a built engine.  Never executes the step.
+
+    ``hlo`` forces the HLO-level SPMD cross-check on (True) or off
+    (False); None follows ``analysis.hlo_audit``.  The cross-check
+    compiles each program through the SPMD partitioner — meaningful
+    extra init cost, so it stays opt-in."""
     cfg = cfg if cfg is not None else engine.config.analysis_config
     targets = engine_targets(engine, sample_batch)
     report = ProgramAuditor(cfg).run(
         targets, gas=engine.gradient_accumulation_steps(),
-        swap=engine_swap_lane(engine, swap))
+        swap=engine_swap_lane(engine, swap),
+        hlo=cfg.hlo_audit if hlo is None else hlo)
     if multihost:
         report.findings.extend(verify_multihost_lockstep(report))
     return report

@@ -151,7 +151,8 @@ def swap_lane(zero_cfg, aio_cfg, param_bytes: int,
 def build_step_time_model(total_flops: int, io_bytes: int,
                           records: List[CollectiveOverlap],
                           cfg,
-                          swap: Optional[Dict[str, Any]] = None
+                          swap: Optional[Dict[str, Any]] = None,
+                          hlo_only_wire_bytes: int = 0
                           ) -> Dict[str, Any]:
     """Combine the roofline terms into the report payload.
 
@@ -159,7 +160,12 @@ def build_step_time_model(total_flops: int, io_bytes: int,
     repeats the modular grad program's records gas times, matching the
     wire-byte accounting).  ``swap`` is an optional offload-tier traffic
     model (``swap_lane``): its hidden time joins the max() roofline, its
-    exposed time is added on top like exposed comm."""
+    exposed time is added on top like exposed comm.
+    ``hlo_only_wire_bytes`` is per-step wire the HLO-level SPMD audit
+    found that the jaxpr accounting never saw (compiler-inserted
+    collectives; analysis/hlo_audit.py) — no overlap record exists for
+    it, so it prices fully EXPOSED: the lower bound must stop
+    undercounting the compiled program's wire."""
     peak_flops_s = cfg.hw_peak_tflops * 1e12
     hbm_bw = cfg.hw_hbm_gbps * 1e9
     wire_bw = cfg.hw_ici_gbps * 1e9
@@ -177,7 +183,7 @@ def build_step_time_model(total_flops: int, io_bytes: int,
     fused_bytes = sum(r.wire_bytes * r.mult for r in records
                       if getattr(r, "fused", False))
     t_hidden = hidden_bytes / wire_bw
-    t_exposed = exposed_bytes / wire_bw
+    t_exposed = (exposed_bytes + hlo_only_wire_bytes) / wire_bw
     t_swap_hidden = float(swap["t_hidden_s"]) if swap else 0.0
     t_swap_exposed = float(swap["t_exposed_s"]) if swap else 0.0
 
@@ -191,6 +197,7 @@ def build_step_time_model(total_flops: int, io_bytes: int,
         "wire_bytes_hidden": int(hidden_bytes),
         "wire_bytes_exposed": int(exposed_bytes),
         "wire_bytes_fused": int(fused_bytes),
+        "wire_bytes_hlo_only": int(hlo_only_wire_bytes),
         "t_compute_s": t_compute,
         "t_memory_s": t_memory,
         "t_comm_hidden_s": t_hidden,

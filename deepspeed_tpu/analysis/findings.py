@@ -22,10 +22,16 @@ RULE_RECOMPILE = "recompile"
 # schedule-level rules (overlap / liveness / step-time; ISSUE 6)
 RULE_OVERLAP = "overlap"
 RULE_HBM_BUDGET = "hbm_budget"
+# HLO-level SPMD cross-check (analysis/hlo_audit.py; ISSUE 14):
+# compiler-inserted gather-family collectives the jaxpr never saw, and
+# jaxpr-predicted vs HLO-measured wire drift on the traced ones
+RULE_SILENT_RESHARD = "silent_reshard"
+RULE_SPMD_DIVERGENCE = "spmd_divergence"
 
 ALL_RULES = (RULE_HOST_SYNC, RULE_DONATION, RULE_LOCKSTEP,
              RULE_DTYPE_HAZARD, RULE_COMM_BUDGET, RULE_RECOMPILE,
-             RULE_OVERLAP, RULE_HBM_BUDGET)
+             RULE_OVERLAP, RULE_HBM_BUDGET, RULE_SILENT_RESHARD,
+             RULE_SPMD_DIVERGENCE)
 
 
 @dataclass
@@ -78,6 +84,11 @@ class AuditReport:
     peak_hbm_contributors: List[Any] = field(default_factory=list)
     # static step-time lower bound (analysis/cost_model.py)
     step_time: Dict[str, Any] = field(default_factory=dict)
+    # HLO-level SPMD cross-check payload (analysis/hlo_audit.py):
+    # per-target compiled-program wire accounting, matched vs
+    # compiler-inserted, divergence ratio — empty when the audit did
+    # not run (analysis.hlo_audit off and no --hlo-audit)
+    hlo: Dict[str, Any] = field(default_factory=dict)
 
     def counts(self) -> Dict[str, int]:
         out = {s: 0 for s in SEVERITIES}
@@ -92,6 +103,19 @@ class AuditReport:
     @property
     def predicted_step_time_lb_s(self) -> Optional[float]:
         return self.step_time.get("predicted_step_time_lb_s")
+
+    # ---- HLO cross-check conveniences (None when the audit is off) -- #
+    @property
+    def hlo_wire_bytes_per_step(self) -> Optional[int]:
+        return self.hlo.get("hlo_wire_bytes_per_step")
+
+    @property
+    def hlo_collective_count(self) -> Optional[int]:
+        return self.hlo.get("hlo_collective_count")
+
+    @property
+    def hlo_divergence_ratio(self) -> Optional[float]:
+        return self.hlo.get("divergence_ratio")
 
     def summary_line(self) -> str:
         c = self.counts()
@@ -135,6 +159,7 @@ class AuditReport:
             "peak_hbm_contributors": [
                 list(c) for c in self.peak_hbm_contributors],
             "step_time": self.step_time,
+            "hlo": self.hlo,
         }, indent=indent)
 
 

@@ -27,7 +27,7 @@ safe planning ceiling for ``analysis.hbm_budget_mb``.
 
 from collections import Counter
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from .findings import Finding, RULE_HBM_BUDGET
 from .jaxpr_walk import as_jaxpr, aval_bytes, eqn_scope, sub_jaxprs

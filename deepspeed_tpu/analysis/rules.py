@@ -46,6 +46,15 @@ class AuditTarget:
     # scan-structure provenance the engine records at build time (gas
     # scan length, streamed-ZeRO-3 plan) — named in overlap findings
     scan_info: dict = field(default_factory=dict)
+    # HLO-level SPMD audit hooks (analysis/hlo_audit.py).  ``lower`` is
+    # a zero-arg thunk returning the OPTIMIZED post-SPMD HLO text of
+    # the program as the engine actually dispatches it (compile-only,
+    # never executed); None = the cross-check skips this target.
+    # ``spmd_waivers`` are (name, byte_budget, opcodes) expectations
+    # for compiler-inserted gather-family wire the sharding contract
+    # predicts (ZeRO's param re-gather in the apply program).
+    lower: Optional[Any] = None
+    spmd_waivers: Tuple = ()
 
 
 # --------------------------------------------------------------------- #

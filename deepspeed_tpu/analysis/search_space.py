@@ -27,7 +27,7 @@ candidates with reasons, never silently skipped.
 import copy
 import itertools
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Tuple
 
 from .. import constants as C
 

@@ -7,7 +7,7 @@ TPU-native (JAX meshes instead of NCCL process groups).
 """
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 from . import constants as C
 from .config_utils import get_scalar_param, load_config_dict
@@ -763,6 +763,13 @@ class AnalysisConfig:
     hw_peak_tflops: float = C.ANALYSIS_HW_PEAK_TFLOPS_DEFAULT
     hw_hbm_gbps: float = C.ANALYSIS_HW_HBM_GBPS_DEFAULT
     hw_ici_gbps: float = C.ANALYSIS_HW_ICI_GBPS_DEFAULT
+    # HLO-level SPMD audit (analysis/hlo_audit.py): compile each audited
+    # program through XLA's SPMD partitioner and cross-check the jaxpr
+    # wire story against the collectives the compiler actually inserted
+    hlo_audit: bool = C.ANALYSIS_HLO_AUDIT_DEFAULT
+    require_spmd_match: bool = C.ANALYSIS_REQUIRE_SPMD_MATCH_DEFAULT
+    spmd_reshard_min_mb: float = C.ANALYSIS_SPMD_RESHARD_MIN_MB_DEFAULT
+    spmd_match_tolerance: float = C.ANALYSIS_SPMD_MATCH_TOLERANCE_DEFAULT
 
     @property
     def enabled(self) -> bool:
@@ -807,6 +814,17 @@ class AnalysisConfig:
             hw_ici_gbps=float(get_scalar_param(
                 d, C.ANALYSIS_HW_ICI_GBPS,
                 C.ANALYSIS_HW_ICI_GBPS_DEFAULT)),
+            hlo_audit=bool(get_scalar_param(
+                d, C.ANALYSIS_HLO_AUDIT, C.ANALYSIS_HLO_AUDIT_DEFAULT)),
+            require_spmd_match=bool(get_scalar_param(
+                d, C.ANALYSIS_REQUIRE_SPMD_MATCH,
+                C.ANALYSIS_REQUIRE_SPMD_MATCH_DEFAULT)),
+            spmd_reshard_min_mb=float(get_scalar_param(
+                d, C.ANALYSIS_SPMD_RESHARD_MIN_MB,
+                C.ANALYSIS_SPMD_RESHARD_MIN_MB_DEFAULT)),
+            spmd_match_tolerance=float(get_scalar_param(
+                d, C.ANALYSIS_SPMD_MATCH_TOLERANCE,
+                C.ANALYSIS_SPMD_MATCH_TOLERANCE_DEFAULT)),
         )
         if cfg.mode not in C.ANALYSIS_MODES:
             raise DeepSpeedConfigError(
@@ -828,6 +846,14 @@ class AnalysisConfig:
             raise DeepSpeedConfigError(
                 "analysis.overlap_min_hidden_fraction must be in (0, 1], "
                 f"got {cfg.overlap_min_hidden_fraction}")
+        if cfg.spmd_reshard_min_mb < 0:
+            raise DeepSpeedConfigError(
+                "analysis.spmd_reshard_min_mb must be >= 0, got "
+                f"{cfg.spmd_reshard_min_mb}")
+        if cfg.spmd_match_tolerance < 0:
+            raise DeepSpeedConfigError(
+                "analysis.spmd_match_tolerance must be >= 0, got "
+                f"{cfg.spmd_match_tolerance}")
         validate_hw_constants({
             C.ANALYSIS_HW_PEAK_TFLOPS: cfg.hw_peak_tflops,
             C.ANALYSIS_HW_HBM_GBPS: cfg.hw_hbm_gbps,

@@ -213,6 +213,32 @@ ANALYSIS_HW_DEFAULTS = {
     ANALYSIS_HW_HBM_GBPS: ANALYSIS_HW_HBM_GBPS_DEFAULT,
     ANALYSIS_HW_ICI_GBPS: ANALYSIS_HW_ICI_GBPS_DEFAULT,
 }
+# HLO-level SPMD audit (analysis/hlo_audit.py): lower each audited
+# program through XLA's SPMD partitioner (compile-only, never executed)
+# and cross-check the jaxpr wire story against what the compiler
+# actually emitted — GSPMD inserts collectives AFTER tracing, so a
+# sharding-annotation mistake can add all-gathers the jaxpr-level
+# accounting never sees ("silent resharding").
+ANALYSIS_HLO_AUDIT = "hlo_audit"
+ANALYSIS_HLO_AUDIT_DEFAULT = False
+# escalate silent-reshard + jaxpr/HLO-divergence findings from warning
+# to error (the CI posture once a config's compiled wire story is
+# pinned)
+ANALYSIS_REQUIRE_SPMD_MATCH = "require_spmd_match"
+ANALYSIS_REQUIRE_SPMD_MATCH_DEFAULT = False
+# floor below which a compiler-inserted gather-family collective is
+# waived as "below_floor" instead of flagged: GSPMD legitimately
+# inserts small gathers for indexed updates (an embedding grad's
+# scatter-add) that are wire the jaxpr never counted but not a
+# sharding mistake.  Priced into the exposed-comm lane either way.
+ANALYSIS_SPMD_RESHARD_MIN_MB = "spmd_reshard_min_mb"
+ANALYSIS_SPMD_RESHARD_MIN_MB_DEFAULT = 1.0
+# tolerated relative gap between the jaxpr-predicted wire bytes and the
+# HLO-measured bytes of the SAME traced collectives before a
+# spmd_divergence finding fires (combiner passes and degenerate-group
+# elision move a few percent)
+ANALYSIS_SPMD_MATCH_TOLERANCE = "spmd_match_tolerance"
+ANALYSIS_SPMD_MATCH_TOLERANCE_DEFAULT = 0.05
 
 #############################################
 # Config autotuner (TPU-native addition; docs/autotuner.md)

@@ -14,7 +14,7 @@ def get_report_lines():
     import jaxlib
 
     from . import version
-    from .ops.op_builder import ALL_OPS, op_report
+    from .ops.op_builder import op_report
 
     lines = ["-" * 64,
              "deepspeed_tpu environment report (ds_report analog)",

@@ -16,7 +16,7 @@ the mesh's "expert" axis; everything else composes exactly as GPT2Model
 (ZeRO 0-2, TP on the attention/dense layers, dp).
 """
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional, Tuple
 
 import jax

@@ -21,7 +21,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..utils.logging import log_dist
-from .replace_policy import InjectBasePolicy, replace_policies
+from .replace_policy import replace_policies
 
 
 def _find_layers(module, policy_cls) -> List[Any]:

@@ -16,7 +16,7 @@ HF GPT-2 uses Conv1D ([in, out] already); BERT/GPT-Neo use nn.Linear
 does per policy.
 """
 
-from typing import Any, Dict, List, Tuple
+from typing import Dict, List
 
 import numpy as np
 

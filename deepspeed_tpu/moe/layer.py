@@ -14,7 +14,6 @@ discovery alike.
 from typing import Optional
 
 from ..parallel import mesh as mesh_mod
-from ..parallel.mesh import EXPERT_AXIS
 from ..utils.logging import log_dist
 from .experts import ExpertMLP
 from .sharded_moe import MOELayer, TopKGate

@@ -18,14 +18,14 @@ XLA-friendly; tokens over capacity are dropped by the position mask exactly
 like the reference's `locations < capacity` test.
 """
 
-from typing import Callable, Optional, Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
-from ..parallel.mesh import DATA_AXIS, EXPERT_AXIS
+from ..parallel.mesh import EXPERT_AXIS
 
 JITTER_EPS = 1e-2
 

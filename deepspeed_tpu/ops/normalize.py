@@ -9,7 +9,6 @@ surrounding kernel schedule explicitly.
 """
 
 import functools
-from typing import Optional
 
 import jax
 import numpy as np

@@ -18,8 +18,7 @@ import hashlib
 import os
 import platform
 import subprocess
-import tempfile
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from ..utils.logging import logger
 

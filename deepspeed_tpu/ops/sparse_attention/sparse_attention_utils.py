@@ -10,12 +10,9 @@ DeepSpeedTransformerLayer route its attention through SparseSelfAttention
 GPT2Model — becomes block-sparse by config alone.
 """
 
-from typing import Optional, Tuple
-
 import jax.numpy as jnp
 
 from .sparse_self_attention import SparseSelfAttention
-from .sparsity_config import SparsityConfig
 
 
 def pad_to_block_size(block: int, input_ids, pad_token_id: int,

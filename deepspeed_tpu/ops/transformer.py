@@ -15,7 +15,7 @@ split with EXPLICIT collectives, the f/g operator pair of
 ops/tp_collectives.py, so they stay out of divergent control flow.)
 """
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 import jax

@@ -18,7 +18,7 @@ Weight layout is identical to DeepSpeedTransformerLayer (ops/transformer.py)
 so training checkpoints serve directly.
 """
 
-from typing import Any, NamedTuple, Optional, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from .flash_attention import DEFAULT_MASK_VALUE, flash_attention
 from .normalize import fused_layer_norm
 from .activations import bias_gelu
-from .quant import QuantizedWeight, matmul_maybe_int8
+from .quant import matmul_maybe_int8
 from .transformer import DeepSpeedTransformerConfig
 
 

@@ -7,7 +7,7 @@ communicator handles, so the accessors return the axis names to reduce over
 plus sizes/ranks derived from the mesh.
 """
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 from . import mesh as mesh_mod
 from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,

@@ -17,8 +17,6 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import numpy as np
 
 import jax
-import jax.numpy as jnp
-from jax import core as jax_core
 
 from ..analysis.jaxpr_walk import as_jaxpr, eqn_scope, sub_jaxprs
 from ..utils.logging import log_dist

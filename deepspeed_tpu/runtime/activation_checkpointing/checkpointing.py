@@ -28,10 +28,9 @@ policies instead of hand-managed tensor stashes:
 from typing import Any, Callable, Optional
 
 import jax
-import jax.numpy as jnp
 from jax import lax
 
-from ...utils.logging import log_dist, logger
+from ...utils.logging import log_dist
 from ...parallel.mesh import MODEL_AXIS
 
 _CONFIG = {

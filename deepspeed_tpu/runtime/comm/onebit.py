@@ -16,7 +16,7 @@ DCN bandwidth win.  On ICI-bound meshes the dense psum is typically faster
 — benchmark before enabling (SURVEY.md §7 honesty note).
 """
 
-from typing import NamedTuple, Optional
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp

@@ -9,7 +9,6 @@ recommended on GPU for Tensor Cores — reference docstring; 128-multiples
 are the natural TPU lane width).
 """
 
-import math
 from typing import Any, Dict
 
 FIXED_LINEAR = "fixed_linear"

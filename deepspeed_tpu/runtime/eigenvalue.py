@@ -8,7 +8,7 @@ TPU-native: the Hessian-vector product is a forward-over-reverse
 `jax.jvp(jax.grad(f))` — exact, jit-compiled, no retain_graph bookkeeping.
 """
 
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp

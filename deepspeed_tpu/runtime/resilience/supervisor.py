@@ -29,7 +29,7 @@ This module is deliberately jax-free: the launcher imports it on
 controller boxes that never initialize a backend.
 """
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ... import constants as C

@@ -20,7 +20,6 @@ file I/O when no native lib builds.
 """
 
 import ctypes
-from typing import Optional
 
 import numpy as np
 

@@ -12,7 +12,7 @@ Infinity streaming engine had to drop — a shared wait() serializes every
 in-flight neighbour behind the slowest write)."""
 
 import time
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 import numpy as np
 

@@ -2,7 +2,7 @@
 SwapBuffer/SwapBufferPool/SwapBufferManager — pinned, io-aligned host
 buffers reused across swap operations)."""
 
-from typing import Dict, List, Optional
+from typing import List
 
 import numpy as np
 

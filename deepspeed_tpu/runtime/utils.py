@@ -9,8 +9,6 @@ stage3 estimate_zero3_model_states_mem_needs.
 
 from typing import Any, List, Optional, Sequence, Tuple
 
-import numpy as np
-
 import jax
 import jax.numpy as jnp
 from jax import lax

@@ -11,7 +11,7 @@ matmul epilogue (XLA fusion), so HBM holds int8 while the MXU still sees
 bf16 operands.
 """
 
-from typing import Any, List, Tuple
+from typing import List
 
 import jax.numpy as jnp
 import numpy as np

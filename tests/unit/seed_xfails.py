@@ -9,12 +9,30 @@ per-file copy would keep its tests skipped after the bug is gone.
 import pytest
 
 # The gated 1F1B executor's stage-index lowering emits a PartitionId
-# instruction that XLA-CPU's SPMD partitioner rejects (UNIMPLEMENTED:
-# "PartitionId instruction is not supported for SPMD partitioning").
-# Deterministic compile-time error on this backend, so run=False; the
-# real fix (stage ids as a sharded operand, or full-manual meshes) is a
-# pipeline-executor PR of its own.
+# instruction that XLA-CPU's SPMD partitioner rejects.  Deterministic
+# compile-time error on this backend, so run=False; the real fix (stage
+# ids as a sharded operand, or full-manual meshes) is a pipeline-
+# executor PR of its own.
+#
+# Re-probed 2026-08-03 (round 18, while building the HLO-level SPMD
+# audit — the cross-check pipeline compiles through the same
+# partitioner): all 9 tests still fail at compile with the IDENTICAL
+# signature below (jax 0.4.37 / jaxlib 0.4.36); none can be un-xfailed.
+# The audit surfaces the same class gracefully: a target whose lowering
+# raises gets a warning finding naming the failure instead of crashing
+# (analysis/hlo_audit.py, test_compile_failure_is_surfaced_not_fatal).
+#
+# Precise XLA failure signature (assert against PARTITION_ID_SIGNATURE
+# when probing — a DIFFERENT partitioner error means the bug moved, not
+# that it is fixed):
+PARTITION_ID_SIGNATURE = (
+    "UNIMPLEMENTED: PartitionId instruction is not supported for SPMD "
+    "partitioning since the meaning is ambiguous -- whether the "
+    "instruction is replicated or the data is replicated, and if the "
+    "latter which data is replicated.")
+
 PARTITION_ID_XFAIL = pytest.mark.xfail(
     reason="XLA-CPU SPMD partitioner rejects the gated 1F1B executor's "
            "PartitionId lowering (pre-existing seed failure, "
-           "docs/COVERAGE.md)", run=False)
+           "docs/COVERAGE.md; signature re-verified round 18: "
+           "PARTITION_ID_SIGNATURE)", run=False)

@@ -1,8 +1,6 @@
 """Test model fixtures (modeled on reference tests/unit/simple_model.py:234 —
 SimpleModel, random/linear dataset generators, args helpers)."""
 
-from typing import Tuple
-
 import numpy as np
 
 import jax

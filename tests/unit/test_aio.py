@@ -7,7 +7,6 @@ import gc
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 import deepspeed_tpu as ds

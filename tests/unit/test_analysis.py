@@ -41,11 +41,14 @@ GOLDEN_STREAM_SERIALIZED = (REPO / "tests" / "unit" / "golden" /
                             "gpt2_zero3_stream_schedule_serialized.json")
 GOLDEN_STREAM_FCM = (REPO / "tests" / "unit" / "golden" /
                      "gpt2_zero3_stream_fcm_schedule.json")
+GOLDEN_HLO_AUDIT = (REPO / "tests" / "unit" / "golden" /
+                    "gpt2_hlo_audit.json")
 EXAMPLE_CFG = REPO / "docs" / "examples" / "gpt2_analysis.json"
 EXAMPLE_STREAM_CFG = (REPO / "docs" / "examples" /
                       "gpt2_zero3_stream_analysis.json")
 EXAMPLE_FCM_CFG = (REPO / "docs" / "examples" /
                    "gpt2_zero3_stream_fcm.json")
+EXAMPLE_HLO_CFG = REPO / "docs" / "examples" / "gpt2_hlo_audit.json"
 
 
 def _cfg(**kw) -> AnalysisConfig:
@@ -938,7 +941,7 @@ def test_ci_gate_examples_error_mode(capsys):
     from deepspeed_tpu.analysis.cli import main as cli_main
     examples = sorted((REPO / "docs" / "examples").glob("*.json"))
     assert EXAMPLE_CFG in examples and EXAMPLE_STREAM_CFG in examples
-    assert EXAMPLE_FCM_CFG in examples
+    assert EXAMPLE_FCM_CFG in examples and EXAMPLE_HLO_CFG in examples
     golden_stream = json.loads(GOLDEN_STREAM.read_text())
     for cfg_path in examples:
         ds.reset_mesh_context()
@@ -1000,17 +1003,43 @@ def test_ci_gate_examples_error_mode(capsys):
             assert (payload["step_time"]["wire_bytes_fused"]
                     == golden_fcm["wire_bytes_fused"] > 0)
             assert payload["findings"] == []
+        if cfg_path == EXAMPLE_HLO_CFG:
+            # the HLO-level SPMD cross-check config runs the compiled-
+            # view audit via its own analysis.hlo_audit knob (no CLI
+            # flag needed) under require_spmd_match + mode=error; its
+            # golden pins the clean compiled wire story — zero silent
+            # reshards, jaxpr/HLO accountings in agreement (ISSUE 14
+            # acceptance bar).  Regenerate with --update-golden.
+            golden_hlo = json.loads(GOLDEN_HLO_AUDIT.read_text())
+            assert payload["signature"] == golden_hlo["signature"]
+            hlo = payload["hlo"]
+            assert (hlo["n_silent_reshards"]
+                    == golden_hlo["n_silent_reshards"] == 0)
+            assert hlo["reshard_bytes_per_step"] == 0
+            assert (hlo["hlo_wire_bytes_per_step"]
+                    == golden_hlo["hlo_wire_bytes_per_step"] > 0)
+            assert (hlo["hlo_collective_count"]
+                    == golden_hlo["hlo_collective_count"] > 0)
+            assert (round(hlo["divergence_ratio"], 4)
+                    == golden_hlo["divergence_ratio"] == 1.0)
+            # the compiled-view-only wire is priced in the exposed lane
+            assert (payload["step_time"]["wire_bytes_hlo_only"]
+                    == hlo["hlo_only_wire_bytes_per_step"] > 0)
+            assert payload["findings"] == []
 
 
 @pytest.mark.slow
 def test_cli_update_golden_regenerates_checked_in_files(tmp_path):
     """--update-golden must reproduce the checked-in goldens exactly —
-    the files are CLI output, never hand-edited."""
+    the files are CLI output, never hand-edited.  One loop covers all
+    four golden files (lockstep, streamed schedule, FCM schedule, HLO
+    cross-check) so stale-golden drift fails in one place."""
     env_dir = str(tmp_path / "golden")
     for cfg_path, golden_path, extra in (
             (EXAMPLE_CFG, GOLDEN, ()),
             (EXAMPLE_STREAM_CFG, GOLDEN_STREAM, ("--devices", "8")),
-            (EXAMPLE_FCM_CFG, GOLDEN_STREAM_FCM, ("--devices", "8"))):
+            (EXAMPLE_FCM_CFG, GOLDEN_STREAM_FCM, ("--devices", "8")),
+            (EXAMPLE_HLO_CFG, GOLDEN_HLO_AUDIT, ("--devices", "8"))):
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env["DS_ANALYSIS_GOLDEN_DIR"] = env_dir
@@ -1045,3 +1074,10 @@ def test_cli_update_golden_unknown_config_errors(tmp_path):
                              "overlap", "wire_bytes_exposed_hot_loop",
                              "wire_bytes_fused"}
     assert "n_fused" in payload3["overlap"]
+    # the HLO cross-check golden (ISSUE 14): its config must be in the
+    # regen map and its payload must pin the clean compiled wire story
+    assert "gpt2_hlo_audit.json" in GOLDEN_MAP
+    payload4 = _golden_payload("gpt2_hlo_audit.json", rep)
+    assert {"signature", "hlo_wire_bytes_per_step",
+            "hlo_collective_count", "divergence_ratio",
+            "n_silent_reshards", "waivers"} <= set(payload4)

@@ -42,6 +42,9 @@ def test_resilience_package_imports_cleanly():
             "deepspeed_tpu.analysis",
             "deepspeed_tpu.analysis.cli",
             "deepspeed_tpu.analysis.__main__",
+            # HLO-level SPMD cross-check: lazily reachable through the
+            # auditor's hlo path and the CLI's --hlo-audit
+            "deepspeed_tpu.analysis.hlo_audit",
             # config autotuner: lazily imported by the tune/calibrate
             # subcommands and bench.py's autotune ladder row
             "deepspeed_tpu.analysis.search_space",

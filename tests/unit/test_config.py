@@ -189,7 +189,6 @@ def test_add_config_arguments_roundtrip():
 def test_prng_impl_config_knob():
     """prng_impl selects the default engine PRNG stream implementation
     (rbg = fast on TPU; threefry = bit-reproducible across backends)."""
-    import jax
 
     from deepspeed_tpu.config import DeepSpeedConfig
 

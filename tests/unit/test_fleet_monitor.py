@@ -25,13 +25,12 @@ import jax
 
 import deepspeed_tpu as ds
 from deepspeed_tpu.config import DeepSpeedConfigError, MonitorConfig
-from deepspeed_tpu.monitor import (
-    ATTR_HOST_GAP, ATTR_SWAP, EVENT_DIVERGENCE, EVENT_STRAGGLER,
-    KIND_FLEET, KIND_FLEET_HOST, KIND_HEALTH, KIND_RECONCILE, KIND_STEP,
-    SCHEMA_VERSION, STEP_RECORD_FIELDS, FleetAggregator, FleetHealth,
-    HeartbeatWriter, ProfileCapture, TrainingMonitor, annotate_stale,
-    format_watch_table, read_heartbeats, straggler_verdict,
-    summarize_fleet, validate_trace_events)
+from deepspeed_tpu.monitor import (ATTR_HOST_GAP, ATTR_SWAP, EVENT_DIVERGENCE,
+    EVENT_STRAGGLER, KIND_FLEET, KIND_FLEET_HOST, KIND_HEALTH, KIND_RECONCILE,
+    KIND_STEP, SCHEMA_VERSION, STEP_RECORD_FIELDS, FleetAggregator,
+    FleetHealth, HeartbeatWriter, ProfileCapture, TrainingMonitor,
+    annotate_stale, format_watch_table, read_heartbeats, straggler_verdict,
+    validate_trace_events)
 from deepspeed_tpu.monitor import record as R
 from deepspeed_tpu.monitor.fleet import (VEC_LEN, _encode_host,
                                          decode_window_vector,

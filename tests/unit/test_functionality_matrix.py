@@ -8,7 +8,6 @@ import numpy as np
 import pytest
 
 import jax
-import jax.numpy as jnp
 
 import deepspeed_tpu as ds
 from deepspeed_tpu.models import GPT2Config, GPT2Model

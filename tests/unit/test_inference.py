@@ -139,7 +139,7 @@ def test_int8_quantization(dp_mesh):
     fp = ds.init_inference(model, model_parameters=params, mesh=dp_mesh.mesh)
     q8 = ds.init_inference(model, model_parameters=params, mesh=dp_mesh.mesh,
                            quantization_setting=4)
-    from deepspeed_tpu.ops.transformer_inference import QuantizedWeight
+    from deepspeed_tpu.ops.quant import QuantizedWeight
     assert isinstance(q8.params["h"]["attn_qkvw"], QuantizedWeight)
     assert q8.params["h"]["attn_qkvw"].qweight.dtype == jnp.int8
 

@@ -8,7 +8,6 @@ swapping; partitioned_param_swapper.py:36), sub_group-wise optimizer sweep
 """
 
 import numpy as np
-import pytest
 
 import jax
 

@@ -7,8 +7,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.parallel import (MeshContext, groups, initialize_mesh,
-                                    resolve_mesh_shape)
+from deepspeed_tpu.parallel import MeshContext, groups, resolve_mesh_shape
 
 
 def test_resolve_wildcard():

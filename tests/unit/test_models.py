@@ -3,7 +3,6 @@
 convergence checks, tests/model/run_func_test.py)."""
 
 import numpy as np
-import pytest
 
 import jax
 import jax.numpy as jnp

@@ -195,7 +195,6 @@ def test_transformer_layer_manual_tp_matches_single(tp):
     forward, input grad, and EVERY param grad — the f/g operator pair
     (tp_fcast/tp_psum, ops/tp_collectives.py) restores full cotangents per device, so no
     post-hoc grad correction exists to hide an error."""
-    from jax import lax
     from jax.sharding import Mesh, PartitionSpec as P
 
     cfg = DeepSpeedTransformerConfig(

@@ -14,7 +14,6 @@ import subprocess
 import sys
 
 import numpy as np
-import pytest
 
 import jax
 import jax.numpy as jnp
@@ -56,7 +55,6 @@ def test_save_load_roundtrip_resharded(tmp_path):
 def test_bfloat16_roundtrip(tmp_path):
     """npz degrades bf16 to a '|V2' void payload — the catalog must re-view
     it from the index dtype (default models are bf16)."""
-    import ml_dtypes
     mesh8 = _mesh(8)
     x = jnp.arange(32 * 4, dtype=jnp.bfloat16).reshape(32, 4)
     xs = jax.device_put(x, NamedSharding(mesh8.mesh, P("data", None)))

@@ -565,8 +565,7 @@ def test_transformer_layer_sparse_mask_routing():
 
 def test_compressed_int8_wire_guards():
     from deepspeed_tpu.parallel import initialize_mesh, reset_mesh_context
-    from deepspeed_tpu.runtime.comm.compressed import (
-        compressed_allreduce, compressed_allreduce_inner)
+    from deepspeed_tpu.runtime.comm.compressed import compressed_allreduce
 
     reset_mesh_context()
     mesh = initialize_mesh(data=-1)

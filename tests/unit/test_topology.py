@@ -1,7 +1,5 @@
 """Topology rank-grid math (reference: tests/unit/test_topology.py:222)."""
 
-import pytest
-
 from deepspeed_tpu.runtime.pipe.topology import (PipeDataParallelTopology,
                                                  PipeModelDataParallelTopology,
                                                  PipelineParallelGrid,
