@@ -586,6 +586,96 @@ class MonitorCaptureConfig:
 
 
 @dataclass
+class MonitorMoeConfig:
+    """MoE routing observability (monitor/moe.py, docs/telemetry.md):
+    device-resident RoutingStats accumulation in the traced step
+    programs, one ``moe`` record + ExpertPopularitySnapshot per flush
+    window, fleet load-skew slots, and the three MoE health rules.
+    Off by default; on a dense model it is inert (no gate ever emits)."""
+    enabled: bool = C.MONITOR_MOE_ENABLED_DEFAULT
+    popularity_ewma_alpha: float = C.MONITOR_MOE_EWMA_ALPHA_DEFAULT
+    hot_k: int = C.MONITOR_MOE_HOT_K_DEFAULT
+    dead_expert_threshold: float = (
+        C.MONITOR_MOE_DEAD_EXPERT_THRESHOLD_DEFAULT)
+    dead_expert_windows: int = C.MONITOR_MOE_DEAD_EXPERT_WINDOWS_DEFAULT
+    entropy_floor: float = C.MONITOR_MOE_ENTROPY_FLOOR_DEFAULT
+    collapse_windows: int = C.MONITOR_MOE_COLLAPSE_WINDOWS_DEFAULT
+    ep_imbalance_ratio: float = C.MONITOR_MOE_EP_IMBALANCE_RATIO_DEFAULT
+    ep_imbalance_windows: int = (
+        C.MONITOR_MOE_EP_IMBALANCE_WINDOWS_DEFAULT)
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "MonitorMoeConfig":
+        if d is True:  # shorthand, like monitor.capture
+            d = {C.MONITOR_MOE_ENABLED: True}
+        elif d in (None, False):
+            d = {}
+        elif not isinstance(d, dict):
+            raise DeepSpeedConfigError(
+                f"monitor.moe must be a config object (or true/false), "
+                f"got {d!r}")
+        cfg = MonitorMoeConfig(
+            enabled=bool(get_scalar_param(
+                d, C.MONITOR_MOE_ENABLED, C.MONITOR_MOE_ENABLED_DEFAULT)),
+            popularity_ewma_alpha=float(get_scalar_param(
+                d, C.MONITOR_MOE_EWMA_ALPHA,
+                C.MONITOR_MOE_EWMA_ALPHA_DEFAULT)),
+            hot_k=int(get_scalar_param(
+                d, C.MONITOR_MOE_HOT_K, C.MONITOR_MOE_HOT_K_DEFAULT)),
+            dead_expert_threshold=float(get_scalar_param(
+                d, C.MONITOR_MOE_DEAD_EXPERT_THRESHOLD,
+                C.MONITOR_MOE_DEAD_EXPERT_THRESHOLD_DEFAULT)),
+            dead_expert_windows=int(get_scalar_param(
+                d, C.MONITOR_MOE_DEAD_EXPERT_WINDOWS,
+                C.MONITOR_MOE_DEAD_EXPERT_WINDOWS_DEFAULT)),
+            entropy_floor=float(get_scalar_param(
+                d, C.MONITOR_MOE_ENTROPY_FLOOR,
+                C.MONITOR_MOE_ENTROPY_FLOOR_DEFAULT)),
+            collapse_windows=int(get_scalar_param(
+                d, C.MONITOR_MOE_COLLAPSE_WINDOWS,
+                C.MONITOR_MOE_COLLAPSE_WINDOWS_DEFAULT)),
+            ep_imbalance_ratio=float(get_scalar_param(
+                d, C.MONITOR_MOE_EP_IMBALANCE_RATIO,
+                C.MONITOR_MOE_EP_IMBALANCE_RATIO_DEFAULT)),
+            ep_imbalance_windows=int(get_scalar_param(
+                d, C.MONITOR_MOE_EP_IMBALANCE_WINDOWS,
+                C.MONITOR_MOE_EP_IMBALANCE_WINDOWS_DEFAULT)),
+        )
+        if not 0.0 < cfg.popularity_ewma_alpha <= 1.0:
+            raise DeepSpeedConfigError(
+                "monitor.moe.popularity_ewma_alpha must be in (0, 1], "
+                f"got {cfg.popularity_ewma_alpha}")
+        if cfg.hot_k < 1:
+            raise DeepSpeedConfigError(
+                f"monitor.moe.hot_k must be >= 1, got {cfg.hot_k}")
+        if not 0.0 <= cfg.dead_expert_threshold < 1.0:
+            raise DeepSpeedConfigError(
+                "monitor.moe.dead_expert_threshold must be in [0, 1) — "
+                "a fraction of the fair per-expert share, got "
+                f"{cfg.dead_expert_threshold}")
+        if not 0.0 <= cfg.entropy_floor < 1.0:
+            raise DeepSpeedConfigError(
+                "monitor.moe.entropy_floor must be in [0, 1) — router "
+                "entropy is normalized by ln(num_experts), got "
+                f"{cfg.entropy_floor}")
+        if cfg.ep_imbalance_ratio <= 1.0:
+            raise DeepSpeedConfigError(
+                "monitor.moe.ep_imbalance_ratio must be > 1.0 (a hot "
+                "host carries MORE than the peer-median load), got "
+                f"{cfg.ep_imbalance_ratio}")
+        for name, v in ((C.MONITOR_MOE_DEAD_EXPERT_WINDOWS,
+                         cfg.dead_expert_windows),
+                        (C.MONITOR_MOE_COLLAPSE_WINDOWS,
+                         cfg.collapse_windows),
+                        (C.MONITOR_MOE_EP_IMBALANCE_WINDOWS,
+                         cfg.ep_imbalance_windows)):
+            if v < 1:
+                raise DeepSpeedConfigError(
+                    f"monitor.moe.{name} must be >= 1, got {v}")
+        return cfg
+
+
+@dataclass
 class MonitorConfig:
     """Runtime telemetry block (docs/telemetry.md): per-step structured
     metric records, pluggable writers, optional Chrome/Perfetto trace
@@ -614,6 +704,7 @@ class MonitorConfig:
     health_warmup_windows: int = C.MONITOR_HEALTH_WARMUP_WINDOWS_DEFAULT
     capture: MonitorCaptureConfig = field(
         default_factory=MonitorCaptureConfig)
+    moe: MonitorMoeConfig = field(default_factory=MonitorMoeConfig)
 
     @staticmethod
     def from_dict(d: Optional[Dict[str, Any]]) -> "MonitorConfig":
@@ -672,6 +763,7 @@ class MonitorConfig:
                 C.MONITOR_HEALTH_WARMUP_WINDOWS_DEFAULT)),
             capture=MonitorCaptureConfig.from_dict(
                 d.get(C.MONITOR_CAPTURE)),
+            moe=MonitorMoeConfig.from_dict(d.get(C.MONITOR_MOE)),
         )
         unknown = [w for w in cfg.writers if w not in C.MONITOR_WRITER_KINDS]
         if unknown:

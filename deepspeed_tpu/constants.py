@@ -394,6 +394,34 @@ MONITOR_CAPTURE_COOLDOWN_STEPS_DEFAULT = 100
 MONITOR_CAPTURE_OUTPUT_PATH = "output_path"
 MONITOR_CAPTURE_OUTPUT_PATH_DEFAULT = ""
 
+# ---- MoE routing observability (monitor/moe.py, ISSUE 15) ------------ #
+# Off by default; enabling it threads the RoutingStats accumulator
+# through the traced step programs (moe/sharded_moe.py) and emits one
+# `moe` record per flush window with the ExpertPopularitySnapshot —
+# ROADMAP item 6's prefetch oracle.
+MONITOR_MOE = "moe"
+MONITOR_MOE_ENABLED = "enabled"
+MONITOR_MOE_ENABLED_DEFAULT = False
+MONITOR_MOE_EWMA_ALPHA = "popularity_ewma_alpha"
+MONITOR_MOE_EWMA_ALPHA_DEFAULT = 0.2
+MONITOR_MOE_HOT_K = "hot_k"
+MONITOR_MOE_HOT_K_DEFAULT = 4
+# health rules (health.py): a near-zero expert for K consecutive
+# windows, a collapsed router entropy floor, and per-host expert-
+# parallel load imbalance vs the leave-one-out peer median
+MONITOR_MOE_DEAD_EXPERT_THRESHOLD = "dead_expert_threshold"
+MONITOR_MOE_DEAD_EXPERT_THRESHOLD_DEFAULT = 0.02
+MONITOR_MOE_DEAD_EXPERT_WINDOWS = "dead_expert_windows"
+MONITOR_MOE_DEAD_EXPERT_WINDOWS_DEFAULT = 3
+MONITOR_MOE_ENTROPY_FLOOR = "entropy_floor"
+MONITOR_MOE_ENTROPY_FLOOR_DEFAULT = 0.05
+MONITOR_MOE_COLLAPSE_WINDOWS = "collapse_windows"
+MONITOR_MOE_COLLAPSE_WINDOWS_DEFAULT = 3
+MONITOR_MOE_EP_IMBALANCE_RATIO = "ep_imbalance_ratio"
+MONITOR_MOE_EP_IMBALANCE_RATIO_DEFAULT = 1.5
+MONITOR_MOE_EP_IMBALANCE_WINDOWS = "ep_imbalance_windows"
+MONITOR_MOE_EP_IMBALANCE_WINDOWS_DEFAULT = 3
+
 #############################################
 # Tensorboard
 #############################################

@@ -1,3 +1,5 @@
 from .experts import ExpertMLP
 from .layer import MoE
-from .sharded_moe import MOELayer, TopKGate, top1gating, top2gating
+from .sharded_moe import (MOELayer, RoutingStats, TopKGate,
+                          collect_routing_stats, emit_routing_stats,
+                          sum_routing_stats, top1gating, top2gating)

@@ -59,9 +59,21 @@ class MoE:
             return  # no mesh yet; stay at the ep_size=1 defaults
         ep_size = ctx.expert_parallel_world_size
         if self.num_experts % max(1, ep_size) != 0:
+            # actionable: name BOTH sides of the mismatch and the
+            # nearest expert counts that would divide this mesh (the
+            # discovery-time hook in engine.py runs this after mesh
+            # creation, so the operator sees it at engine build)
+            below = (self.num_experts // ep_size) * ep_size
+            above = below + ep_size
+            nearest = [n for n in (below, above) if n >= ep_size]
             raise ValueError(
-                f"num_experts={self.num_experts} must divide the expert mesh "
-                f"axis ({ep_size})")
+                f"MoE: num_experts={self.num_experts} does not divide "
+                f"the mesh's expert axis (expert={ep_size}) — each of "
+                f"the {ep_size} expert-parallel shards must own the "
+                f"same number of experts. Nearest valid num_experts: "
+                f"{' or '.join(str(n) for n in nearest)}; or resize "
+                f"the mesh's expert axis to a divisor of "
+                f"{self.num_experts}")
         self.ep_size = ep_size
         self.num_local_experts = self.num_experts // max(1, ep_size)
         if not self._mesh_checked:

@@ -18,7 +18,8 @@ XLA-friendly; tokens over capacity are dropped by the position mask exactly
 like the reference's `locations < capacity` test.
 """
 
-from typing import Optional, Tuple
+import contextlib
+from typing import List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -28,6 +29,159 @@ import jax.numpy as jnp
 from ..parallel.mesh import EXPERT_AXIS
 
 JITTER_EPS = 1e-2
+
+# entropy clip floor: softmax outputs are strictly positive, but fp32
+# underflow on very peaked routers would otherwise produce 0 * -inf
+_ENTROPY_EPS = 1e-20
+
+
+class RoutingStats(NamedTuple):
+    """Per-gate routing telemetry, a pure pytree of device scalars/[E]
+    vectors so it sums across layers (``emit_routing_stats`` inside one
+    traced forward), microbatches (the fused gas scan), and optimizer
+    steps (the engine's device-resident accumulator) with plain
+    ``jax.tree.map(jnp.add)`` — and is host-read ONLY at monitor
+    flush-window boundaries (docs/telemetry.md "MoE routing
+    observability").  Everything is POST-capacity-mask reality: a token
+    the ``locations < capacity`` test dropped never counts as routed.
+
+    This is the in-program half of the expert-popularity prefetch
+    oracle ROADMAP item 6's NVMe expert streaming keys on
+    (monitor/moe.py turns the accumulated ``expert_counts`` into the
+    ``ExpertPopularitySnapshot`` the streamer consumes)."""
+    expert_counts: jnp.ndarray    # f32[E] routed token-slots per expert
+    overflow_counts: jnp.ndarray  # f32[E] capacity-dropped slots per
+    #                               WANTED expert (where demand exceeded
+    #                               the slot budget)
+    tokens: jnp.ndarray           # f32[] token-slots wanted (k x tokens,
+    #                               used_token-masked)
+    dropped: jnp.ndarray          # f32[] token-slots dropped (= tokens
+    #                               - routed)
+    entropy: jnp.ndarray          # f32[] sum over tokens of router
+    #                               softmax entropy (nats)
+    confidence: jnp.ndarray       # f32[] sum over tokens of raw top-k
+    #                               gate probability mass
+    gate_tokens: jnp.ndarray      # f32[] tokens contributing entropy/
+    #                               confidence
+    l_aux: jnp.ndarray            # f32[] summed load-balance loss
+    layers: jnp.ndarray           # f32[] gate invocations folded in
+
+
+def _routing_stats(gates, wanted_counts, routed_counts, topk_mass,
+                   l_aux, used_token=None) -> RoutingStats:
+    """Assemble one gate invocation's RoutingStats.
+
+    ``wanted_counts``/``routed_counts``: [E] pre-/post-capacity-mask
+    token-slot counts; ``topk_mass``: [S] raw gate probability mass on
+    the selected (pre-capacity) experts; ``used_token``: optional [S]
+    validity mask (padding tokens contribute nothing)."""
+    ent = -jnp.sum(gates * jnp.log(jnp.clip(gates, _ENTROPY_EPS, 1.0)),
+                   axis=-1)
+    if used_token is not None:
+        u = used_token.astype(jnp.float32)
+        ent = ent * u
+        topk_mass = topk_mass * u
+        gate_tokens = u.sum()
+    else:
+        gate_tokens = jnp.float32(gates.shape[0])
+    wanted = wanted_counts.astype(jnp.float32)
+    routed = routed_counts.astype(jnp.float32)
+    return RoutingStats(
+        expert_counts=routed,
+        overflow_counts=wanted - routed,
+        tokens=wanted.sum(),
+        dropped=(wanted - routed).sum(),
+        entropy=ent.sum().astype(jnp.float32),
+        confidence=topk_mass.sum().astype(jnp.float32),
+        gate_tokens=gate_tokens,
+        l_aux=l_aux.astype(jnp.float32),
+        layers=jnp.float32(1.0))
+
+
+# ---- routing-stats collection tap ------------------------------------ #
+# The model's loss function returns a scalar, so routing stats leave the
+# traced program through a trace-time side channel: the engine installs
+# a tap around the model apply INSIDE its loss_fn (same trace scope),
+# MOELayer.apply emits each gate's RoutingStats into it, and the engine
+# returns the summed pytree as a grad aux output.  The stack is plain
+# trace-time Python state (tracing is single-threaded per process);
+# nothing here runs per step at execution time.
+_ACTIVE_TAPS: List[list] = []
+
+
+@contextlib.contextmanager
+def collect_routing_stats():
+    """Context manager: collect every RoutingStats emitted while tracing
+    the enclosed computation.  MUST wrap code in the SAME trace scope as
+    the emissions — stats emitted inside an inner lax.scan body cannot
+    escape to an outer tap (see sum_routing_stats)."""
+    tap: list = []
+    _ACTIVE_TAPS.append(tap)
+    try:
+        yield tap
+    finally:
+        _ACTIVE_TAPS.pop()
+
+
+def emit_routing_stats(stats: RoutingStats) -> None:
+    """Offer one gate invocation's stats to the innermost active tap
+    (no-op when no tap is installed — gating stays side-effect-free
+    outside a collecting engine)."""
+    if _ACTIVE_TAPS:
+        _ACTIVE_TAPS[-1].append(stats)
+
+
+_SUM_WARNED = set()
+
+
+def sum_routing_stats(entries: list) -> Optional[RoutingStats]:
+    """Sum a tap's collected stats into one RoutingStats (None when
+    nothing was emitted — a dense model under a collecting engine).
+
+    Two degradations, both loud-once instead of crashing the trace:
+    mixed expert counts across layers cannot share one [E] accumulator
+    (entries whose num_experts differs from the first gate's are dropped
+    entirely — the simplest honest contract); and stats emitted inside
+    an INNER scan body (e.g. a
+    hypothetical MoE layer under the ZeRO-3 streamed layer scan) are
+    body-local tracers that cannot escape to this scope — they surface
+    as escaped-tracer errors here and are dropped with a warning naming
+    the fix (thread the layer out of the streamed scan or disable
+    monitor.moe)."""
+    if not entries:
+        return None
+    from ..utils.logging import logger
+    e0 = entries[0].expert_counts.shape[0]
+    keep, skipped = [], 0
+    for s in entries:
+        if s.expert_counts.shape[0] != e0:
+            skipped += 1
+            continue
+        keep.append(s)
+    if skipped and "mixed_E" not in _SUM_WARNED:
+        _SUM_WARNED.add("mixed_E")
+        logger.warning(
+            f"routing stats: {skipped} gate(s) with num_experts != {e0} "
+            "dropped from the accumulator — per-layer expert counts must "
+            "match to share one [E] histogram (first layer wins)")
+    total = keep[0]
+    try:
+        for s in keep[1:]:
+            total = jax.tree.map(jnp.add, total, s)
+        # touch the result so an escaped tracer surfaces HERE (a single
+        # leaked entry raises on first use, which may be the return)
+        total = jax.tree.map(lambda x: x + 0.0, total)
+    except Exception as e:  # noqa: BLE001 — escaped inner-scan tracers
+        if "escaped" not in _SUM_WARNED:
+            _SUM_WARNED.add("escaped")
+            logger.warning(
+                "routing stats: emitted stats could not escape their "
+                f"trace scope ({type(e).__name__}) — MoE layers inside "
+                "an inner scan (e.g. the ZeRO-3 streamed layer scan) "
+                "cannot feed the outer accumulator; their stats are "
+                "dropped for this program")
+        return None
+    return total
 
 
 def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
@@ -56,9 +210,13 @@ def top1gating_compact(
     """Top-1 gating, compact form — the single source of routing truth.
 
     Returns (l_aux, capacity, experts [S,1], slots [S,1], weights [S,1]
-    fp32 with zeros for dropped tokens, exp_counts [E]).  The [S,E,C]
-    mask form (top1gating) expands from this; the scatter dispatcher
-    consumes it directly with O(S·d) memory instead of O(S·E·C).
+    fp32 with zeros for dropped tokens, exp_counts [E], stats
+    RoutingStats).  ``exp_counts`` is POST-capacity-mask: a token the
+    ``locations < capacity`` test dropped is not routed anywhere, so it
+    must not count (the pre-capacity demand survives in
+    ``stats.overflow_counts``).  The [S,E,C] mask form (top1gating)
+    expands from this; the scatter dispatcher consumes it directly with
+    O(S·d) memory instead of O(S·E·C).
     """
     num_tokens, num_experts = logits.shape
     capacity = _capacity(num_tokens, num_experts, capacity_factor,
@@ -74,7 +232,8 @@ def top1gating_compact(
     if used_token is not None:  # mask out padding tokens
         mask1 = mask1 * used_token.astype(mask1.dtype)[:, None]
 
-    exp_counts = mask1.sum(axis=0)
+    wanted_counts = mask1.sum(axis=0)  # pre-capacity demand per expert
+    topk_mass = (gates * mask1).sum(axis=-1)
 
     # load-balance loss (reference: sharded_moe.py:133): fraction of router
     # probability × fraction of tokens per expert
@@ -88,9 +247,12 @@ def top1gating_compact(
     locations1_s = (locations1 * mask1).sum(axis=-1)
     gates1_s = (gates * mask1).sum(axis=-1)  # 0 for dropped tokens
 
+    exp_counts = mask1.sum(axis=0)
+    stats = _routing_stats(gates, wanted_counts, exp_counts, topk_mass,
+                           l_aux, used_token)
     return (l_aux, capacity, indices1[:, None],
             locations1_s.astype(jnp.int32)[:, None], gates1_s[:, None],
-            exp_counts)
+            exp_counts, stats)
 
 
 def _expand_compact(capacity, num_experts, experts, slots, weights):
@@ -112,14 +274,15 @@ def top1gating(logits: jnp.ndarray, capacity_factor: float = 1.0,
     """Top-1 gating (reference: sharded_moe.py:99).
 
     Returns (l_aux, combine_weights [S,E,C], dispatch_mask [S,E,C] bool,
-    exp_counts [E]).
+    exp_counts [E] post-capacity, stats RoutingStats).
     """
-    l_aux, capacity, experts, slots, weights, exp_counts = top1gating_compact(
+    (l_aux, capacity, experts, slots, weights, exp_counts,
+     stats) = top1gating_compact(
         logits, capacity_factor, min_capacity, used_token,
         noisy_gate_policy, rng)
     combine, dispatch = _expand_compact(capacity, logits.shape[1],
                                         experts, slots, weights)
-    return l_aux, combine, dispatch, exp_counts
+    return l_aux, combine, dispatch, exp_counts, stats
 
 
 def top2gating_compact(
@@ -130,7 +293,9 @@ def top2gating_compact(
 
     Returns (l_aux, capacity, experts [S,2], slots [S,2], weights [S,2]
     fp32 normalized over the kept choices with zeros for dropped slots,
-    exp_counts [E]).
+    exp_counts [E] post-capacity, stats RoutingStats).  Top-2 doubles
+    the slot budget (2 * capacity_factor), so stats.overflow_counts
+    reflects demand against the DOUBLED capacity.
     """
     num_tokens, num_experts = logits.shape
     capacity = _capacity(num_tokens, num_experts, 2 * capacity_factor,
@@ -151,7 +316,8 @@ def top2gating_compact(
     indices2 = jnp.argmax(select2, axis=-1)
     mask2 = _one_hot(indices2, num_experts)
 
-    exp_counts = (mask1 + mask2).sum(axis=0)
+    wanted_counts = (mask1 + mask2).sum(axis=0)
+    topk_mass = (gates * (mask1 + mask2)).sum(axis=-1)
 
     me = gates.mean(axis=0)
     ce = mask1.mean(axis=0)
@@ -175,7 +341,10 @@ def top2gating_compact(
     experts = jnp.stack([indices1, indices2], axis=1)
     slots = jnp.stack([locations1_s, locations2_s], axis=1).astype(jnp.int32)
     weights = jnp.stack([gates1_s, gates2_s], axis=1)
-    return l_aux, capacity, experts, slots, weights, exp_counts
+    exp_counts = (mask1 + mask2).sum(axis=0)
+    stats = _routing_stats(gates, wanted_counts, exp_counts, topk_mass,
+                           l_aux)
+    return l_aux, capacity, experts, slots, weights, exp_counts, stats
 
 
 def top2gating(logits: jnp.ndarray, capacity_factor: float = 1.0,
@@ -189,12 +358,15 @@ def top2gating(logits: jnp.ndarray, capacity_factor: float = 1.0,
     unconditionally via torch's implicit global RNG; JAX needs an explicit
     key, so pass rng= for reference-parity stochastic second choice).
     Top-2 capacity doubles the slot budget like the reference (2 * S / E).
+    Returns (l_aux, combine, dispatch, exp_counts [E] post-capacity,
+    stats RoutingStats).
     """
-    l_aux, capacity, experts, slots, weights, exp_counts = top2gating_compact(
+    (l_aux, capacity, experts, slots, weights, exp_counts,
+     stats) = top2gating_compact(
         logits, capacity_factor, min_capacity, rng, noisy_gate_policy)
     combine, dispatch = _expand_compact(capacity, logits.shape[1],
                                         experts, slots, weights)
-    return l_aux, combine, dispatch, exp_counts
+    return l_aux, combine, dispatch, exp_counts, stats
 
 
 class TopKGate:
@@ -219,18 +391,20 @@ class TopKGate:
             rng, (self.model_dim, self.num_experts), jnp.float32) * scale)}
 
     def apply(self, params, x, rng=None, train=True):
-        """x: [S, d] tokens → (l_aux, combine, dispatch, exp_counts) —
-        the legacy [S,E,C] form, expanded from the compact routing so the
-        einsum and scatter dispatch paths can never route differently."""
-        l_aux, capacity, experts, slots, weights, exp_counts = \
+        """x: [S, d] tokens → (l_aux, combine, dispatch, exp_counts,
+        stats) — the legacy [S,E,C] form, expanded from the compact
+        routing so the einsum and scatter dispatch paths can never route
+        differently."""
+        l_aux, capacity, experts, slots, weights, exp_counts, stats = \
             self.apply_compact(params, x, rng=rng, train=train)
         combine, dispatch = _expand_compact(capacity, self.num_experts,
                                             experts, slots, weights)
-        return l_aux, combine, dispatch, exp_counts
+        return l_aux, combine, dispatch, exp_counts, stats
 
     def apply_compact(self, params, x, rng=None, train=True):
         """x: [S, d] → (l_aux, capacity, experts [S,k], slots [S,k],
-        weights [S,k], exp_counts) — no [S,E,C] materialization."""
+        weights [S,k], exp_counts, stats) — no [S,E,C]
+        materialization."""
         x32 = x.astype(jnp.float32)
         if train and self.noisy_gate_policy == "Jitter":
             if rng is None:
@@ -334,9 +508,10 @@ class MOELayer:
         tokens = x.reshape(-1, d_model)
         s = tokens.shape[0]
 
-        l_aux, capacity, experts, slots, weights, exp_counts = \
+        l_aux, capacity, experts, slots, weights, exp_counts, stats = \
             self.gate.apply_compact(params["gate"], tokens, rng=rng,
                                     train=train)
+        emit_routing_stats(stats)
         k = experts.shape[1]
         e_total = self.num_experts
         valid = weights > 0.0
@@ -385,8 +560,9 @@ class MOELayer:
         d_model = x.shape[-1]
         tokens = x.reshape(-1, d_model)
 
-        l_aux, combine, dispatch, exp_counts = self.gate.apply(
+        l_aux, combine, dispatch, exp_counts, stats = self.gate.apply(
             params["gate"], tokens, rng=rng, train=train)
+        emit_routing_stats(stats)
 
         tokens_e = tokens
         if tp_axis is not None:  # see _apply_scatter: expert input only

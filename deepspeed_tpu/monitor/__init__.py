@@ -25,17 +25,23 @@ from .health import (FleetHealth, attribute_straggler_lane,
                      format_health_line, straggler_verdict)
 from .heartbeat import (HEARTBEAT_DIR, HeartbeatWriter, annotate_stale,
                         format_watch_table, read_heartbeats)
+from .moe import (ExpertPopularityTracker, MoeRoutingAggregator,
+                  SNAPSHOT_SCHEMA, format_moe_line, snapshot_from_record,
+                  summarize_window, validate_snapshot)
 from .monitor import (METRICS_CSV, METRICS_JSONL, PROFILES_DIR, TRACE_JSON,
                       MetricsStream, TrainingMonitor)
 from .reconcile import (ATTR_COMM_EXPOSED, ATTR_COMM_HIDDEN, ATTR_COMPUTE,
+                        ATTR_EXPERT_HOTSPOT,
                         ATTR_HOST_GAP, ATTR_IO, ATTR_SWAP,
                         FLAG_HBM_ABOVE_BAND,
                         FLAG_HBM_BELOW_BAND, FLAG_MODEL_VIOLATION,
                         FLAG_STEP_TIME_ABOVE_BAND, FLAG_SWAP_BELOW_CEILING,
                         Bands, attribute_gap, bare_summary, format_line,
                         reconcile_window)
-from .record import (EVENT_DIVERGENCE, EVENT_STRAGGLER, KIND_FLEET,
-                     KIND_FLEET_HOST, KIND_HEALTH, KIND_META,
+from .record import (EVENT_DEAD_EXPERT, EVENT_DIVERGENCE,
+                     EVENT_EP_IMBALANCE, EVENT_ROUTER_COLLAPSE,
+                     EVENT_STRAGGLER, KIND_FLEET,
+                     KIND_FLEET_HOST, KIND_HEALTH, KIND_META, KIND_MOE,
                      KIND_RECONCILE, KIND_STEP, SCHEMA_VERSION,
                      STEP_RECORD_FIELDS, device_memory, identity,
                      make_step_record)
@@ -45,9 +51,12 @@ from .writers import (CsvWriter, JsonlWriter, MetricsWriter,
 
 __all__ = [
     "ATTR_COMM_EXPOSED", "ATTR_COMM_HIDDEN", "ATTR_COMPUTE",
-    "ATTR_HOST_GAP", "ATTR_IO",
-    "ATTR_SWAP", "Bands", "CsvWriter", "EVENT_DIVERGENCE",
-    "EVENT_STRAGGLER",
+    "ATTR_EXPERT_HOTSPOT", "ATTR_HOST_GAP", "ATTR_IO",
+    "ATTR_SWAP", "Bands", "CsvWriter", "EVENT_DEAD_EXPERT",
+    "EVENT_DIVERGENCE", "EVENT_EP_IMBALANCE", "EVENT_ROUTER_COLLAPSE",
+    "EVENT_STRAGGLER", "ExpertPopularityTracker", "KIND_MOE",
+    "MoeRoutingAggregator", "SNAPSHOT_SCHEMA", "format_moe_line",
+    "snapshot_from_record", "summarize_window", "validate_snapshot",
     "FLAG_HBM_ABOVE_BAND", "FLAG_HBM_BELOW_BAND", "FLAG_MODEL_VIOLATION",
     "FLAG_STEP_TIME_ABOVE_BAND", "FLAG_SWAP_BELOW_CEILING",
     "FleetAggregator", "FleetHealth", "HEARTBEAT_DIR", "HeartbeatWriter",

@@ -52,6 +52,15 @@ VEC_FIELDS = (
     "swap_exposed_mean_s",  # mean per-step exposed (caller-blocked) swap
     "grad_norm_mean",       # mean global grad norm (sentinel-fed; NaN
                             # when no host-side norm is computed)
+    # ---- MoE routing slots (monitor/moe.py; NaN = absent on dense
+    # configs or with monitor.moe off) — appended after the v2 set so
+    # positional readers of the released slots keep working ------------ #
+    "moe_drop_frac",        # capacity-dropped fraction of routed slots
+    "moe_entropy",          # normalized router entropy (1 = uniform)
+    "moe_imbalance",        # hottest / mean routed expert count
+    "moe_min_count_frac",   # coldest expert count / fair share
+    "moe_coldest_expert",   # coldest expert id (float-encoded index)
+    "moe_local_load",       # this host's local-expert load / fair share
 )
 VEC_LEN = len(VEC_FIELDS)
 _IDX = {name: i for i, name in enumerate(VEC_FIELDS)}
@@ -184,6 +193,8 @@ class FleetAggregator:
                 R.FL_HOST_GAP_MEAN_S: _r(d["host_gap_mean_s"]),
                 R.FL_SWAP_READ_GBPS: _r(d["swap_read_gbps"]),
                 R.FL_SWAP_EXPOSED_S: _r(d["swap_exposed_mean_s"]),
+                R.FL_MOE_DROP_FRAC: _r(d["moe_drop_frac"]),
+                R.FL_MOE_LOCAL_LOAD: _r(d["moe_local_load"]),
             }
             out.append(rec)
         return out
@@ -206,6 +217,17 @@ class FleetAggregator:
             "host_gap_s": _rlist(gap),
             "swap_read_gbps": _rlist(swp),
         }
+        # expert-parallel load skew column, only when any host routed
+        # (dense configs keep the fleet record exactly as before)
+        load = matrix[:, _IDX["moe_local_load"]]
+        if np.isfinite(load).any():
+            rec[R.FL_PER_HOST]["moe_local_load"] = _rlist(load)
+            drop = matrix[:, _IDX["moe_drop_frac"]]
+            finite_drop = drop[np.isfinite(drop)]
+            rec[R.FL_MOE_DROP_FRAC] = (_r(float(finite_drop.mean()))
+                                       if finite_drop.size else None)
+            rec[R.FL_MOE_LOAD_MAX] = _r(float(
+                load[np.isfinite(load)].max()))
         return rec
 
 

@@ -42,7 +42,8 @@ import numpy as np
 from .. import constants as C
 from . import record as R
 from .fleet import _IDX
-from .reconcile import ATTR_COMPUTE, ATTR_HOST_GAP, ATTR_SWAP
+from .reconcile import (ATTR_COMPUTE, ATTR_EXPERT_HOTSPOT, ATTR_HOST_GAP,
+                        ATTR_SWAP)
 
 _VAR_FLOOR = 1e-18
 
@@ -81,14 +82,21 @@ class _Ewma:
 
 
 def attribute_straggler_lane(row: Dict[str, Optional[float]],
-                             median_row: Dict[str, float]) -> str:
+                             median_row: Dict[str, float],
+                             ep_imbalance_ratio: float =
+                             C.MONITOR_MOE_EP_IMBALANCE_RATIO_DEFAULT
+                             ) -> str:
     """Charge a straggler host's excess step time to a lane.
 
     ``row``: the flagged host's decoded window vector; ``median_row``:
     peer medians for the same fields.  The host's excess host-gap and
     excess exposed-swap are subtracted from its excess step time; the
     dominant term names the lane (ties/residual -> compute: the device
-    itself is slow — thermal throttle, a sick chip)."""
+    itself is slow — thermal throttle, a sick chip).  One refinement on
+    the compute residual: when the host's expert-parallel load share
+    sits at or past the EP-imbalance gate vs its peers, the verdict
+    names the expert hot-spot instead of generic compute — the device
+    isn't sick, its local experts are popular (ISSUE 15)."""
     excess_total = ((row.get("step_time_mean_s") or 0.0)
                     - (median_row.get("step_time_mean_s") or 0.0))
     excess_gap = ((row.get("host_gap_mean_s") or 0.0)
@@ -100,6 +108,11 @@ def attribute_straggler_lane(row: Dict[str, Optional[float]],
     # the named lane must explain a meaningful share of the excess
     if value > 0.0 and excess_total > 0.0 and value >= 0.25 * excess_total:
         return lane
+    load = row.get("moe_local_load")
+    load_ref = median_row.get("moe_local_load")
+    if (load is not None and load_ref is not None and load_ref > 0.0
+            and load / load_ref >= ep_imbalance_ratio):
+        return ATTR_EXPERT_HOTSPOT
     return ATTR_COMPUTE
 
 
@@ -115,7 +128,19 @@ class FleetHealth:
                  C.MONITOR_DIVERGENCE_REL_SPREAD_DEFAULT,
                  warmup_windows: int =
                  C.MONITOR_HEALTH_WARMUP_WINDOWS_DEFAULT,
-                 ewma_alpha: float = 0.2):
+                 ewma_alpha: float = 0.2,
+                 dead_expert_threshold: float =
+                 C.MONITOR_MOE_DEAD_EXPERT_THRESHOLD_DEFAULT,
+                 dead_expert_windows: int =
+                 C.MONITOR_MOE_DEAD_EXPERT_WINDOWS_DEFAULT,
+                 entropy_floor: float =
+                 C.MONITOR_MOE_ENTROPY_FLOOR_DEFAULT,
+                 collapse_windows: int =
+                 C.MONITOR_MOE_COLLAPSE_WINDOWS_DEFAULT,
+                 ep_imbalance_ratio: float =
+                 C.MONITOR_MOE_EP_IMBALANCE_RATIO_DEFAULT,
+                 ep_imbalance_windows: int =
+                 C.MONITOR_MOE_EP_IMBALANCE_WINDOWS_DEFAULT):
         self.straggler_zscore = straggler_zscore
         self.straggler_min_ratio = straggler_min_ratio
         self.divergence_rel_spread = divergence_rel_spread
@@ -124,6 +149,24 @@ class FleetHealth:
         self.windows_seen = 0
         self.stragglers_flagged = 0
         self.divergences_flagged = 0
+        # ---- MoE rules (ISSUE 15): deterministic K-consecutive-window
+        # gates, no EWMA baseline to pollute.  The dead-expert and
+        # router-collapse metrics are fleet-global (the gating math is
+        # replicated, every host reports the same value); EP imbalance
+        # is per-host, gated against the leave-one-out PEER median so a
+        # flagged host never defines its own reference — the same
+        # flagged-samples-never-update-baseline discipline as the
+        # straggler detector, realized cross-sectionally.
+        self.dead_expert_threshold = dead_expert_threshold
+        self.dead_expert_windows = dead_expert_windows
+        self.entropy_floor = entropy_floor
+        self.collapse_windows = collapse_windows
+        self.ep_imbalance_ratio = ep_imbalance_ratio
+        self.ep_imbalance_windows = ep_imbalance_windows
+        self._dead_streak = 0
+        self._collapse_streak = 0
+        self._ep_streaks: Dict[int, int] = {}
+        self.moe_events_flagged = 0
 
     # ------------------------------------------------------------------ #
     def observe(self, matrix: np.ndarray,
@@ -172,8 +215,12 @@ class FleetHealth:
                         matrix[:, _IDX["host_gap_mean_s"]], p) or 0.0,
                     "swap_exposed_mean_s": _peer_median(
                         matrix[:, _IDX["swap_exposed_mean_s"]], p) or 0.0,
+                    "moe_local_load": _peer_median(
+                        matrix[:, _IDX["moe_local_load"]], p),
                 }
-                lane = attribute_straggler_lane(row, median_row)
+                lane = attribute_straggler_lane(
+                    row, median_row,
+                    ep_imbalance_ratio=self.ep_imbalance_ratio)
                 self.stragglers_flagged += 1
                 events.append({
                     R.F_KIND: R.KIND_HEALTH,
@@ -201,6 +248,7 @@ class FleetHealth:
                 self._stat.update(float(times[p]))
 
         events.extend(self._check_divergence(matrix, hosts, step))
+        events.extend(self._check_moe(matrix, hosts, step))
         return events
 
     # metric-column -> human name for divergence events; both scalars
@@ -273,10 +321,132 @@ class FleetHealth:
             })
         return events
 
+    # ------------------------------------------------------------------ #
+    # MoE health rules (ISSUE 15): dead expert, router collapse, EP
+    # load imbalance — all deterministic (same matrix in, same events
+    # out on every host), all K-consecutive-window gated, all NaN-inert
+    # on dense configs (the moe_* slots simply never go finite).
+    # ------------------------------------------------------------------ #
+    def _fleet_scalar(self, matrix: np.ndarray, field: str
+                      ) -> Optional[float]:
+        """Fleet-global moe scalar: the gating math is replicated, so
+        every host reports the same value — the median shrugs off a
+        host that missed the window (NaN)."""
+        col = matrix[:, _IDX[field]]
+        finite = col[np.isfinite(col)]
+        return float(np.median(finite)) if finite.size else None
+
+    def _check_moe(self, matrix: np.ndarray, hosts: List[str],
+                   step: Optional[int]) -> List[Dict[str, Any]]:
+        events: List[Dict[str, Any]] = []
+        world = int(matrix.shape[0])
+
+        def base(event: str) -> Dict[str, Any]:
+            return {R.F_KIND: R.KIND_HEALTH, R.H_EVENT: event,
+                    R.F_WORLD_SIZE: world, R.H_STEP: step}
+
+        # -- dead expert: the coldest expert's share of the fair
+        # per-expert load sits at/below the threshold K windows running.
+        # Model-level pathology (the router starved an expert), so the
+        # event carries no process identity — no host self-arms a
+        # capture over it; the record stream and sentinel ring get it.
+        min_frac = self._fleet_scalar(matrix, "moe_min_count_frac")
+        if min_frac is not None and min_frac <= self.dead_expert_threshold:
+            self._dead_streak += 1
+        else:
+            self._dead_streak = 0
+        if self._dead_streak >= self.dead_expert_windows:
+            cold = self._fleet_scalar(matrix, "moe_coldest_expert")
+            self.moe_events_flagged += 1
+            events.append({
+                **base(R.EVENT_DEAD_EXPERT),
+                R.F_HOST: "fleet", R.F_PROCESS_INDEX: None,
+                R.H_RATIO: round(min_frac, 6),
+                "expert": int(cold) if cold is not None else None,
+                "consecutive_windows": self._dead_streak,
+                R.H_DETAIL: (
+                    f"expert {int(cold) if cold is not None else '?'} "
+                    f"received {min_frac * 100:.2f}% of its fair token "
+                    f"share for {self._dead_streak} consecutive windows "
+                    f"(threshold {self.dead_expert_threshold * 100:.1f}%)"
+                    " — a dead expert wastes its parameters and, under "
+                    "expert streaming, its NVMe slot"),
+            })
+
+        # -- router collapse: normalized entropy under the floor K
+        # windows running — the router concentrated onto a few experts
+        # (l_aux too weak / gate logits saturated); capacity drops and
+        # dead experts follow.
+        ent = self._fleet_scalar(matrix, "moe_entropy")
+        if ent is not None and ent <= self.entropy_floor:
+            self._collapse_streak += 1
+        else:
+            self._collapse_streak = 0
+        if self._collapse_streak >= self.collapse_windows:
+            self.moe_events_flagged += 1
+            events.append({
+                **base(R.EVENT_ROUTER_COLLAPSE),
+                R.F_HOST: "fleet", R.F_PROCESS_INDEX: None,
+                R.H_RATIO: round(ent, 6),
+                "consecutive_windows": self._collapse_streak,
+                R.H_DETAIL: (
+                    f"normalized router entropy {ent:.4f} has sat at or "
+                    f"under the {self.entropy_floor:.2f} floor for "
+                    f"{self._collapse_streak} consecutive windows — the "
+                    "router is collapsing onto a few experts (raise "
+                    "moe_aux_loss_coef or check the gate's lr)"),
+            })
+
+        # -- EP load imbalance: a host whose LOCAL experts carry >=
+        # ratio x the leave-one-out peer-median load for K consecutive
+        # windows.  Per-host: the flagged host gets the event (and arms
+        # its own capture), lane-attributed as an expert hot-spot so
+        # the verdict reads "expert hot-spot on host w2", not generic
+        # compute.
+        load = matrix[:, _IDX["moe_local_load"]]
+        seen = set()
+        for p in range(world):
+            v = float(load[p])
+            if not math.isfinite(v):
+                continue
+            seen.add(p)
+            ref = _peer_median(load, p)
+            ratio = v / ref if ref else 1.0
+            if ref and ratio >= self.ep_imbalance_ratio:
+                self._ep_streaks[p] = self._ep_streaks.get(p, 0) + 1
+            else:
+                self._ep_streaks[p] = 0
+                continue
+            if self._ep_streaks[p] < self.ep_imbalance_windows:
+                continue
+            host = hosts[p] if p < len(hosts) else f"p{p}"
+            self.moe_events_flagged += 1
+            events.append({
+                **base(R.EVENT_EP_IMBALANCE),
+                R.F_HOST: host, R.F_PROCESS_INDEX: p,
+                R.H_LANE: ATTR_EXPERT_HOTSPOT,
+                R.H_RATIO: round(ratio, 3),
+                "local_load": round(v, 4),
+                "peer_median_load": round(ref, 4),
+                "consecutive_windows": self._ep_streaks[p],
+                R.H_DETAIL: (
+                    f"expert hot-spot on host {host}: its local experts "
+                    f"carry {v:.2f}x their fair token share, "
+                    f"{ratio:.2f}x the peer median ({ref:.2f}), for "
+                    f"{self._ep_streaks[p]} consecutive windows — "
+                    "rebalance experts or tune capacity_factor"),
+            })
+        # a host that left the fleet (elastic reshape) drops its streak
+        for p in list(self._ep_streaks):
+            if p not in seen:
+                del self._ep_streaks[p]
+        return events
+
     def counters(self) -> Dict[str, int]:
         return {"fleet_windows": self.windows_seen,
                 "stragglers_flagged": self.stragglers_flagged,
-                "divergences_flagged": self.divergences_flagged}
+                "divergences_flagged": self.divergences_flagged,
+                "moe_events_flagged": self.moe_events_flagged}
 
 
 def _peer_median(col: np.ndarray, p: int) -> Optional[float]:
@@ -309,12 +479,17 @@ def _window_step(matrix: np.ndarray) -> Optional[int]:
 def straggler_verdict(matrix: np.ndarray,
                       hosts: Optional[List[str]] = None,
                       min_ratio: float =
-                      C.MONITOR_STRAGGLER_MIN_RATIO_DEFAULT
+                      C.MONITOR_STRAGGLER_MIN_RATIO_DEFAULT,
+                      ep_imbalance_ratio: float =
+                      C.MONITOR_MOE_EP_IMBALANCE_RATIO_DEFAULT
                       ) -> Dict[str, Any]:
     """Single-window cross-sectional verdict (no EWMA history) — the
     form bench rows embed: with one measured window there is no baseline
     to z-score against, so the verdict is purely ratio-vs-fleet-median.
-    A 1-host matrix is the degenerate case: ratio 1.0, no straggler."""
+    A 1-host matrix is the degenerate case: ratio 1.0, no straggler.
+    ``ep_imbalance_ratio`` gates the expert-hotspot lane exactly like
+    the live detector — pass the configured monitor.moe value so the
+    two surfaces can never disagree on the same window matrix."""
     matrix = np.asarray(matrix, dtype=np.float64)
     hosts = hosts or [f"p{i}" for i in range(matrix.shape[0])]
     times = matrix[:, _IDX["step_time_mean_s"]]
@@ -339,9 +514,12 @@ def straggler_verdict(matrix: np.ndarray,
                 matrix[:, _IDX["host_gap_mean_s"]], worst) or 0.0,
             "swap_exposed_mean_s": _peer_median(
                 matrix[:, _IDX["swap_exposed_mean_s"]], worst) or 0.0,
+            "moe_local_load": _peer_median(
+                matrix[:, _IDX["moe_local_load"]], worst),
         }
         out["host"] = hosts[worst] if worst < len(hosts) else f"p{worst}"
-        out["lane"] = attribute_straggler_lane(row, median_row)
+        out["lane"] = attribute_straggler_lane(
+            row, median_row, ep_imbalance_ratio=ep_imbalance_ratio)
     return out
 
 

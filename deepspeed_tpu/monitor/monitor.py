@@ -77,12 +77,22 @@ class MetricsStream:
                  identity: Optional[Dict[str, Any]] = None,
                  window_hook: Optional[Callable[[dict],
                                                 Optional[List[dict]]]] = None,
-                 assemble_records: bool = True):
+                 assemble_records: bool = True,
+                 moe_stats_fn: Optional[Callable[[],
+                                                 Optional[dict]]] = None,
+                 moe_hook: Optional[Callable] = None):
         self.window = max(1, int(window))
         self._sink = sink
         self._boundary_fn = boundary_fn
         self._swap_stats_fn = swap_stats_fn
         self._reconciler = reconciler
+        # MoE routing observability (monitor/moe.py): moe_stats_fn is
+        # the engine's flush-boundary fetch-and-reset of the device-
+        # resident RoutingStats accumulator — the ONLY host read of it,
+        # same cadence as the loss/memory reads; moe_hook turns the raw
+        # window into (record, fleet-vector fields)
+        self._moe_stats_fn = moe_stats_fn
+        self._moe_hook = moe_hook
         # False on fleet non-emitter ranks: no writer consumes step
         # records there, so the flush skips record assembly AND the
         # records-only boundary reads (lr / loss-scale) — the loss fetch,
@@ -187,6 +197,32 @@ class MetricsStream:
                 swap = self._swap_stats_fn()
             except Exception:  # noqa: BLE001
                 swap = None
+        # MoE routing window: ONE batched fetch of the device-resident
+        # accumulator (the engine resets it), consumed by the moe record
+        # on emitter ranks and by the fleet window vector's moe_* slots
+        # on every fleet rank — a heartbeat-only non-emitter has neither
+        # consumer and skips the transfer like the loss fetch below
+        moe_fields: Dict[str, Any] = {}
+        moe_records: List[dict] = []
+        if (self._moe_stats_fn is not None and self._moe_hook is not None
+                and (self._assemble_records
+                     or self._window_hook is not None)):
+            try:
+                moe_raw = self._moe_stats_fn()
+            except Exception as e:  # noqa: BLE001 — never fail a step
+                logger.warning(f"monitor: moe stats fetch failed ({e})")
+                moe_raw = None
+            if moe_raw is not None:
+                try:
+                    rec_moe, moe_fields = self._moe_hook(
+                        moe_raw, pending[0]["step"], pending[-1]["step"])
+                    if rec_moe is not None and self._assemble_records:
+                        moe_records.append(rec_moe)
+                    moe_fields = moe_fields or {}
+                except Exception as e:  # noqa: BLE001
+                    logger.warning(
+                        f"monitor: moe window processing failed ({e})")
+                    moe_fields = {}
         # losses feed records and the fleet summary; a heartbeat-only
         # non-emitter rank (no writers, no fleet hook) has neither
         # consumer — skip the per-window device transfer entirely
@@ -222,6 +258,7 @@ class MetricsStream:
             })
             if rec is not None and self._assemble_records:
                 records.append(rec)
+        records.extend(moe_records)
         for rec in records:
             for k, v in self._identity.items():
                 rec.setdefault(k, v)
@@ -255,6 +292,9 @@ class MetricsStream:
                 "swap_exposed_mean_s": (sum(exposed) / len(exposed)
                                         if exposed else None),
             }
+            # the moe_* slots of the fleet window vector (NaN-absent on
+            # dense configs — fleet.py VEC_FIELDS)
+            summary.update(moe_fields)
             try:
                 extra = self._window_hook(summary)
             except Exception as e:  # noqa: BLE001
@@ -299,6 +339,8 @@ class TrainingMonitor:
                  summary_writer: Any = None,
                  boundary_fn: Optional[Callable[[], Dict[str, Any]]] = None,
                  swap_stats_fn: Optional[Callable[[], Optional[dict]]] = None,
+                 moe_stats_fn: Optional[Callable[[],
+                                                 Optional[dict]]] = None,
                  meta: Optional[Dict[str, Any]] = None,
                  process_index: Optional[int] = None,
                  world_size: Optional[int] = None,
@@ -359,11 +401,31 @@ class TrainingMonitor:
                 process_index=self.process_index,
                 process_count=self.world_size,
                 host=self.identity[R.F_HOST], gather_fn=gather_fn)
+            moe_knobs = {}
+            if getattr(cfg, "moe", None) is not None:
+                moe_knobs = dict(
+                    dead_expert_threshold=cfg.moe.dead_expert_threshold,
+                    dead_expert_windows=cfg.moe.dead_expert_windows,
+                    entropy_floor=cfg.moe.entropy_floor,
+                    collapse_windows=cfg.moe.collapse_windows,
+                    ep_imbalance_ratio=cfg.moe.ep_imbalance_ratio,
+                    ep_imbalance_windows=cfg.moe.ep_imbalance_windows)
             self.health = FleetHealth(
                 straggler_zscore=cfg.straggler_zscore,
                 straggler_min_ratio=cfg.straggler_min_ratio,
                 divergence_rel_spread=cfg.divergence_rel_spread,
-                warmup_windows=cfg.health_warmup_windows)
+                warmup_windows=cfg.health_warmup_windows,
+                **moe_knobs)
+
+        # ---- MoE routing observability (monitor/moe.py, ISSUE 15) ---- #
+        self.moe_agg = None
+        moe_cfg = getattr(cfg, "moe", None)
+        if (moe_cfg is not None and moe_cfg.enabled
+                and moe_stats_fn is not None):
+            from .moe import MoeRoutingAggregator
+            self.moe_agg = MoeRoutingAggregator(
+                ewma_alpha=moe_cfg.popularity_ewma_alpha,
+                hot_k=moe_cfg.hot_k, identity=self.identity)
 
         self.heartbeat: Optional[HeartbeatWriter] = None
         if getattr(cfg, "heartbeat", False):
@@ -399,6 +461,10 @@ class TrainingMonitor:
             identity=self.identity,
             window_hook=(self._fleet_window if self.fleet is not None
                          else None),
+            moe_stats_fn=(moe_stats_fn if self.moe_agg is not None
+                          else None),
+            moe_hook=(self._moe_window if self.moe_agg is not None
+                      else None),
             # non-emitter ranks have no writers: skip record assembly
             # and the records-only boundary reads on them
             assemble_records=self.is_emitter)
@@ -418,6 +484,7 @@ class TrainingMonitor:
             f"trace={'on' if self.trace else 'off'} "
             f"reconcile={'on' if reconciler else 'off'} "
             f"fleet={'on' if self.fleet else 'off'} "
+            f"moe={'on' if self.moe_agg else 'off'} "
             f"heartbeat={'on' if self.heartbeat else 'off'} "
             f"capture={'armed-standby' if self.capture else 'off'} "
             f"-> {self.out_dir}", ranks=[0])
@@ -503,6 +570,27 @@ class TrainingMonitor:
         else:
             log_dist(format_line(rec), ranks=[0])
         return rec
+
+    def _moe_window(self, raw: Dict[str, Any],
+                    window_start: Optional[int],
+                    window_end: Optional[int]):
+        """Flush-boundary MoE hook: one fetched RoutingStats accumulator
+        -> (the window's ``moe`` record with the popularity snapshot
+        embedded, the moe_* fleet-vector fields).  Also samples the
+        Perfetto counter lanes (per-window drop rate + expert-load
+        imbalance) so routing pathology lines up with the step-phase
+        timeline in the same trace."""
+        from .moe import format_moe_line
+        rec = self.moe_agg.observe_window(raw, window_start, window_end)
+        fields = self.moe_agg.fleet_fields()
+        if rec is not None:
+            if self.trace is not None and not self.trace.saturated:
+                self.trace.add_counter(
+                    "moe routing", time.perf_counter(),
+                    {"drop_fraction": rec.get(R.M_DROP_FRAC),
+                     "imbalance": rec.get(R.M_IMBALANCE)})
+            log_dist(format_moe_line(rec), ranks=[0])
+        return rec, fields
 
     def _fleet_window(self, summary: Dict[str, Any]) -> List[dict]:
         """FULL-window hook: one fixed-shape allgather of this host's

@@ -46,6 +46,11 @@ ATTR_SWAP = "io-bound (swap exposed)"
 # fleet-health lane (health.py straggler attribution): the excess step
 # time sits BETWEEN dispatches — dataloader / host work, not the device
 ATTR_HOST_GAP = "host-gap"
+# fleet-health lane (health.py MoE rules): the host's excess is explained
+# by expert-parallel load skew — its local experts carry more than the
+# peer-median share of routed tokens, so its expert FFN pass is longer
+# ("expert hot-spot on host w2" instead of generic compute)
+ATTR_EXPERT_HOTSPOT = "expert-hotspot"
 
 _LANE_ATTR = {"compute": ATTR_COMPUTE, "memory": ATTR_IO,
               "hidden_comm": ATTR_COMM_HIDDEN,

@@ -35,6 +35,10 @@ KIND_META = "meta"
 KIND_FLEET_HOST = "fleet_host"
 KIND_FLEET = "fleet"
 KIND_HEALTH = "health"
+# MoE routing telemetry (monitor/moe.py): one record per flush window
+# summarizing the device-resident RoutingStats accumulator — expert
+# popularity, drop/overflow accounting, router entropy/confidence
+KIND_MOE = "moe"
 
 # ---- per-step field names (the schema) ------------------------------- #
 F_KIND = "kind"
@@ -92,6 +96,10 @@ FL_HOST_GAP_MEAN_S = "host_gap_mean_s"
 FL_SWAP_READ_GBPS = "swap_read_gbps"
 FL_SWAP_EXPOSED_S = "swap_exposed_mean_s"
 FL_PER_HOST = "per_host"
+# MoE routing slots (fleet.py moe_* vector fields; absent on dense runs)
+FL_MOE_DROP_FRAC = "moe_drop_frac"
+FL_MOE_LOCAL_LOAD = "moe_local_load"
+FL_MOE_LOAD_MAX = "moe_local_load_max"
 # health-event field names (health.py)
 H_EVENT = "event"
 H_STEP = "step"
@@ -103,6 +111,28 @@ H_METRIC = "metric"
 H_SPREAD = "spread"
 EVENT_STRAGGLER = "straggler"
 EVENT_DIVERGENCE = "divergence"
+# MoE health events (health.py MoE rules, ISSUE 15)
+EVENT_DEAD_EXPERT = "dead_expert"
+EVENT_ROUTER_COLLAPSE = "router_collapse"
+EVENT_EP_IMBALANCE = "ep_imbalance"
+
+# ---- MoE routing field names (monitor/moe.py payload) ----------------- #
+M_WINDOW_START = "window_start_step"
+M_WINDOW_END = "window_end_step"
+M_STEPS = "steps"
+M_EXPERTS = "num_experts"
+M_LAYERS_PER_STEP = "layers_per_step"
+M_TOKENS_PER_STEP = "tokens_per_step"
+M_DROP_FRAC = "drop_fraction"
+M_COUNTS = "expert_counts"
+M_OVERFLOW = "overflow_counts"
+M_IMBALANCE = "imbalance"          # hottest / mean routed count
+M_MIN_COUNT_FRAC = "min_count_frac"  # coldest / fair share
+M_ENTROPY = "router_entropy"       # normalized [0, 1] (1 = uniform)
+M_CONFIDENCE = "router_confidence"  # mean raw top-k gate mass per token
+M_LAUX = "l_aux_mean"              # per gate invocation
+M_LOCAL_LOAD = "local_expert_load"  # this host's load vs fair share
+M_POPULARITY = "popularity"        # embedded ExpertPopularitySnapshot
 
 # ---- reconciliation field names (reconcile.py payload) --------------- #
 R_WINDOW_START = "window_start_step"

@@ -32,9 +32,11 @@ TID_STEP = 1
 TID_SWAP_IN = 2
 TID_SWAP_OUT = 3
 TID_MARKS = 4
+TID_MOE = 5
 
 _LANE_NAMES = {TID_STEP: "step phases", TID_SWAP_IN: "swap in (NVMe read)",
-               TID_SWAP_OUT: "swap out (NVMe write)", TID_MARKS: "monitor"}
+               TID_SWAP_OUT: "swap out (NVMe write)", TID_MARKS: "monitor",
+               TID_MOE: "moe routing"}
 
 
 class TraceEventBuffer:
@@ -110,6 +112,25 @@ class TraceEventBuffer:
         if a:
             ev["args"] = a
         self.events.append(ev)
+
+    def add_counter(self, name: str, t: float,
+                    values: Dict[str, float],
+                    tid: int = TID_MOE) -> None:
+        """One counter sample (``ph: "C"`` — Perfetto renders these as
+        stacked value tracks).  Used for the per-window MoE routing
+        lanes: drop rate and expert-load imbalance sampled at every
+        flush boundary.  Absent (None) values are SKIPPED, not zeroed
+        — a window that routed nothing must read as a gap in the
+        counter track, never as a confident 0.0."""
+        args = {k: round(float(v), 6)
+                for k, v in values.items() if v is not None}
+        if not args:
+            return
+        self._name_lane(tid)
+        self.events.append({
+            "name": name, "cat": "counter", "ph": "C",
+            "ts": round(self._ts(t), 3), "pid": self._pid, "tid": tid,
+            "args": args})
 
     def add_instant(self, name: str, t: float, tid: int = TID_MARKS,
                     args: Optional[Dict[str, Any]] = None) -> None:

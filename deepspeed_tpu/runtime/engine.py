@@ -308,6 +308,21 @@ class DeepSpeedEngine:
         # lazily-traced collective lockstep signature (reshard re-verify)
         self._lockstep_sig_cache = None
 
+        # ---- MoE routing observability (monitor.moe; docs/telemetry.md)
+        # Decided BEFORE the programs are built: the RoutingStats
+        # accumulation is traced INTO the step programs, and every
+        # process must trace the same program (lockstep) whether or not
+        # it consumes the stats.  The accumulator is device-resident,
+        # summed across layers/microbatches/steps in-program or via the
+        # tiny donated add below, and host-read ONLY at monitor
+        # flush-window boundaries (_monitor_moe_stats).
+        mon_cfg = self.config.monitor_config
+        self._moe_stats_enabled = bool(mon_cfg.enabled
+                                       and mon_cfg.moe.enabled)
+        self._moe_stats_acc = None
+        self._moe_stats_steps = 0
+        self._moe_acc_fn = None
+
         # ---- compiled programs --------------------------------------- #
         self._build_functions()
 
@@ -693,6 +708,14 @@ class DeepSpeedEngine:
             return grads
 
         custom_grad_program = getattr(self, "_custom_grad_program", None)
+        moe_stats = self._moe_stats_enabled
+        if moe_stats and custom_grad_program is not None:
+            logger.warning(
+                "monitor.moe: the custom grad program (pipeline 1F1B "
+                "executor) schedules its own differentiation — routing "
+                "stats cannot be collected there; disabling MoE routing "
+                "telemetry for this engine")
+            moe_stats = self._moe_stats_enabled = False
         sparse_paths = ()
         if self.config.sparse_gradients_enabled:
             sparse_paths = tuple(getattr(self.module, "sparse_grad_paths",
@@ -734,18 +757,34 @@ class DeepSpeedEngine:
 
             def loss_fn(p):
                 cp = _tree_cast(p, compute_dtype)
-                out = apply_model(cp, rng, *args, **kwargs)
+                if moe_stats:
+                    # tap installed in the SAME trace scope as the gate
+                    # emissions (moe/sharded_moe.py); the summed pytree
+                    # rides out as a grad aux output — pure device math,
+                    # no callbacks, no collectives (the host-sync audit
+                    # and lockstep signature are pinned unchanged by
+                    # tests/unit/test_moe_monitor.py)
+                    from ..moe.sharded_moe import (collect_routing_stats,
+                                                   sum_routing_stats)
+                    with collect_routing_stats() as tap:
+                        out = apply_model(cp, rng, *args, **kwargs)
+                    stats = sum_routing_stats(tap)
+                else:
+                    out = apply_model(cp, rng, *args, **kwargs)
+                    stats = None
                 if isinstance(out, tuple):
                     loss = out[0]
                 else:
                     loss = out
                 scaled = (loss.astype(jnp.float32) *
                           scaler_state.loss_scale)
-                return scaled, loss
-            (_, loss), grads = jax.value_and_grad(
+                return scaled, (loss, stats)
+            (_, (loss, stats)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
             if prescale and predivide:
                 grads = jax.tree.map(lambda g: g / predivide, grads)
+            if moe_stats:
+                return loss, _grads_out(grads), stats
             return loss, _grads_out(grads)
 
         from ..parallel.mesh import ZERO_AXES
@@ -756,6 +795,14 @@ class DeepSpeedEngine:
             # engine.py:1729-1792): each shard ships (token indices, touched
             # rows) and every shard scatter-adds the gathered pairs — comm
             # volume O(batch·seq·hidden·dp) instead of O(vocab·hidden).
+            if moe_stats:
+                logger.warning(
+                    "monitor.moe: the sparse_gradients shard_map region "
+                    "does not thread routing stats out of its manual "
+                    "collectives — disabling MoE routing telemetry for "
+                    "this engine (sparse embeddings + MoE experts is an "
+                    "unmonitored combination)")
+                moe_stats = self._moe_stats_enabled = False
             mesh = self.mesh_ctx.mesh
             dpw = int(np.prod([self.mesh_ctx.axis_size(a) for a in manual]))
 
@@ -834,9 +881,14 @@ class DeepSpeedEngine:
         # the un-jitted body doubles as the fused whole-step program's scan
         # body (runtime/fused_step.py) — one definition, two compilations
         self._loss_and_grads = loss_and_grads
+        grad_out_shardings = (replicated, self.grad_shardings)
+        if moe_stats:
+            # the RoutingStats aux (a prefix `replicated` broadcasts
+            # over the pytree — or over None when the model has no MoE
+            # layers, in which case the accumulator simply never fills)
+            grad_out_shardings = grad_out_shardings + (replicated,)
         self._grad_fn = jax.jit(
-            loss_and_grads,
-            out_shardings=(replicated, self.grad_shardings))
+            loss_and_grads, out_shardings=grad_out_shardings)
 
         def accumulate(acc, grads):
             return jax.tree.map(jnp.add, acc, grads)
@@ -1040,8 +1092,13 @@ class DeepSpeedEngine:
         trace_on = self.monitor is not None and self.monitor.trace_active
         if trace_on:
             _tp0 = time.perf_counter()
-        loss, grads = self._grad_fn(self.params, self.scaler_state,
-                                    rng, *args, **kwargs)
+        if self._moe_stats_enabled:
+            loss, grads, moe_stats = self._grad_fn(
+                self.params, self.scaler_state, rng, *args, **kwargs)
+            self._moe_note_stats(moe_stats)
+        else:
+            loss, grads = self._grad_fn(self.params, self.scaler_state,
+                                        rng, *args, **kwargs)
         if trace_on:
             # host DISPATCH window of the grad program (XLA executes
             # asynchronously behind it) — the async-host-loop timeline
@@ -1137,6 +1194,8 @@ class DeepSpeedEngine:
         self._grad_acc = None
         self._last_overflow = overflow
         self.global_steps += 1
+        if self._moe_stats_enabled:
+            self._moe_stats_steps += 1
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self.global_steps)
         # fp16 dynamic scaling: fetch the overflow flag (the reference's
@@ -1269,6 +1328,8 @@ class DeepSpeedEngine:
             predictions=predictions,
             summary_writer=self._summary_writer,
             boundary_fn=self._monitor_boundary_reads,
+            moe_stats_fn=(self._monitor_moe_stats
+                          if self._moe_stats_enabled else None),
             process_index=jax.process_index(),
             world_size=jax.process_count(),
             # fleet health events (straggler/divergence) land in the
@@ -1308,6 +1369,86 @@ class DeepSpeedEngine:
             counters[mrec.F_RETRACES] = (
                 self._recompile_guard.counters().get("retraces_seen"))
         return counters
+
+    # ------------------------------------------------------------------ #
+    # MoE routing stats accumulator (monitor.moe; docs/telemetry.md)
+    # ------------------------------------------------------------------ #
+    def _moe_note_stats(self, stats) -> None:
+        """Fold one dispatch's RoutingStats into the device-resident
+        accumulator.  Pure dispatch work: the add is a tiny jitted
+        program over a few scalars and two [E] vectors, the inputs stay
+        device arrays, and NOTHING is read until the monitor's flush
+        boundary (_monitor_moe_stats)."""
+        if stats is None:
+            return  # dense model under monitor.moe — nothing to count
+        if self._moe_stats_acc is None:
+            self._moe_stats_acc = stats
+            return
+        if self._moe_acc_fn is None:
+            self._moe_acc_fn = jax.jit(
+                lambda a, b: jax.tree.map(jnp.add, a, b),
+                donate_argnums=(0,))
+        self._moe_stats_acc = self._moe_acc_fn(self._moe_stats_acc, stats)
+
+    def _moe_local_expert_slice(self, num_experts: int):
+        """(lo, hi) — the contiguous range of expert ids whose parameters
+        live on THIS process's shard of the expert mesh axis (stacked
+        expert params are sharded over EXPERT_AXIS dim 0, so the mapping
+        is positional).  Feeds the per-host load-skew slot of the fleet
+        window vector; best-effort (0, E) — i.e. load exactly fair —
+        when the process's expert coordinate cannot be resolved."""
+        from ..parallel.mesh import EXPERT_AXIS
+        ep = self.mesh_ctx.axis_size(EXPERT_AXIS)
+        if ep <= 1 or num_experts % ep != 0 or jax.process_count() <= 1:
+            return (0, num_experts)
+        per = num_experts // ep
+        try:
+            # the UNION of expert-axis coordinates across ALL local
+            # devices — a host whose devices span several expert shards
+            # (the common layout: 'expert' is inner of 'data', so one
+            # host often holds every shard) owns the union, and when
+            # that union is the whole axis its load is exactly fair by
+            # construction.  Resolving only local_devices()[0] would
+            # report shard 0's load on every host and blind the EP-
+            # imbalance rule.
+            mesh = self.mesh_ctx.mesh
+            axis = list(mesh.axis_names).index(EXPERT_AXIS)
+            coords = set()
+            for dev in jax.local_devices():
+                pos = np.argwhere(mesh.devices == dev)
+                if pos.size:
+                    coords.add(int(pos[0][axis]))
+            if not coords:
+                return (0, num_experts)
+            lo_c, hi_c = min(coords), max(coords)
+            if len(coords) != hi_c - lo_c + 1:
+                # non-contiguous ownership: a single (lo, hi) slice
+                # cannot describe it — degrade to exactly-fair
+                return (0, num_experts)
+        except Exception:  # noqa: BLE001 — telemetry must not crash
+            return (0, num_experts)
+        return (lo_c * per, (hi_c + 1) * per)
+
+    def _monitor_moe_stats(self):
+        """Monitor flush-boundary hook: ONE batched host read of the
+        routing accumulator, then reset.  Never called per step — the
+        MetricsStream invokes it only where it fetches losses/memory
+        (the boundary-only contract the host-sync audit pins)."""
+        acc, self._moe_stats_acc = self._moe_stats_acc, None
+        steps, self._moe_stats_steps = self._moe_stats_steps, 0
+        if acc is None:
+            return None
+        try:
+            host = jax.device_get(acc)
+        except Exception as e:  # noqa: BLE001
+            logger.warning(f"monitor.moe: stats fetch failed ({e})")
+            return None
+        raw = {name: np.asarray(v)
+               for name, v in zip(type(acc)._fields, host)}
+        raw["steps"] = max(1, int(steps))
+        raw["local_expert_slice"] = self._moe_local_expert_slice(
+            int(raw["expert_counts"].shape[0]))
+        return raw
 
     def _monitor_note_batch(self, tree) -> None:
         """Capture the sequence length from batch SHAPES (host metadata,
@@ -1713,11 +1854,19 @@ class DeepSpeedEngine:
         trace_on = self.monitor is not None and self.monitor.trace_active
         if trace_on:
             _tp0 = time.perf_counter()
-        (self.params, self.opt_state, self.scaler_state,
-         self._fused_sent_state, loss, overflow,
-         sent_flags) = self._fused_step_fn(
+        fused_out = self._fused_step_fn(
             self.params, self.opt_state, self.scaler_state,
             self._fused_sent_state, rng, args, {})
+        if self._moe_stats_enabled:
+            (self.params, self.opt_state, self.scaler_state,
+             self._fused_sent_state, loss, overflow, sent_flags,
+             moe_stats) = fused_out
+            self._moe_note_stats(moe_stats)
+            self._moe_stats_steps += 1
+        else:
+            (self.params, self.opt_state, self.scaler_state,
+             self._fused_sent_state, loss, overflow,
+             sent_flags) = fused_out
         if trace_on:
             self.monitor.add_phase(
                 getattr(self, "_fused_dispatch_label", "fused_dispatch"),

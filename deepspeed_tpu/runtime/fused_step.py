@@ -131,6 +131,11 @@ def build_fused_step(engine):
     """
     gas = engine.gradient_accumulation_steps()
     loss_and_grads = engine._loss_and_grads
+    # MoE routing stats (monitor.moe): the scan body's aux RoutingStats
+    # ride out as stacked scan outputs and are summed over the [gas]
+    # axis IN-program — the accumulator crosses the microbatch scan
+    # without a host touch (docs/telemetry.md)
+    moe_stats = getattr(engine, "_moe_stats_enabled", False)
     apply_core = engine._apply_core
     if apply_core is None:  # pragma: no cover — guarded by fallback_reason
         raise RuntimeError("fused_step requires the compiled apply path")
@@ -190,14 +195,23 @@ def build_fused_step(engine):
         def body(carry, xs):
             acc, loss_sum = carry
             r, mb_args, mb_kwargs = xs
-            loss, grads = loss_and_grads(params, scaler_state, r,
-                                         *mb_args, **mb_kwargs)
+            if moe_stats:
+                loss, grads, stats = loss_and_grads(
+                    params, scaler_state, r, *mb_args, **mb_kwargs)
+            else:
+                loss, grads = loss_and_grads(params, scaler_state, r,
+                                             *mb_args, **mb_kwargs)
+                stats = None
             acc = jax.tree.map(jnp.add, acc, grads)
-            return (acc, loss_sum + loss.astype(jnp.float32)), None
+            return (acc, loss_sum + loss.astype(jnp.float32)), stats
 
-        (grads, loss_sum), _ = lax.scan(
+        (grads, loss_sum), stats_stack = lax.scan(
             body, (zeros, jnp.zeros((), jnp.float32)),
             (rngs, batch_args, batch_kwargs))
+        # stacked [gas, ...] RoutingStats -> one per-step sum (None
+        # passes through tree.map untouched: a dense model under
+        # monitor.moe, or moe_stats off)
+        moe_out = jax.tree.map(lambda x: x.sum(axis=0), stats_stack)
         mean_loss = loss_sum / gas
 
         healthy = jnp.asarray(True)
@@ -214,8 +228,11 @@ def build_fused_step(engine):
                 healthy = ~flagged
         new_params, new_opt, new_scaler, overflow = apply_core(
             params, opt_state, scaler_state, grads, healthy)
-        return (new_params, new_opt, new_scaler, new_sent, mean_loss,
-                overflow, (flagged, nonfinite))
+        out = (new_params, new_opt, new_scaler, new_sent, mean_loss,
+               overflow, (flagged, nonfinite))
+        if moe_stats:
+            out = out + (moe_out,)
+        return out
 
     replicated = engine.mesh_ctx.replicated()
     sent_shardings = jax.tree.map(lambda _: replicated,
@@ -232,9 +249,14 @@ def build_fused_step(engine):
     # path is ONE dispatch where the modular loop issues 2*gas
     engine._dispatches_per_step = 1
     engine._fused_dispatch_label = f"fused_step(gas={gas})"
+    out_shardings = (engine.param_shardings, engine.opt_shardings,
+                     replicated, sent_shardings, replicated, replicated,
+                     (replicated, replicated))
+    if moe_stats:
+        # prefix sharding broadcasts over the RoutingStats pytree (or
+        # over None when the model has no MoE layers)
+        out_shardings = out_shardings + (replicated,)
     return jax.jit(
         fused_step,
-        out_shardings=(engine.param_shardings, engine.opt_shardings,
-                       replicated, sent_shardings, replicated, replicated,
-                       (replicated, replicated)),
+        out_shardings=out_shardings,
         donate_argnums=engine._fused_donate_argnums)

@@ -65,7 +65,10 @@ def test_resilience_package_imports_cleanly():
             "deepspeed_tpu.monitor.fleet",
             "deepspeed_tpu.monitor.health",
             "deepspeed_tpu.monitor.heartbeat",
-            "deepspeed_tpu.monitor.capture")
+            "deepspeed_tpu.monitor.capture",
+            # MoE routing observability (monitor.moe is lazily reachable
+            # through TrainingMonitor and the bench moe rows)
+            "deepspeed_tpu.monitor.moe")
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     out = subprocess.run(

@@ -25,7 +25,10 @@ import jax
 
 import deepspeed_tpu as ds
 from deepspeed_tpu.config import DeepSpeedConfigError, MonitorConfig
-from deepspeed_tpu.monitor import (ATTR_HOST_GAP, ATTR_SWAP, EVENT_DIVERGENCE,
+from deepspeed_tpu.monitor import (ATTR_COMPUTE, ATTR_EXPERT_HOTSPOT,
+    ATTR_HOST_GAP,
+    ATTR_SWAP, EVENT_DEAD_EXPERT, EVENT_DIVERGENCE, EVENT_EP_IMBALANCE,
+    EVENT_ROUTER_COLLAPSE,
     EVENT_STRAGGLER, KIND_FLEET, KIND_FLEET_HOST, KIND_HEALTH, KIND_RECONCILE,
     KIND_STEP, SCHEMA_VERSION, STEP_RECORD_FIELDS, FleetAggregator,
     FleetHealth, HeartbeatWriter, ProfileCapture, TrainingMonitor,
@@ -41,11 +44,17 @@ from deepspeed_tpu.runtime.resilience.sentinel import TrainingSentinel
 # --------------------------------------------------------------------- #
 # fake-fleet plumbing
 # --------------------------------------------------------------------- #
-def _summary(t, loss=2.0, gap=0.0, swap_exp=0.0, step=10, gbps=None):
-    return {"last_step": step, "steps": 5, "step_time_mean_s": t,
-            "step_time_max_s": t, "loss_mean": loss,
-            "host_gap_mean_s": gap, "swap_read_gbps": gbps,
-            "swap_exposed_mean_s": swap_exp}
+def _summary(t, loss=2.0, gap=0.0, swap_exp=0.0, step=10, gbps=None,
+             **moe):
+    """Window summary; the moe_* slots default ABSENT (NaN on the wire)
+    exactly like a dense config — pass e.g. moe_local_load=2.0 to rig
+    an expert-parallel fleet."""
+    d = {"last_step": step, "steps": 5, "step_time_mean_s": t,
+         "step_time_max_s": t, "loss_mean": loss,
+         "host_gap_mean_s": gap, "swap_read_gbps": gbps,
+         "swap_exposed_mean_s": swap_exp}
+    d.update(moe)
+    return d
 
 
 def _matrix(rows):
@@ -902,3 +911,221 @@ def test_bench_fleet_summary_degenerate_single_host():
     assert fl["straggler"]["straggler"] is False
     assert len(fl["host_names"]) == 1
     assert "error" not in fl
+
+
+# --------------------------------------------------------------------- #
+# MoE health rules (ISSUE 15): dead expert, router collapse, EP load
+# imbalance — rigged fleet matrices through the full sentinel ->
+# capture-arming path
+# --------------------------------------------------------------------- #
+def _moe_summary(t=0.010, step=10, load=1.0, min_frac=0.9, entropy=0.8,
+                 drop=0.01, imb=1.1, cold=2):
+    return _summary(t, step=step, moe_drop_frac=drop, moe_entropy=entropy,
+                    moe_imbalance=imb, moe_min_count_frac=min_frac,
+                    moe_coldest_expert=cold, moe_local_load=load)
+
+
+def test_dead_expert_rule_needs_consecutive_windows():
+    health = FleetHealth(dead_expert_threshold=0.02,
+                         dead_expert_windows=3)
+    hosts = ["a", "b"]
+    sick = _matrix([_moe_summary(min_frac=0.001)] * 2)
+    healthy = _matrix([_moe_summary(min_frac=0.5)] * 2)
+    assert health.observe(sick, hosts) == []
+    assert health.observe(sick, hosts) == []
+    # a healthy window resets the streak
+    assert health.observe(healthy, hosts) == []
+    assert health.observe(sick, hosts) == []
+    assert health.observe(sick, hosts) == []
+    evs = health.observe(sick, hosts)
+    assert [e[R.H_EVENT] for e in evs] == [EVENT_DEAD_EXPERT]
+    ev = evs[0]
+    # model-level pathology: no process identity, nobody self-arms
+    assert ev[R.F_PROCESS_INDEX] is None and ev[R.F_HOST] == "fleet"
+    assert ev["expert"] == 2                 # the rigged coldest expert
+    assert "dead expert" in ev[R.H_DETAIL] or "fair token share" in \
+        ev[R.H_DETAIL]
+    assert health.counters()["moe_events_flagged"] == 1
+
+
+def test_router_collapse_rule_fires_at_entropy_floor():
+    health = FleetHealth(entropy_floor=0.05, collapse_windows=2)
+    hosts = ["a", "b"]
+    collapsed = _matrix([_moe_summary(entropy=0.01)] * 2)
+    assert health.observe(collapsed, hosts) == []
+    evs = health.observe(collapsed, hosts)
+    assert [e[R.H_EVENT] for e in evs] == [EVENT_ROUTER_COLLAPSE]
+    assert "entropy" in evs[0][R.H_DETAIL]
+    assert evs[0][R.F_PROCESS_INDEX] is None
+    # dense fleets (NaN slots) never trip any moe rule
+    dense = FleetHealth(entropy_floor=0.5, collapse_windows=1)
+    for _ in range(3):
+        assert dense.observe(_matrix([_summary(0.01)] * 2),
+                             hosts) == []
+
+
+def test_ep_imbalance_rule_leave_one_out_and_lane():
+    health = FleetHealth(ep_imbalance_ratio=1.5, ep_imbalance_windows=2)
+    hosts = [f"w{i}" for i in range(4)]
+    rows = [_moe_summary(load=2.4 if p == 2 else 0.8)
+            for p in range(4)]
+    mat = _matrix(rows)
+    assert health.observe(mat, hosts) == []  # window 1 of 2
+    evs = health.observe(mat, hosts)
+    assert [e[R.H_EVENT] for e in evs] == [EVENT_EP_IMBALANCE]
+    ev = evs[0]
+    assert ev[R.F_HOST] == "w2" and ev[R.F_PROCESS_INDEX] == 2
+    assert ev[R.H_LANE] == ATTR_EXPERT_HOTSPOT
+    assert ev[R.H_RATIO] == pytest.approx(3.0)  # 2.4 / peer-median 0.8
+    assert "expert hot-spot on host w2" in ev[R.H_DETAIL]
+    # balanced window resets the streak
+    balanced = _matrix([_moe_summary(load=1.0)] * 4)
+    assert health.observe(balanced, hosts) == []
+    assert health.observe(mat, hosts) == []
+
+
+def test_straggler_lane_names_expert_hotspot():
+    """A straggler whose excess is explained by neither host-gap nor
+    swap, but whose local experts carry past the EP gate, attributes as
+    expert-hotspot instead of generic compute — the ISSUE 15 verdict
+    upgrade."""
+    health = FleetHealth(straggler_zscore=2.0, straggler_min_ratio=1.3,
+                         warmup_windows=1, ep_imbalance_ratio=1.5)
+    hosts = [f"w{i}" for i in range(4)]
+    for _ in range(3):
+        health.observe(_matrix([_moe_summary(0.010)] * 4), hosts)
+    rows = [_moe_summary(0.010, load=0.8) for _ in range(4)]
+    rows[2] = _moe_summary(0.030, load=2.4)   # slow AND expert-hot
+    evs = health.observe(_matrix(rows), hosts)
+    stragglers = [e for e in evs if e[R.H_EVENT] == EVENT_STRAGGLER]
+    assert len(stragglers) == 1
+    assert stragglers[0][R.H_LANE] == ATTR_EXPERT_HOTSPOT
+    # straggler_verdict (the bench-row form) agrees
+    verdict = straggler_verdict(_matrix(rows), hosts, min_ratio=1.3)
+    assert verdict["straggler"] and verdict["host"] == "w2"
+    assert verdict["lane"] == ATTR_EXPERT_HOTSPOT
+    # and it honors a CONFIGURED ep gate exactly like the live
+    # detector: a stricter ratio demotes the same matrix to compute
+    strict = straggler_verdict(_matrix(rows), hosts, min_ratio=1.3,
+                               ep_imbalance_ratio=4.0)
+    assert strict["lane"] == ATTR_COMPUTE
+
+
+def test_e2e_ep_imbalance_sentinel_and_capture(tmp_path):
+    """ISSUE-15 acceptance: rigged EP-imbalance fleet matrix -> health
+    event on the hot host -> sentinel health ring fed (abort budget
+    untouched) -> capture armed on the flagged host, K-step disarm."""
+    hosts = [f"w{i}" for i in range(4)]
+    mats = []
+    for w in range(4):
+        rows = [_moe_summary(step=2 * (w + 1),
+                             load=(2.4 if p == 2 and w >= 1 else 0.8))
+                for p in range(4)]
+        mats.append(_matrix(rows))
+    rig = RiggedGather(hosts, mats)
+    prof = MockProfiler()
+    sentinel = TrainingSentinel()
+    mon = TrainingMonitor(
+        _fleet_cfg(tmp_path, capture={"enabled": True, "steps": 2,
+                                      "max_captures": 1},
+                   moe={"enabled": True, "ep_imbalance_ratio": 1.5,
+                        "ep_imbalance_windows": 2}),
+        process_index=2, world_size=4, host="w2",
+        gather_fn=rig, profiler=prof,
+        health_sink=sentinel.record_health_event)
+    step = 0
+    for _ in range(2):                       # windows 1-2: arming run-up
+        for _ in range(2):
+            step += 1
+            mon.mark_step_start()
+            mon.end_step(step, loss=2.0)
+    assert not prof.active                   # streak 1 of 2: no event
+    for _ in range(2):                       # window 3: streak reaches 2
+        step += 1
+        mon.mark_step_start()
+        mon.end_step(step, loss=2.0)
+    evs = mon.last_health_events
+    assert [e[R.H_EVENT] for e in evs] == [EVENT_EP_IMBALANCE]
+    assert evs[0][R.F_HOST] == "w2" and evs[0][R.F_PROCESS_INDEX] == 2
+    # sentinel ring got the structured event; the ABORT budget did not
+    assert sentinel.health_events_seen == 1
+    assert sentinel.health_events[0][R.H_EVENT] == EVENT_EP_IMBALANCE
+    assert sentinel.consecutive_anomalies == 0
+    assert not sentinel.over_budget
+    # flagged host (us) armed its own capture; K=2 steps then disarm
+    assert prof.active and mon.capture.armed
+    mon.mark_step_start()
+    mon.end_step(step + 1, loss=2.0)
+    mon.mark_step_start()
+    mon.end_step(step + 2, loss=2.0)
+    assert not mon.capture.armed
+    assert prof.stopped == 1
+    assert "ep_imbalance" in prof.started[0]
+    mon.close()
+
+
+def test_e2e_dead_expert_rank0_record_no_capture(tmp_path):
+    """Dead-expert events carry no process identity: rank 0 writes the
+    record + feeds its sentinel, and NO host self-arms a capture."""
+    hosts = ["w0", "w1"]
+    mats = [_matrix([_moe_summary(step=2 * (w + 1),
+                                  min_frac=0.001)] * 2)
+            for w in range(4)]
+    rig = RiggedGather(hosts, mats)
+    prof = MockProfiler()
+    sentinel = TrainingSentinel()
+    mon = TrainingMonitor(
+        _fleet_cfg(tmp_path, capture={"enabled": True},
+                   moe={"enabled": True, "dead_expert_windows": 2,
+                        "dead_expert_threshold": 0.02}),
+        process_index=0, world_size=2, host="w0",
+        gather_fn=rig, profiler=prof,
+        health_sink=sentinel.record_health_event)
+    for step in range(1, 9):
+        mon.mark_step_start()
+        mon.end_step(step, loss=2.0)
+    mon.close()
+    assert not prof.started                  # nobody self-armed
+    recs = [json.loads(line) for line in open(mon.jsonl_path)]
+    dead = [r for r in recs if r.get(R.F_KIND) == KIND_HEALTH
+            and r.get(R.H_EVENT) == EVENT_DEAD_EXPERT]
+    assert len(dead) >= 1
+    assert dead[0][R.F_HOST] == "fleet"
+    assert sentinel.health_events_seen == len(dead)
+    # the rigged fleet records also carry the per-host moe load column
+    fleet = [r for r in recs if r.get(R.F_KIND) == KIND_FLEET]
+    assert fleet and fleet[0][R.FL_PER_HOST]["moe_local_load"] == [
+        1.0, 1.0]
+
+
+def test_e2e_router_collapse_sentinel_ring_budget_untouched(tmp_path):
+    """Router-collapse through the full path: rigged entropy floor ->
+    health event -> sentinel ring fed, abort budget untouched, no
+    capture (fleet-global event carries no process identity)."""
+    hosts = ["w0", "w1"]
+    mats = [_matrix([_moe_summary(step=2 * (w + 1),
+                                  entropy=0.01)] * 2)
+            for w in range(3)]
+    rig = RiggedGather(hosts, mats)
+    prof = MockProfiler()
+    sentinel = TrainingSentinel(anomaly_budget=1)
+    mon = TrainingMonitor(
+        _fleet_cfg(tmp_path, capture={"enabled": True},
+                   moe={"enabled": True, "entropy_floor": 0.05,
+                        "collapse_windows": 2}),
+        process_index=0, world_size=2, host="w0",
+        gather_fn=rig, profiler=prof,
+        health_sink=sentinel.record_health_event)
+    for step in range(1, 7):
+        mon.mark_step_start()
+        mon.end_step(step, loss=2.0)
+    mon.close()
+    assert not prof.started
+    recs = [json.loads(line) for line in open(mon.jsonl_path)]
+    collapse = [r for r in recs if r.get(R.F_KIND) == KIND_HEALTH
+                and r.get(R.H_EVENT) == EVENT_ROUTER_COLLAPSE]
+    assert len(collapse) >= 1 and "entropy" in collapse[0][R.H_DETAIL]
+    assert sentinel.health_events_seen == len(collapse)
+    # a tight abort budget survives: health events never count toward it
+    assert sentinel.consecutive_anomalies == 0
+    assert not sentinel.over_budget

@@ -17,7 +17,7 @@ E = 4
 class TestTop1Gating:
     def test_shapes(self):
         logits = jax.random.normal(jax.random.PRNGKey(0), (16, E))
-        l_aux, combine, dispatch, counts = top1gating(
+        l_aux, combine, dispatch, counts, stats = top1gating(
             logits, capacity_factor=2.0, min_capacity=1)
         cap = max(1, int(np.ceil(16 / E * 2.0)))
         assert combine.shape == (16, E, cap)
@@ -27,8 +27,8 @@ class TestTop1Gating:
 
     def test_all_tokens_dispatched_when_capacity_ample(self):
         logits = jax.random.normal(jax.random.PRNGKey(1), (16, E))
-        _, combine, dispatch, _ = top1gating(logits, capacity_factor=float(E),
-                                             min_capacity=16)
+        _, combine, dispatch, _, _ = top1gating(
+            logits, capacity_factor=float(E), min_capacity=16)
         # each token occupies exactly one (expert, slot)
         per_token = dispatch.sum(axis=(1, 2))
         np.testing.assert_array_equal(np.asarray(per_token), np.ones(16))
@@ -37,16 +37,16 @@ class TestTop1Gating:
         # all tokens prefer expert 0; capacity 2 keeps only 2
         logits = jnp.stack([jnp.full((16,), 5.0)] + [jnp.zeros(16)] * (E - 1),
                            axis=1)
-        _, _, dispatch, _ = top1gating(logits, capacity_factor=0.5,
-                                       min_capacity=2)
+        _, _, dispatch, _, _ = top1gating(logits, capacity_factor=0.5,
+                                          min_capacity=2)
         kept = float(dispatch.sum())
         assert kept == 2.0
 
     def test_l_aux_uniform_is_one(self):
         # perfectly uniform router → l_aux == 1 (E * E * (1/E²))
         logits = jnp.zeros((E * 8, E))
-        l_aux, _, _, _ = top1gating(logits, capacity_factor=2.0,
-                                    min_capacity=64)
+        l_aux, _, _, _, _ = top1gating(logits, capacity_factor=2.0,
+                                       min_capacity=64)
         # argmax breaks ties to expert 0 → ce is one-hot; me uniform
         # so l_aux = E * sum(me*ce) = E * 1/E = 1
         assert float(l_aux) == pytest.approx(1.0, rel=1e-5)
@@ -54,8 +54,8 @@ class TestTop1Gating:
     def test_combine_weights_are_gate_probs(self):
         logits = jax.random.normal(jax.random.PRNGKey(2), (8, E))
         gates = jax.nn.softmax(logits, axis=-1)
-        _, combine, dispatch, _ = top1gating(logits, capacity_factor=float(E),
-                                             min_capacity=8)
+        _, combine, dispatch, _, _ = top1gating(
+            logits, capacity_factor=float(E), min_capacity=8)
         sel = np.asarray(jnp.argmax(logits, axis=-1))
         w = np.asarray(combine.sum(axis=2))  # [S, E]
         for s in range(8):
@@ -66,7 +66,7 @@ class TestTop1Gating:
 class TestTop2Gating:
     def test_shapes_and_two_experts(self):
         logits = jax.random.normal(jax.random.PRNGKey(3), (16, E))
-        l_aux, combine, dispatch, counts = top2gating(
+        l_aux, combine, dispatch, counts, stats = top2gating(
             logits, capacity_factor=float(E), min_capacity=32)
         per_token_experts = (dispatch.sum(axis=2) > 0).sum(axis=1)
         np.testing.assert_array_equal(np.asarray(per_token_experts),
@@ -77,8 +77,8 @@ class TestTop2Gating:
 
     def test_second_differs_from_first(self):
         logits = jax.random.normal(jax.random.PRNGKey(4), (16, E))
-        _, _, dispatch, _ = top2gating(logits, capacity_factor=float(E),
-                                       min_capacity=32)
+        _, _, dispatch, _, _ = top2gating(logits, capacity_factor=float(E),
+                                          min_capacity=32)
         experts_hit = np.asarray(dispatch.sum(axis=2))  # [S, E] 0/1
         assert (experts_hit.max(axis=1) <= 1).all()
 
@@ -357,4 +357,149 @@ class TestManualTP:
         for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_tp)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=2e-5)
+        deepspeed_tpu.reset_mesh_context()
+
+
+class TestRoutingStats:
+    """ISSUE-15 satellite: gating drop accounting — exp_counts and
+    RoutingStats reflect POST-capacity-mask reality (a token dropped by
+    `locations < capacity` never counts as routed; its demand survives
+    in overflow_counts)."""
+
+    def _hot_logits(self, s=16, hot=0):
+        # every token prefers expert `hot` decisively
+        cols = [jnp.full((s,), 5.0) if e == hot else jnp.zeros(s)
+                for e in range(E)]
+        return jnp.stack(cols, axis=1)
+
+    def test_top1_post_capacity_counts_and_overflow(self):
+        logits = self._hot_logits()
+        _, _, dispatch, counts, st = top1gating(
+            logits, capacity_factor=0.5, min_capacity=2)  # capacity 2
+        # routed == what the dispatch mask actually dispatched
+        np.testing.assert_array_equal(np.asarray(counts),
+                                      np.asarray(dispatch.sum(axis=(0, 2))))
+        assert float(counts[0]) == 2.0          # post-capacity, not 16
+        assert float(st.expert_counts[0]) == 2.0
+        assert float(st.overflow_counts[0]) == 14.0
+        assert float(st.tokens) == 16.0
+        assert float(st.dropped) == 14.0
+        assert float(st.layers) == 1.0
+
+    def test_top1_ample_capacity_zero_drops(self):
+        logits = jax.random.normal(jax.random.PRNGKey(11), (16, E))
+        _, _, dispatch, counts, st = top1gating(
+            logits, capacity_factor=float(E), min_capacity=16)
+        assert float(st.dropped) == 0.0
+        assert float(st.tokens) == 16.0
+        np.testing.assert_array_equal(np.asarray(st.overflow_counts),
+                                      np.zeros(E))
+        np.testing.assert_array_equal(np.asarray(st.expert_counts),
+                                      np.asarray(dispatch.sum(axis=(0, 2))))
+
+    def test_top1_used_token_masks_everything(self):
+        logits = self._hot_logits()
+        used = jnp.asarray([1.0] * 8 + [0.0] * 8)
+        _, _, dispatch, counts, st = top1gating(
+            logits, capacity_factor=float(E), min_capacity=16,
+            used_token=used)
+        # padding tokens neither want nor route nor contribute entropy
+        assert float(st.tokens) == 8.0
+        assert float(st.dropped) == 0.0
+        assert float(st.gate_tokens) == 8.0
+        assert float(counts.sum()) == 8.0
+        # and with a tight capacity the drop accounting still holds
+        _, _, _, counts2, st2 = top1gating(
+            logits, capacity_factor=0.5, min_capacity=2, used_token=used)
+        assert float(counts2[0]) == 2.0
+        assert float(st2.dropped) == 6.0
+        assert float(st2.overflow_counts[0]) == 6.0
+
+    def test_top2_doubled_capacity_in_overflow(self):
+        s = 16
+        logits = self._hot_logits(s)
+        (_, cap, _, _, _, counts, st) = (
+            __import__("deepspeed_tpu.moe.sharded_moe",
+                       fromlist=["top2gating_compact"]).top2gating_compact(
+                logits, capacity_factor=1.0, min_capacity=1))
+        # top-2 doubles the slot budget: ceil(16/4 * 2 * 1.0) = 8
+        assert cap == 8
+        # expert 0 wanted by all 16 first choices, keeps the DOUBLED
+        # capacity's 8; the second choice (argmax ties -> expert 1)
+        # absorbs 16 wants against the same budget
+        assert float(st.expert_counts[0]) == 8.0
+        assert float(st.overflow_counts[0]) == 8.0
+        assert float(st.tokens) == 2.0 * s      # k=2 slots per token
+        np.testing.assert_array_equal(np.asarray(counts),
+                                      np.asarray(st.expert_counts))
+
+    def test_top2_post_capacity_matches_dispatch(self):
+        logits = jax.random.normal(jax.random.PRNGKey(12), (32, E))
+        _, _, dispatch, counts, st = top2gating(
+            logits, capacity_factor=0.25, min_capacity=1)
+        np.testing.assert_array_equal(np.asarray(counts),
+                                      np.asarray(dispatch.sum(axis=(0, 2))))
+        np.testing.assert_array_equal(np.asarray(st.expert_counts),
+                                      np.asarray(counts))
+        assert float(st.tokens) == float(
+            st.expert_counts.sum() + st.overflow_counts.sum())
+
+    def test_entropy_normalization_bounds(self):
+        # uniform router -> per-token entropy == ln(E); peaked -> ~0
+        from deepspeed_tpu.moe.sharded_moe import top1gating_compact
+        s = 32
+        uniform = top1gating_compact(jnp.zeros((s, E)),
+                                     capacity_factor=float(E),
+                                     min_capacity=s)[-1]
+        assert float(uniform.entropy) == pytest.approx(s * np.log(E),
+                                                       rel=1e-5)
+        peaked = top1gating_compact(self._hot_logits(s) * 10.0,
+                                    capacity_factor=float(E),
+                                    min_capacity=s)[-1]
+        assert float(peaked.entropy) < 0.05 * s * np.log(E)
+        # confidence: uniform top-1 mass is 1/E per token, peaked ~ 1
+        assert float(uniform.confidence) == pytest.approx(s / E, rel=1e-5)
+        assert float(peaked.confidence) > 0.95 * s
+
+    def test_tap_collects_and_sums_across_layers(self):
+        from deepspeed_tpu.moe import (collect_routing_stats,
+                                       sum_routing_stats)
+        gate = TopKGate(D, E, k=1, capacity_factor=float(E),
+                        min_capacity=64)
+        layer = MOELayer(gate, ExpertMLP(D), E)
+        x = jax.random.normal(jax.random.PRNGKey(13), (16, D))
+        params = layer.init_params(jax.random.PRNGKey(14), x)
+        with collect_routing_stats() as tap:
+            layer.apply(params, x, train=False)
+            layer.apply(params, x, train=False)
+        assert len(tap) == 2
+        total = sum_routing_stats(tap)
+        assert float(total.layers) == 2.0
+        assert float(total.tokens) == 32.0
+        # outside the context, emissions go nowhere
+        layer.apply(params, x, train=False)
+        assert len(tap) == 2
+        assert sum_routing_stats([]) is None
+
+
+class TestMeshValidationMessage:
+    def test_error_names_axis_sizes_and_nearest_valid_counts(self):
+        """ISSUE-15 satellite: the num_experts-vs-expert-axis failure
+        names both values and the nearest valid expert counts."""
+        deepspeed_tpu.initialize_mesh(expert=4, data=-1)
+        with pytest.raises(ValueError) as ei:
+            MoE(hidden_size=D, num_experts=6)
+        msg = str(ei.value)
+        assert "num_experts=6" in msg
+        assert "expert=4" in msg
+        assert "4 or 8" in msg           # nearest multiples of ep_size
+        assert "divisor of 6" in msg
+        deepspeed_tpu.reset_mesh_context()
+
+    def test_error_below_ep_size_suggests_ep_size(self):
+        deepspeed_tpu.initialize_mesh(expert=4, data=-1)
+        with pytest.raises(ValueError) as ei:
+            MoE(hidden_size=D, num_experts=2)
+        # below=0 is not a valid expert count; only ep_size survives
+        assert "Nearest valid num_experts: 4;" in str(ei.value)
         deepspeed_tpu.reset_mesh_context()
