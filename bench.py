@@ -594,6 +594,150 @@ def bench_gpt2_gas4_fused():
     return _bench_gpt2_gas(fused=True)
 
 
+def bench_gpt2_onebit(batch=8, freeze=2, seq=1024):
+    """1-bit optimizer A/B (ISSUE 16): OneBitAdam with the compressed
+    wire tier (zero_optimization.low_bandwidth.onebit, docs/onebit.md)
+    against a dense-Adam twin on the identical model/data/ZeRO stage.
+    The timed window measures the STEADY-STATE compressed phase — the
+    warmup steps and the one planned phase-switch retrace run untimed —
+    and the row embeds both phases' wire accounting from per-phase
+    audits, so the measured delta is attributable to the wire the tier
+    removed.  Hard gates: the phase switch must cost EXACTLY one
+    planned retrace (RecompileGuard counters), and the 1-bit run's
+    final loss must land inside a 10% band around the dense twin's
+    (post-freeze sign+scale momentum is an approximation — the band is
+    the pinned contract, bitwise identity is only promised for warmup).
+    Requires a >1-device data world: on a single chip the tier is inert
+    and the row would silently measure dense Adam."""
+    import jax
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT2Config, GPT2Model
+
+    mesh = ds.initialize_mesh(data=-1)
+    dp = mesh.data_parallel_world_size
+    if dp < 2:
+        raise RuntimeError(
+            f"gpt2_onebit needs a >1-device data world (the 1-bit tier "
+            f"is inert on {dp} device) — run on a multichip host")
+    cfg = GPT2Config(n_positions=seq, bf16=True)
+    model = GPT2Model(cfg)
+    micro = max(1, batch // dp)
+    global_batch = micro * dp
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size,
+                      size=(global_batch, seq)).astype(np.int32)
+
+    def batch_iter():
+        while True:
+            yield (ids,)
+
+    def run(onebit):
+        params = model.init_params(jax.random.PRNGKey(0))
+        config = {
+            "train_micro_batch_size_per_gpu": micro,
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 2},
+            # warn mode arms the RecompileGuard (the retrace-count gate)
+            # without failing the build on advisory findings
+            "analysis": {"mode": "warn"},
+            "steps_per_print": 10 ** 9,
+        }
+        if onebit:
+            config["optimizer"] = {
+                "type": "OneBitAdam",
+                "params": {"lr": 6e-4, "freeze_step": freeze}}
+            config["zero_optimization"]["low_bandwidth"] = {
+                "onebit": True}
+        else:
+            config["optimizer"] = {"type": "Adam", "params": {"lr": 6e-4}}
+        engine, _, _, _ = ds.initialize(model=model, config=config,
+                                        model_parameters=params)
+        it = batch_iter()
+        # untimed: the warmup steps, the freeze-boundary switch, and one
+        # compressed step to absorb the phase-B compile — the timed
+        # window then measures the steady-state program only (the dense
+        # twin runs the same untimed prefix so the A/B stays aligned)
+        for _ in range(freeze + 1):
+            engine.train_batch(it)
+
+        def step():
+            return engine.train_batch(it)
+
+        import jax.numpy as jnp
+
+        def param_sync():
+            leaf = jax.tree.leaves(engine.params)[0]
+            float(jnp.asarray(leaf).ravel()[0])
+
+        dt, final_loss, n = _time_steps(step, warmup=1, iters=8,
+                                        final_sync=param_sync)
+        return engine, dt, final_loss, n
+
+    e_1bit, dt_1bit, loss_1bit, n_1bit = run(onebit=True)
+    if e_1bit._onebit_phase != "compressed":
+        raise RuntimeError(
+            "gpt2_onebit: engine never entered the compressed phase "
+            f"(phase={e_1bit._onebit_phase!r}, freeze_step={freeze})")
+    counters = (e_1bit._recompile_guard.counters()
+                if e_1bit._recompile_guard is not None else {})
+    planned = int(counters.get("planned_retraces", -1))
+    if planned != 1:
+        raise RuntimeError(
+            f"gpt2_onebit: the warmup->compressed switch must cost "
+            f"exactly ONE planned retrace, guard saw {counters}")
+
+    # per-phase wire accounting (docs/onebit.md): the jaxpr numbers for
+    # both phase programs plus the HLO cross-check when it lowers —
+    # best-effort like every audit field, the row never fails on it
+    phases = {}
+    try:
+        from deepspeed_tpu.analysis import audit_engine
+        for phase in ("warmup", "compressed"):
+            rep = audit_engine(e_1bit, multihost=False, phase=phase,
+                               hlo=True)
+            phases[f"wire_bytes_{phase}"] = rep.wire_bytes_per_step
+            if rep.hlo:
+                phases[f"hlo_wire_bytes_{phase}"] = (
+                    rep.hlo["hlo_wire_bytes_per_step"])
+            phases[f"lockstep_signature_{phase}"] = (
+                rep.signature or "")[:16]
+    except Exception as e:  # noqa: BLE001 — provenance is best-effort
+        phases["phase_audit_error"] = f"{e}"[:120]
+
+    e_dense, dt_dense, loss_dense, n_dense = run(onebit=False)
+    band = 0.10
+    if abs(loss_1bit - loss_dense) > band * max(1.0, abs(loss_dense)):
+        raise RuntimeError(
+            f"gpt2_onebit loss left the parity band: 1bit="
+            f"{loss_1bit:.6f} vs dense={loss_dense:.6f} (band {band:.0%})"
+            " — the compressed momentum changed the trajectory, not "
+            "just the wire")
+
+    tokens_per_sec = n_1bit * global_batch * seq / dt_1bit
+    tokens_dense = n_dense * global_batch * seq / dt_dense
+    tflops = tokens_per_sec * cfg.flops_per_token() / 1e12
+    peak = _peak_tflops()
+    return {
+        "metric": "gpt2_124m_onebit_train_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tflops / REFERENCE_TFLOPS, 3),
+        "tflops_per_chip": round(tflops / dp, 2),
+        "mfu": round(tflops / (peak * dp), 4),
+        "data_world": dp,
+        "freeze_step": freeze,
+        "planned_retraces": planned,
+        "final_loss": round(loss_1bit, 4),
+        "dense_tokens_per_sec": round(tokens_dense, 1),
+        "dense_final_loss": round(loss_dense, 4),
+        "onebit_speedup": round(tokens_per_sec / tokens_dense, 4),
+        "loss_parity_band": band,
+        **phases,
+        **_program_audit_fields(e_1bit,
+                                measured_step_s=dt_1bit / n_1bit),
+    }
+
+
 def _zero3_stream_setup(row_name, batch, seq=1024):
     """Shared scaffolding of the zero3_stream rows (the carried pair
     and the fcm A/B): mesh + >1-device guard + model + data.  Requires
@@ -1635,6 +1779,7 @@ BENCHES = {"gpt2": bench_gpt2, "smoke": bench_smoke,
            "autotune": bench_autotune,
            "gpt2_gas4": bench_gpt2_gas4,
            "gpt2_gas4_fused": bench_gpt2_gas4_fused,
+           "gpt2_onebit": bench_gpt2_onebit,
            "gpt2_zero3_stream": bench_gpt2_zero3_stream,
            "gpt2_zero3_stream_carried": bench_gpt2_zero3_stream_carried,
            "gpt2_zero3_stream_fcm": bench_gpt2_zero3_stream_fcm,
@@ -1654,6 +1799,7 @@ METRIC_NAMES = {  # error-path metric must match the success-path name
                   "tokens/s"),
     "gpt2_gas4_fused": ("gpt2_124m_gas4_fused_train_tokens_per_sec_1chip",
                         "tokens/s"),
+    "gpt2_onebit": ("gpt2_124m_onebit_train_tokens_per_sec", "tokens/s"),
     "gpt2_zero3_stream": ("gpt2_124m_zero3_stream_serialized_train_tokens"
                           "_per_sec", "tokens/s"),
     "gpt2_zero3_stream_carried": ("gpt2_124m_zero3_stream_carried_train_"

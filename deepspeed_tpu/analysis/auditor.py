@@ -164,8 +164,152 @@ def _engine_spmd_waivers(engine, kind: str) -> Tuple[SpmdWaiver, ...]:
     return tuple(waivers)
 
 
-def engine_targets(engine, sample_batch: Optional[Tuple] = None
-                   ) -> List[AuditTarget]:
+def _onebit_wire_template(engine):
+    """ShapeDtypeStructs of the worker-stacked wire-error state — the
+    compressed-phase programs carry it even when the engine itself is
+    still in warmup (the auditor prices both phases at init)."""
+    import jax
+    W = engine._onebit["world"]
+    return jax.tree.map(
+        lambda p: jax.ShapeDtypeStruct((W,) + tuple(p.shape), np.float32),
+        engine.params)
+
+
+def _onebit_engine_targets(engine, sample_batch) -> List[AuditTarget]:
+    """Compressed-phase (post-freeze) audit targets for the onebit wire
+    tier (docs/onebit.md).  Program identity differs from warmup — the
+    dense DP grad allreduce is gone from the grad program and the
+    momentum sync rides the apply program's packed wire — so the phase
+    is part of what gets traced, priced, and lockstep-pinned."""
+    import jax
+    progs = engine._onebit_get_programs()
+    wire_tmpl = _onebit_wire_template(engine)
+    wire_sharding = progs["wire_sharding"]
+    wire_sharded = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                       sharding=wire_sharding), wire_tmpl)
+    targets: List[AuditTarget] = []
+
+    fused = progs.get("fused")
+    if fused is not None:
+        if sample_batch is None:
+            return targets
+        gas = engine.gradient_accumulation_steps()
+        stacked = tuple(
+            jax.ShapeDtypeStruct((gas,) + tuple(s.shape), s.dtype)
+            for s in sample_batch)
+        closed = jax.make_jaxpr(fused["raw"])(
+            engine.params, engine.opt_state, engine.scaler_state,
+            engine._fused_sent_state, wire_tmpl, engine._rng, stacked, {})
+        donated = fused["donate_argnums"]
+        args = [
+            ArgInfo("params", _tree_bytes(engine.params), 0 in donated,
+                    True),
+            ArgInfo("opt_state", _tree_bytes(engine.opt_state),
+                    1 in donated, True),
+            ArgInfo("scaler_state", _tree_bytes(engine.scaler_state),
+                    2 in donated, True),
+            ArgInfo("sentinel_state", _tree_bytes(engine._fused_sent_state),
+                    3 in donated, True),
+            ArgInfo("wire_error", _tree_bytes(wire_tmpl), 4 in donated,
+                    True),
+            ArgInfo("batch", _tree_bytes(stacked), False, False),
+        ]
+        arg_trees = (engine.params, engine.opt_state, engine.scaler_state,
+                     engine._fused_sent_state, wire_tmpl, engine._rng,
+                     stacked, {})
+        donated_invars, labels = _expand_invars(arg_trees, [
+            (0 in donated, "params"), (1 in donated, "opt_state"),
+            (2 in donated, "scaler_state"), (3 in donated,
+                                            "sentinel_state"),
+            (4 in donated, "wire_error"), (False, "rng"),
+            (False, "batch"), (False, "kwargs")])
+        sharded_stacked = _sharded_batch_structs(engine, stacked,
+                                                 stacked=True)
+        targets.append(AuditTarget(
+            "fused_step", closed, args,
+            donated_invars=donated_invars, invar_labels=labels,
+            scan_info=_engine_scan_info(engine),
+            lower=lambda: fused["fn"].lower(
+                engine.params, engine.opt_state, engine.scaler_state,
+                engine._fused_sent_state, wire_sharded, engine._rng,
+                sharded_stacked, {}).compile().as_text(),
+            spmd_waivers=_engine_spmd_waivers(engine, "fused")))
+        return targets
+
+    if sample_batch is not None:
+        closed = jax.make_jaxpr(
+            lambda p, s, r, *b: progs["loss_and_grads"](p, s, r, *b))(
+            engine.params, engine.scaler_state, engine._rng,
+            *sample_batch)
+        args = [
+            ArgInfo("params", _tree_bytes(engine.params), False, False),
+            ArgInfo("scaler_state", _tree_bytes(engine.scaler_state),
+                    False, False),
+            ArgInfo("batch", _tree_bytes(sample_batch), False, False),
+        ]
+        donated_invars, labels = _expand_invars(
+            (engine.params, engine.scaler_state, engine._rng,
+             list(sample_batch)),
+            [(False, "params"), (False, "scaler_state"),
+             (False, "rng"), (False, "batch")])
+        sharded_batch = _sharded_batch_structs(engine, sample_batch,
+                                               stacked=False)
+        targets.append(AuditTarget(
+            "grad_step", closed, args,
+            donated_invars=donated_invars, invar_labels=labels,
+            resident_extra_bytes=(_tree_bytes(engine.opt_state) +
+                                  _tree_bytes(wire_tmpl)),
+            scan_info=_engine_scan_info(engine),
+            lower=lambda: progs["grad_fn"].lower(
+                engine.params, engine.scaler_state, engine._rng,
+                *sharded_batch).compile().as_text(),
+            spmd_waivers=_engine_spmd_waivers(engine, "grad")))
+
+    grads = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(
+            (engine._onebit["world"],) + tuple(s.shape), s.dtype),
+        _grads_template(engine))
+    healthy = jax.ShapeDtypeStruct((), np.bool_)
+    closed = jax.make_jaxpr(
+        lambda p, o, s, g, e, h: progs["apply_core"](p, o, s, g, e, h))(
+        engine.params, engine.opt_state, engine.scaler_state, grads,
+        wire_tmpl, healthy)
+    donated = progs["apply_donate_argnums"]
+    args = [
+        ArgInfo("params", _tree_bytes(engine.params), 0 in donated, True),
+        ArgInfo("opt_state", _tree_bytes(engine.opt_state), 1 in donated,
+                True),
+        ArgInfo("scaler_state", _tree_bytes(engine.scaler_state),
+                2 in donated, True),
+        ArgInfo("grads", _tree_bytes(grads), 3 in donated, True),
+        ArgInfo("wire_error", _tree_bytes(wire_tmpl), 4 in donated, True),
+    ]
+    donated_invars, labels = _expand_invars(
+        (engine.params, engine.opt_state, engine.scaler_state, grads,
+         wire_tmpl, healthy),
+        [(0 in donated, "params"), (1 in donated, "opt_state"),
+         (2 in donated, "scaler_state"), (3 in donated, "grads"),
+         (4 in donated, "wire_error"), (False, "healthy")])
+    grads_sharded = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                       sharding=wire_sharding), grads)
+    healthy_arr = jax.ShapeDtypeStruct(
+        (), np.bool_, sharding=engine.mesh_ctx.replicated())
+    targets.append(AuditTarget(
+        "apply_step", closed, args,
+        donated_invars=donated_invars, invar_labels=labels,
+        scan_info=_engine_scan_info(engine),
+        lower=lambda: progs["apply_fn"].lower(
+            engine.params, engine.opt_state, engine.scaler_state,
+            grads_sharded, wire_sharded,
+            healthy_arr).compile().as_text(),
+        spmd_waivers=_engine_spmd_waivers(engine, "apply")))
+    return targets
+
+
+def engine_targets(engine, sample_batch: Optional[Tuple] = None,
+                   phase: Optional[str] = None) -> List[AuditTarget]:
     """Trace the engine's step program(s) abstractly.
 
     Modular path: the grad program (dispatched gas times per step) and
@@ -173,13 +317,36 @@ def engine_targets(engine, sample_batch: Optional[Tuple] = None
     Donation facts come from the argnum tuples the engine recorded next
     to its jit calls (`_apply_donate_argnums` / `_fused_donate_argnums`)
     so the audit reflects what is actually dispatched.
+
+    ``phase`` selects which of an onebit engine's two step programs to
+    trace ("warmup" / "compressed" — docs/onebit.md); None follows the
+    engine's current phase.  Non-onebit engines ignore it.
     """
     import jax
     targets: List[AuditTarget] = []
     if sample_batch is None:
         sample_batch = synthesize_sample_batch(engine)
 
+    onebit = getattr(engine, "_onebit", None)
+    if onebit is not None:
+        if phase is None:
+            phase = getattr(engine, "_onebit_phase", "warmup")
+        if phase == "compressed":
+            return _onebit_engine_targets(engine, sample_batch)
+
     fused_raw = getattr(engine, "_fused_step_raw", None)
+    fused_fn = engine._fused_step_fn
+    fused_donated = getattr(engine, "_fused_donate_argnums", (0, 1))
+    if (onebit is not None
+            and getattr(engine, "_onebit_phase", "warmup") == "compressed"
+            and engine._onebit_programs is not None):
+        # warmup-phase audit of an already-switched engine (checkpoint
+        # signature verify): the installed fused artifacts are phase-B,
+        # but the phase-A ones were stashed at the switch
+        fa = engine._onebit_programs.get("fused_phase_a")
+        if fa is not None:
+            fused_raw, fused_fn = fa["raw"], fa["fn"]
+            fused_donated = fa["donate_argnums"]
     if engine._fused_step_fn is not None and fused_raw is not None:
         if sample_batch is not None:
             gas = engine.gradient_accumulation_steps()
@@ -189,7 +356,7 @@ def engine_targets(engine, sample_batch: Optional[Tuple] = None
             closed = jax.make_jaxpr(fused_raw)(
                 engine.params, engine.opt_state, engine.scaler_state,
                 engine._fused_sent_state, engine._rng, stacked, {})
-            donated = getattr(engine, "_fused_donate_argnums", (0, 1))
+            donated = fused_donated
             args = [
                 ArgInfo("params", _tree_bytes(engine.params),
                         0 in donated, True),
@@ -216,7 +383,7 @@ def engine_targets(engine, sample_batch: Optional[Tuple] = None
                 "fused_step", closed, args,
                 donated_invars=donated_invars, invar_labels=labels,
                 scan_info=_engine_scan_info(engine),
-                lower=lambda: engine._fused_step_fn.lower(
+                lower=lambda: fused_fn.lower(
                     engine.params, engine.opt_state, engine.scaler_state,
                     engine._fused_sent_state, engine._rng,
                     sharded_stacked, {}).compile().as_text(),
@@ -432,15 +599,18 @@ def engine_swap_lane(engine, swap=None):
 
 def audit_engine(engine, sample_batch: Optional[Tuple] = None,
                  cfg=None, multihost: bool = True,
-                 swap=None, hlo: Optional[bool] = None) -> AuditReport:
+                 swap=None, hlo: Optional[bool] = None,
+                 phase: Optional[str] = None) -> AuditReport:
     """Full static audit of a built engine.  Never executes the step.
 
     ``hlo`` forces the HLO-level SPMD cross-check on (True) or off
     (False); None follows ``analysis.hlo_audit``.  The cross-check
     compiles each program through the SPMD partitioner — meaningful
-    extra init cost, so it stays opt-in."""
+    extra init cost, so it stays opt-in.  ``phase`` audits an onebit
+    engine's warmup or compressed step program (docs/onebit.md); None
+    follows the engine's current phase."""
     cfg = cfg if cfg is not None else engine.config.analysis_config
-    targets = engine_targets(engine, sample_batch)
+    targets = engine_targets(engine, sample_batch, phase=phase)
     report = ProgramAuditor(cfg).run(
         targets, gas=engine.gradient_accumulation_steps(),
         swap=engine_swap_lane(engine, swap),

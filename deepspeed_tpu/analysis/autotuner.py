@@ -210,8 +210,15 @@ def audit_candidate(candidate: Candidate, model_kw: Dict[str, int],
             swap = swap_lane(orig_zero, engine.config.aio_config,
                              param_bytes=_tree_bytes(engine.params),
                              opt_state_bytes=_tree_bytes(engine.opt_state))
+        # 1-bit candidates are ranked on their STEADY-STATE program: the
+        # post-freeze compressed phase is what the run spends its life
+        # in (the warmup program is the dense twin, already enumerated)
+        lb = (traced_raw.get(C.ZERO_OPTIMIZATION) or {}).get(
+            C.ZERO_OPTIMIZATION_LOW_BANDWIDTH) or {}
+        phase = ("compressed" if lb.get(C.LOW_BANDWIDTH_ONEBIT)
+                 else None)
         return audit_engine(engine, cfg=analysis_cfg, multihost=False,
-                            swap=swap)
+                            swap=swap, phase=phase)
     finally:
         if engine is not None and getattr(engine, "_preemption",
                                           None) is not None:

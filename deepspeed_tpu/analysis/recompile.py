@@ -52,7 +52,19 @@ class RecompileGuard:
         self._last_sig: Optional[Tuple] = None
         self._restored = 0  # retraces carried in from a checkpoint
         self.retraces_seen = 0  # distinct signatures beyond the first
+        self.planned_retraces = 0  # announced phase switches (onebit)
         self._store_cap = 4 * self.max_retraces + 64
+
+    def note_planned(self) -> None:
+        """Record a PLANNED one-time retrace (the onebit warmup→compressed
+        phase switch, docs/onebit.md): the program identity changes while
+        the batch signature does not, so the guard both counts the retrace
+        (benchmarks read exactly one) and grows the budget by one (a
+        planned switch must never trip the storm detector)."""
+        self.retraces_seen += 1
+        self._restored += 1
+        self.max_retraces += 1
+        self.planned_retraces += 1
 
     def observe(self, tree: Any) -> Optional[Finding]:
         """Record one dispatch; returns a Finding when this dispatch
@@ -86,7 +98,8 @@ class RecompileGuard:
     # ---- checkpoint round-trip (mirrors the sentinel counters) ------- #
     def counters(self) -> dict:
         return {"retraces_seen": self.retraces_seen,
-                "max_retraces": self.max_retraces}
+                "max_retraces": self.max_retraces,
+                "planned_retraces": self.planned_retraces}
 
     def load_counters(self, d: Optional[dict]) -> None:
         """Restore the persisted retrace count.  Signatures themselves
@@ -98,3 +111,10 @@ class RecompileGuard:
         self._restored = max(self._restored,
                              int(d.get("retraces_seen", 0)))
         self.retraces_seen = max(self.retraces_seen, self._restored)
+        # planned retraces carried in from the checkpoint re-credit the
+        # budget exactly once (a resumed run must not trip the storm
+        # detector for a switch the previous run already announced)
+        planned = int(d.get("planned_retraces", 0))
+        new_planned = max(self.planned_retraces, planned)
+        self.max_retraces += new_planned - self.planned_retraces
+        self.planned_retraces = new_planned

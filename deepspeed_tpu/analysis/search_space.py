@@ -103,7 +103,8 @@ def _deep_merge(dst: Dict[str, Any], overlay: Dict[str, Any]) -> None:
 
 def _candidate_name(stage, streamed, pmode, bucket, micro, gas, data,
                     model, expert, qwz, qgz, hpz, fused, offload,
-                    pdepth, odepth, multi_bucket, fcm=False) -> str:
+                    pdepth, odepth, multi_bucket, fcm=False,
+                    onebit=False) -> str:
     bits = [f"z{stage}" + ("s" if streamed else "")]
     if streamed:
         bits.append(pmode)
@@ -119,6 +120,8 @@ def _candidate_name(stage, streamed, pmode, bucket, micro, gas, data,
         bits.append(f"hpz{hpz}")
     if fcm:
         bits.append("fcm")
+    if onebit:
+        bits.append("1bit")
     bits.append("fused" if fused else "mod")
     if offload == C.AUTOTUNING_OFFLOAD_TIER_NVME:
         # the depth axes only modulate the NVMe tier; the cpu tier has
@@ -132,7 +135,7 @@ def _candidate_name(stage, streamed, pmode, bucket, micro, gas, data,
 def _build_config(base: Dict[str, Any], *, stage, streamed, pmode,
                   bucket, micro, gas, data, model, expert, qwz, qgz,
                   hpz, fused, offload, pdepth, odepth,
-                  fixed, fcm=False) -> Dict[str, Any]:
+                  fixed, fcm=False, onebit=False) -> Dict[str, Any]:
     raw = copy.deepcopy(base)
     # candidates are bench-ready engine configs: the search description
     # itself must not ride along
@@ -168,6 +171,16 @@ def _build_config(base: Dict[str, Any], *, stage, streamed, pmode,
         lb[C.LOW_BANDWIDTH_HPZ_GROUP_SIZE] = hpz
     if fcm:
         lb[C.LOW_BANDWIDTH_FCM] = True
+    if onebit:
+        lb[C.LOW_BANDWIDTH_ONEBIT] = True
+        # the wire format IS the onebit optimizer's error-feedback
+        # momentum: swap the base optimizer for its onebit counterpart,
+        # keeping lr/betas/... (docs/onebit.md)
+        opt = copy.deepcopy(base.get(C.OPTIMIZER) or {})
+        name = str(opt.get("type") or "").lower()
+        opt["type"] = "OneBitLamb" if "lamb" in name else "OneBitAdam"
+        opt.setdefault("params", {})
+        raw[C.OPTIMIZER] = opt
     if lb:
         zo[C.ZERO_OPTIMIZATION_LOW_BANDWIDTH] = lb
     if offload == C.AUTOTUNING_OFFLOAD_TIER_CPU:
@@ -207,6 +220,29 @@ def enumerate_candidates(base: Dict[str, Any], tune_cfg,
             f"sizes {list(tune_cfg.mesh_expert)}")
     multi_bucket = len(set(tune_cfg.stage3_bucket_sizes)) > 1
     elastic = base.get(C.ELASTICITY)
+
+    # the 1-bit wire axis (docs/onebit.md) is gated at the BASE config:
+    # gradient clipping / sparse gradients conflict with the tier for
+    # every candidate, so an infeasible base yields ONE pruned record
+    # instead of a trace-prune per enumerated point
+    onebit_axis = tuple(sorted(set(bool(v) for v in tune_cfg.onebit)))
+    if True in onebit_axis:
+        reason = None
+        if float(base.get(C.GRADIENT_CLIPPING) or 0.0) > 0:
+            reason = (f"base config sets {C.GRADIENT_CLIPPING}="
+                      f"{base.get(C.GRADIENT_CLIPPING)}; global-norm "
+                      "clipping needs the dense gradient the 1-bit tier "
+                      "removes")
+        elif base.get(C.SPARSE_GRADIENTS):
+            reason = (f"base config sets {C.SPARSE_GRADIENTS}; both "
+                      "features rewrite the data-parallel grad "
+                      "reduction")
+        if reason is not None:
+            space.n_enumerated += 1
+            space.pruned.append(Pruned(name="1bit", stage="batch",
+                                       reason=reason))
+            onebit_axis = tuple(v for v in onebit_axis if not v) or \
+                (False,)
 
     streamed_possible = 3 in tune_cfg.zero_stages and any(
         v == C.AUTOTUNING_STAGE3_VARIANT_STREAMED
@@ -297,14 +333,29 @@ def enumerate_candidates(base: Dict[str, Any], tune_cfg,
                     fuseds = (tune_cfg.fused
                               if offload == C.AUTOTUNING_OFFLOAD_TIER_NONE
                               else (False,))  # host-interactive fallback
-                    for pdepth, odepth, fused in itertools.product(
-                            pdepths, odepths, sorted(set(fuseds))):
+                    # the 1-bit wire replaces the DATA-parallel grad
+                    # allreduce of a resident stage <= 2 engine: ZeRO-3
+                    # streaming has no whole-grad allreduce, offloaded
+                    # optimizer state cannot host the packed momentum,
+                    # non-data axes shard the grads it syncs, and qgZ
+                    # already rewrites the same reduction
+                    onebits = (onebit_axis
+                               if (stage <= 2 and not streamed
+                                   and offload ==
+                                   C.AUTOTUNING_OFFLOAD_TIER_NONE
+                                   and model == 1 and expert == 1
+                                   and not qgz)
+                               else (False,))
+                    for pdepth, odepth, fused, onebit in \
+                            itertools.product(pdepths, odepths,
+                                              sorted(set(fuseds)),
+                                              onebits):
                         space.n_enumerated += 1
                         name = _candidate_name(
                             stage, streamed, pmode, bucket, micro, gas,
                             data, model, expert, qwz, qgz, hpz, fused,
                             offload, pdepth, odepth, multi_bucket,
-                            fcm=fcm)
+                            fcm=fcm, onebit=onebit)
                         cfg = _build_config(
                             base, stage=stage, streamed=streamed,
                             pmode=pmode, bucket=bucket, micro=micro,
@@ -312,7 +363,7 @@ def enumerate_candidates(base: Dict[str, Any], tune_cfg,
                             expert=expert, qwz=qwz, qgz=qgz, hpz=hpz,
                             fused=fused, offload=offload, pdepth=pdepth,
                             odepth=odepth, fixed=tune_cfg.fixed,
-                            fcm=fcm)
+                            fcm=fcm, onebit=onebit)
                         import json as _json
                         key = _json.dumps(cfg, sort_keys=True)
                         if key in seen:
@@ -331,6 +382,7 @@ def enumerate_candidates(base: Dict[str, Any], tune_cfg,
                                 "qwz_bits": qwz, "qgz_bits": qgz,
                                 "hpz_group_size": hpz,
                                 "fused_collective_matmul": bool(fcm),
+                                "onebit": bool(onebit),
                                 "fused_step": bool(fused),
                                 "offload": offload,
                                 "nvme_prefetch_depth": pdepth,

@@ -192,18 +192,30 @@ class ZeroLowBandwidthConfig:
         grad scatters move tile-by-tile over a ring instead of as one
         monolithic collective, and the Schedule Auditor classifies the
         per-tile wire as fused/hidden.  Off by default.
+    onebit: 1-bit optimizer wire tier (docs/onebit.md): after the onebit
+        optimizer's freeze_step the data-parallel grad allreduce is
+        removed from the grad program and replaced by an error-feedback
+        sign+scale momentum sync on a packed int8 wire
+        (comm/compressed.py wire="packed").  Requires a OneBitAdam /
+        OneBitLamb optimizer and ZeRO stage <= 2; hpz_group_size doubles
+        as the hierarchical group size (intra-group dense, cross-group
+        1-bit).  Off by default.
     """
     qwz_bits: int = C.LOW_BANDWIDTH_QWZ_BITS_DEFAULT
     qgz_bits: int = C.LOW_BANDWIDTH_QGZ_BITS_DEFAULT
     hpz_group_size: int = C.LOW_BANDWIDTH_HPZ_GROUP_SIZE_DEFAULT
     block_size: int = C.LOW_BANDWIDTH_BLOCK_SIZE_DEFAULT
     fused_collective_matmul: bool = C.LOW_BANDWIDTH_FCM_DEFAULT
+    onebit: bool = C.LOW_BANDWIDTH_ONEBIT_DEFAULT
 
     @property
     def enabled(self) -> bool:
         # fused_collective_matmul alone engages the low-bandwidth
         # context: the per-tile ring schedule applies at native width
-        # even with both quantizers off
+        # even with both quantizers off.  `onebit` deliberately does NOT
+        # feed this property — it is a data-parallel wire feature, not a
+        # stage-3 streaming transport, and must not engage the streaming
+        # context (or its stage<3 "will be ignored" warning).
         return bool(self.qwz_bits or self.qgz_bits or
                     self.hpz_group_size > 1 or
                     self.fused_collective_matmul)
@@ -224,6 +236,8 @@ class ZeroLowBandwidthConfig:
                 C.LOW_BANDWIDTH_BLOCK_SIZE_DEFAULT)),
             fused_collective_matmul=get_scalar_param(
                 d, C.LOW_BANDWIDTH_FCM, C.LOW_BANDWIDTH_FCM_DEFAULT),
+            onebit=get_scalar_param(
+                d, C.LOW_BANDWIDTH_ONEBIT, C.LOW_BANDWIDTH_ONEBIT_DEFAULT),
         )
         for name, bits in ((C.LOW_BANDWIDTH_QWZ_BITS, cfg.qwz_bits),
                            (C.LOW_BANDWIDTH_QGZ_BITS, cfg.qgz_bits)):
@@ -239,6 +253,10 @@ class ZeroLowBandwidthConfig:
             raise DeepSpeedConfigError(
                 f"zero_optimization.low_bandwidth.{C.LOW_BANDWIDTH_FCM} "
                 f"must be a bool, got {cfg.fused_collective_matmul!r}")
+        if not isinstance(cfg.onebit, bool):
+            raise DeepSpeedConfigError(
+                f"zero_optimization.low_bandwidth.{C.LOW_BANDWIDTH_ONEBIT} "
+                f"must be a bool, got {cfg.onebit!r}")
         return cfg
 
 
@@ -999,6 +1017,7 @@ class AutotuningConfig:
     hpz_group_sizes: tuple = C.AUTOTUNING_HPZ_GROUP_SIZES_DEFAULT
     fused: tuple = C.AUTOTUNING_FUSED_DEFAULT
     fused_collective_matmul: tuple = C.AUTOTUNING_FCM_DEFAULT
+    onebit: tuple = C.AUTOTUNING_ONEBIT_DEFAULT
     offload: tuple = C.AUTOTUNING_OFFLOAD_TIERS_DEFAULT
     nvme_prefetch_depths: tuple = C.AUTOTUNING_NVME_PREFETCH_DEPTHS_DEFAULT
     opt_pipeline_depths: tuple = C.AUTOTUNING_OPT_PIPELINE_DEPTHS_DEFAULT
@@ -1056,6 +1075,9 @@ class AutotuningConfig:
                                   C.AUTOTUNING_FUSED_DEFAULT), bool),
             fused_collective_matmul=_as_tuple(
                 d.get(C.AUTOTUNING_FCM, C.AUTOTUNING_FCM_DEFAULT), bool),
+            onebit=_as_tuple(
+                d.get(C.AUTOTUNING_ONEBIT, C.AUTOTUNING_ONEBIT_DEFAULT),
+                bool),
             offload=_as_tuple(d.get(C.AUTOTUNING_OFFLOAD_TIERS,
                                     C.AUTOTUNING_OFFLOAD_TIERS_DEFAULT),
                               str),
@@ -1578,6 +1600,68 @@ class DeepSpeedConfig:
         self.pipeline = pd.get(C.PIPELINE, {})
         self.vocabulary_size = get_scalar_param(pd, C.VOCABULARY_SIZE,
                                                 C.VOCABULARY_SIZE_DEFAULT)
+        self._validate_onebit()
+
+    # ------------------------------------------------------------------ #
+    def _validate_onebit(self) -> None:
+        """1-bit optimizer tier cross-field validation (docs/onebit.md).
+
+        Two layers: the onebit optimizers' params block is validated
+        whenever a OneBitAdam/OneBitLamb optimizer is named, and the
+        wire tier (`zero_optimization.low_bandwidth.onebit`) is checked
+        against every feature it cannot compose with — each conflict is
+        a loud DeepSpeedConfigError naming the offending knob, never a
+        silent numerics-only fallback."""
+        # spellings owned by runtime/optimizers.py (lowered there too)
+        onebit_names = ("onebitadam", "onebitlamb")
+        is_onebit_opt = self.optimizer_name in onebit_names
+        if is_onebit_opt:
+            freeze = self.optimizer_params.get("freeze_step", 100)
+            if not isinstance(freeze, int) or freeze < 1:
+                raise DeepSpeedConfigError(
+                    f"optimizer.params.freeze_step must be an int >= 1 "
+                    f"for {self.optimizer_name}, got {freeze!r}")
+            betas = self.optimizer_params.get("betas", (0.9, 0.999))
+            if (len(tuple(betas)) != 2
+                    or not all(0.0 <= float(b) < 1.0 for b in betas)):
+                raise DeepSpeedConfigError(
+                    f"optimizer.params.betas for {self.optimizer_name} "
+                    f"must be two floats in [0, 1), got {betas!r}")
+        lb = self.zero_config.low_bandwidth
+        if not lb.onebit:
+            return
+        prefix = (f"zero_optimization.low_bandwidth."
+                  f"{C.LOW_BANDWIDTH_ONEBIT}=true conflicts with ")
+        if not is_onebit_opt:
+            raise DeepSpeedConfigError(
+                f"zero_optimization.low_bandwidth.{C.LOW_BANDWIDTH_ONEBIT}"
+                f"=true requires a OneBitAdam or OneBitLamb optimizer "
+                f"(the wire format is the optimizer's error-feedback "
+                f"momentum), got optimizer.type="
+                f"{self.optimizer_name!r}")
+        if self.zero_config.stage >= 3:
+            raise DeepSpeedConfigError(
+                prefix + f"zero_optimization.stage="
+                f"{self.zero_config.stage}: the ZeRO-3 streaming path "
+                "gathers params/scatters grads inside the step program "
+                "and has no whole-gradient allreduce to replace — use "
+                "stage <= 2")
+        if self.zero_config.offload_optimizer is not None:
+            raise DeepSpeedConfigError(
+                prefix + "zero_optimization.offload_optimizer: the "
+                "compressed phase keeps momentum (and its error "
+                "feedback) device-resident and replicated; an offloaded "
+                "optimizer state cannot host the packed momentum sync")
+        if self.sparse_gradients_enabled:
+            raise DeepSpeedConfigError(
+                prefix + "sparse_gradients: both features rewrite the "
+                "data-parallel gradient reduction and cannot stack")
+        if self.gradient_clipping and self.gradient_clipping > 0:
+            raise DeepSpeedConfigError(
+                prefix + f"gradient_clipping={self.gradient_clipping}: "
+                "global-norm clipping needs the dense gradient on every "
+                "worker before the optimizer sees it, which is exactly "
+                "the allreduce the 1-bit tier removes")
 
     # ------------------------------------------------------------------ #
     @property

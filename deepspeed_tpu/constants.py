@@ -291,6 +291,8 @@ AUTOTUNING_FUSED = "fused"
 AUTOTUNING_FUSED_DEFAULT = (False,)
 AUTOTUNING_FCM = "fused_collective_matmul"
 AUTOTUNING_FCM_DEFAULT = (False,)
+AUTOTUNING_ONEBIT = "onebit"
+AUTOTUNING_ONEBIT_DEFAULT = (False,)
 AUTOTUNING_OFFLOAD_TIERS = "offload"
 AUTOTUNING_OFFLOAD_TIER_NONE = "none"
 AUTOTUNING_OFFLOAD_TIER_CPU = "cpu"
@@ -542,12 +544,25 @@ LOW_BANDWIDTH_BLOCK_SIZE_DEFAULT = 256
 # tiles complete) instead of as one monolithic collective
 LOW_BANDWIDTH_FCM = "fused_collective_matmul"
 LOW_BANDWIDTH_FCM_DEFAULT = False
+# 1-bit optimizer wire tier (reference runtime/comm/nccl.py
+# compressed_allreduce; docs/onebit.md): after the optimizer's
+# freeze_step the data-parallel grad allreduce is removed from the grad
+# program and replaced by an error-feedback sign+scale momentum sync on
+# a packed int8 wire (comm/compressed.py wire="packed").  Requires a
+# onebit optimizer (OneBitAdam/OneBitLamb) and ZeRO stage <= 2.
+LOW_BANDWIDTH_ONEBIT = "onebit"
+LOW_BANDWIDTH_ONEBIT_DEFAULT = False
 # name-scope marker the fused collective-matmul ops trace under; the
 # Schedule Auditor's overlap classifier (analysis/overlap.py) reads it
 # off eqn name stacks to classify the per-tile transports as
 # fused/hidden — single-sourced here so the op and the analyzer can
 # never disagree on the spelling
 FCM_SCOPE = "fcm_fused"
+# name-scope marker the packed 1-bit momentum-sync transport traces
+# under (comm/compressed.py wire="packed"); collective_wire_bytes and
+# the Schedule Auditor read it off eqn name stacks for attribution —
+# single-sourced here like FCM_SCOPE
+ONEBIT_SCOPE = "onebit_packed"
 
 #############################################
 # Offload (reference: runtime/zero/offload_constants.py)

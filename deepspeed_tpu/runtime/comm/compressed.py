@@ -12,23 +12,96 @@ XLA keeps in bf16/int8-width lanes).  Two error-feedback states (worker +
 server in the reference) collapse into one because psum has no gather/
 scatter asymmetry.
 
+wire="packed" restores the reference's genuinely narrow wire: signs ride
+8-per-byte through a two-stage all_to_all + all_gather (the reference's
+igather/allgather pair), with blockwise mean-|x| scales riding alongside
+— the same blockwise-scale convention as the qwZ/qgZ transports in
+``low_bandwidth.py``.  A hierarchical (Frontier-style, arXiv:2501.04266)
+variant does a dense intra-group psum first and runs the packed exchange
+only across groups.
+
 Honest perf note (measured stance of SURVEY.md §7): on ICI the dense psum
 is rarely the bottleneck, so compression mainly pays on DCN-spanning
 meshes; the API exists for parity and for multi-pod data parallelism.
 """
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ... import constants as C
 from ...parallel.mesh import DATA_AXIS
+from .low_bandwidth import DEFAULT_BLOCK
+
+_WIRES = ("full", "int8", "packed")
+
+
+def _packed_sync(compensated: jnp.ndarray, axis_name: str, block: int,
+                 wg: int, my_rank: jnp.ndarray,
+                 groups: Optional[list]) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Two-stage packed-sign exchange across ``wg`` peers.
+
+    Stage 1 (the reference's igather): every peer packs its signs
+    8-per-byte plus blockwise scales and all_to_alls chunk w to peer w;
+    each peer decodes and averages its server chunk.  Stage 2 (the
+    reference's allgather): the averaged chunk is re-compressed and
+    all_gathered back; the second-stage compression residual is folded
+    into this peer's OWN chunk slice of the error state, exactly like
+    the reference's server-side error.  Padding tail blocks decode to
+    garbage but are sliced off before returning.
+    """
+    n = compensated.size
+    dtype = compensated.dtype
+    flat = compensated.astype(jnp.float32).reshape(-1)
+    chunk = -(-n // (wg * block)) * block  # per-peer chunk, block multiple
+    n_pad = chunk * wg
+    nb = chunk // block
+    flat = jnp.pad(flat, (0, n_pad - n))
+    blocks = flat.reshape(wg, nb, block)
+    s1 = jnp.mean(jnp.abs(blocks), axis=-1)            # [wg, nb]
+    pos1 = blocks >= 0
+    bits1 = jnp.packbits(pos1, axis=-1)                # [wg, nb, block//8]
+    applied1 = jnp.where(pos1, 1.0, -1.0) * s1[..., None]
+    with jax.named_scope(C.ONEBIT_SCOPE):
+        bits_recv = lax.all_to_all(bits1, axis_name, 0, 0,
+                                   axis_index_groups=groups)
+        s1_recv = lax.all_to_all(s1, axis_name, 0, 0,
+                                 axis_index_groups=groups)
+    sgn_recv = (jnp.unpackbits(bits_recv, axis=-1, count=block)
+                .astype(jnp.float32) * 2.0 - 1.0)
+    server = jnp.mean(sgn_recv * s1_recv[..., None], axis=0)  # [nb, block]
+    s2 = jnp.mean(jnp.abs(server), axis=-1)            # [nb]
+    pos2 = server >= 0
+    bits2 = jnp.packbits(pos2, axis=-1)                # [nb, block//8]
+    applied2 = jnp.where(pos2, 1.0, -1.0) * s2[..., None]
+    server_resid = server - applied2
+    with jax.named_scope(C.ONEBIT_SCOPE):
+        bits_all = lax.all_gather(bits2, axis_name, axis=0,
+                                  axis_index_groups=groups)
+        s2_all = lax.all_gather(s2, axis_name, axis=0,
+                                axis_index_groups=groups)
+    decoded = ((jnp.unpackbits(bits_all, axis=-1, count=block)
+                .astype(jnp.float32) * 2.0 - 1.0) * s2_all[..., None])
+    reduced = (decoded.reshape(-1)[:n].reshape(compensated.shape)
+               .astype(dtype))
+    # stage-1 residual everywhere; the server residual lands in this
+    # peer's own chunk slice (every peer holds a disjoint server chunk,
+    # so across the fleet the full residual is accounted exactly once)
+    e1 = blocks - applied1
+    my_e = lax.dynamic_slice_in_dim(e1, my_rank, 1, axis=0)[0] + server_resid
+    e_full = lax.dynamic_update_slice_in_dim(e1, my_e[None], my_rank, axis=0)
+    new_error = (e_full.reshape(-1)[:n].reshape(compensated.shape)
+                 .astype(dtype))
+    return reduced, new_error
 
 
 def compressed_allreduce_inner(x: jnp.ndarray, error: jnp.ndarray,
                                axis_name: str = DATA_AXIS,
-                               wire: str = "full"
+                               wire: str = "full",
+                               block: int = DEFAULT_BLOCK,
+                               group_size: int = 0
                                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """One error-compensated 1-bit allreduce step; call inside shard_map.
 
@@ -41,15 +114,22 @@ def compressed_allreduce_inner(x: jnp.ndarray, error: jnp.ndarray,
     wire-width win (measured in benchmarks/onebit_cost.py; the XLA psum
     cannot weight per-worker operands after an int8 cast).
     wire="int8": the scale is first psum-averaged to a SHARED scalar, the
-    sign tensor then rides the wire as int8 (4x narrower than fp32; the
-    narrowest dtype XLA collectives move — true 1-bit packing would need
-    a bit-packed allgather whose volume scales with world size).  The
+    sign tensor then rides the wire as int8 (4x narrower than fp32).  The
     worker's error feedback absorbs the shared-scale approximation the
     same way the reference's server-side error absorbs its second-stage
     compression (runtime/comm/nccl.py:47).
+    wire="packed": true 1-bit lanes — signs packed 8-per-byte with
+    blockwise fp32 scales (``block`` elements per scale), moved by the
+    reference's two-stage igather/allgather recast as all_to_all +
+    all_gather.  ~n/8 sign bytes each way plus n/block scales: ≈14x
+    narrower than a dense fp32 psum at block=256 under the repo's wire
+    accounting.  ``group_size`` G > 1 selects the Frontier-style
+    hierarchical variant: dense psum-mean inside consecutive groups of
+    G, packed exchange only across the W/G groups (G must divide the
+    axis size; G == axis size degenerates to a plain dense mean).
     """
-    if wire not in ("full", "int8"):
-        raise ValueError(f"wire={wire!r} not in full|int8")
+    if wire not in _WIRES:
+        raise ValueError(f"wire={wire!r} not in {'|'.join(_WIRES)}")
     if wire == "int8":
         # the axis size is static inside shard_map — guard here too, not
         # just in the wrapper (shard_map loops call inner directly)
@@ -59,6 +139,34 @@ def compressed_allreduce_inner(x: jnp.ndarray, error: jnp.ndarray,
                 f"wire='int8' supports at most 127 workers on "
                 f"{axis_name!r} (summed signs ride int8 lanes); axis has "
                 f"{world_static} — use wire='full'")
+    if wire == "packed":
+        if block < 8 or block % 8:
+            raise ValueError(
+                f"wire='packed' needs block % 8 == 0 (signs pack "
+                f"8-per-byte), got block={block}")
+        W = lax.axis_size(axis_name)
+        G = int(group_size) if group_size and group_size > 1 else 1
+        if G > 1:
+            if W % G:
+                raise ValueError(
+                    f"hierarchical group_size={G} must divide the "
+                    f"{axis_name!r} axis size {W}")
+            wg = W // G
+            groups_intra = [[g * G + i for i in range(G)]
+                            for g in range(wg)]
+            dense = lax.psum(x, axis_name,
+                             axis_index_groups=groups_intra) / G
+            if wg == 1:  # G == W: one group, plain dense mean
+                return dense + error, jnp.zeros_like(error)
+            groups_cross = [[r + g * G for g in range(wg)]
+                            for r in range(G)]
+            my_rank = lax.axis_index(axis_name) // G
+            return _packed_sync(dense + error, axis_name, block, wg,
+                                my_rank, groups_cross)
+        if W == 1:
+            return x + error, jnp.zeros_like(error)
+        return _packed_sync(x + error, axis_name, block, W,
+                            lax.axis_index(axis_name), None)
     world = lax.psum(1, axis_name)
     compensated = x + error
     # per-worker scale: mean magnitude preserves E[|x|] under sign compression
@@ -79,13 +187,15 @@ def compressed_allreduce_inner(x: jnp.ndarray, error: jnp.ndarray,
 
 
 def compressed_allreduce(x_stacked, error_stacked, mesh_ctx=None,
-                         axis_name: str = DATA_AXIS, wire: str = "full"):
+                         axis_name: str = DATA_AXIS, wire: str = "full",
+                         block: int = DEFAULT_BLOCK, group_size: int = 0):
     """Worker-stacked wrapper: x_stacked [W, ...] holds worker i's tensor in
     row i (sharded over the data axis).  Returns (reduced [W, ...] — every
     row identical — and the new per-worker error stack).
 
     wire="int8" needs world size <= 127 (the summed sign tensor rides in
-    int8 lanes)."""
+    int8 lanes); wire="packed"/group_size are forwarded to
+    :func:`compressed_allreduce_inner`."""
     from ...parallel.mesh import get_mesh_context
     from jax.sharding import PartitionSpec as P
     ctx = mesh_ctx or get_mesh_context()
@@ -99,7 +209,8 @@ def compressed_allreduce(x_stacked, error_stacked, mesh_ctx=None,
     spec = P(axis_name)
 
     def inner(a, b):
-        r, e = compressed_allreduce_inner(a[0], b[0], axis_name, wire=wire)
+        r, e = compressed_allreduce_inner(a[0], b[0], axis_name, wire=wire,
+                                          block=block, group_size=group_size)
         return r[None], e[None]
 
     fn = jax.shard_map(inner, mesh=ctx.mesh, in_specs=(spec, spec),

@@ -380,15 +380,24 @@ def collective_wire_bytes(jaxpr) -> dict:
     from ... import constants as _C
     from ...analysis.jaxpr_walk import (aval_bytes, iter_eqns,
                                         scope_has_component)
-    out = {"gather_bytes": 0, "reduce_bytes": 0, "fcm_bytes": 0}
+    out = {"gather_bytes": 0, "reduce_bytes": 0, "fcm_bytes": 0,
+           "onebit_bytes": 0}
     for ctx in iter_eqns(jaxpr):
         name = ctx.eqn.primitive.name
+        onebit = scope_has_component(ctx.scope, _C.ONEBIT_SCOPE)
         if name in _GATHER_PRIMS:
-            out["gather_bytes"] += sum(aval_bytes(v)
-                                       for v in ctx.eqn.outvars)
+            b = sum(aval_bytes(v) for v in ctx.eqn.outvars)
+            out["gather_bytes"] += b
+            if onebit:
+                # attribution breakout (docs/onebit.md): the packed-sign
+                # exchange is already counted in the gather/reduce totals;
+                # this keys how much of the wire is the 1-bit momentum sync
+                out["onebit_bytes"] += b
         elif name in _REDUCE_PRIMS:
-            out["reduce_bytes"] += sum(aval_bytes(v)
-                                       for v in ctx.eqn.invars)
+            b = sum(aval_bytes(v) for v in ctx.eqn.invars)
+            out["reduce_bytes"] += b
+            if onebit:
+                out["onebit_bytes"] += b
         elif name == "ppermute" and scope_has_component(ctx.scope,
                                                         _C.FCM_SCOPE):
             out["fcm_bytes"] += sum(aval_bytes(v)

@@ -16,6 +16,7 @@ DCN bandwidth win.  On ICI-bound meshes the dense psum is typically faster
 — benchmark before enabling (SURVEY.md §7 honesty note).
 """
 
+import math
 from typing import NamedTuple
 
 import jax
@@ -23,6 +24,7 @@ import jax.numpy as jnp
 import optax
 
 from ...utils.logging import log_dist
+from .low_bandwidth import DEFAULT_BLOCK
 
 
 class OnebitState(NamedTuple):
@@ -37,6 +39,73 @@ def _sign_compress(m, error):
     scale = jnp.mean(jnp.abs(comp))
     cm = scale * jnp.sign(comp)
     return cm, comp - cm
+
+
+def adam_step_math(m, v, bias1, bias2, eps, weight_decay=0.0, p=None):
+    """The raw (pre-lr) Adam step — single-sourced so the engine's
+    compressed-phase apply region (docs/onebit.md) and the optax path
+    below can never drift numerically."""
+    step = (m / bias1) / (jnp.sqrt(v / bias2) + eps)
+    if weight_decay > 0 and p is not None:
+        step = step + weight_decay * p
+    return step
+
+
+def lamb_trust_math(u, p, lr, min_trust, max_trust):
+    """LAMB trust scaling of an update ``u = -lr*step`` (reference
+    onebit/lamb.py:232-249): the ratio is defined on the RAW step, so lr
+    is divided back out of the update norm — single-sourced with
+    :func:`onebit_lamb` for the engine's compressed-phase apply."""
+    p_norm = jnp.linalg.norm(p.reshape(-1))
+    raw_norm = (jnp.linalg.norm(u.reshape(-1)) /
+                jnp.maximum(lr, 1e-30))
+    ratio = jnp.where(
+        (p_norm > 0) & (raw_norm > 0),
+        jnp.clip(p_norm / raw_norm, min_trust, max_trust), 1.0)
+    return u * ratio
+
+
+def onebit_leaf_saves_bytes(shape, dtype, world: int,
+                            block: int = DEFAULT_BLOCK) -> bool:
+    """Per-leaf wire-cost gate (the quantized_gather_saves_bytes idiom):
+    True when the packed two-stage sign exchange moves fewer bytes than
+    a dense psum of the leaf, under the repo's wire accounting
+    (all_to_all at operand bytes, all_gather at output bytes).  Skinny
+    leaves — biases, layernorm scales — lose to the blockwise-scale
+    overhead plus chunk padding and stay on the dense wire."""
+    n = math.prod(shape) if shape else 1
+    dense = n * jnp.dtype(dtype).itemsize
+    chunk = -(-n // (world * block)) * block
+    n_pad = chunk * world
+    nb = chunk // block
+    # bits each way (8 signs/byte) + fp32 blockwise scales each way
+    packed = n_pad // 4 + 8 * world * nb
+    return packed < dense
+
+
+def init_onebit_wire_error(params, world: int):
+    """Worker-stacked error-feedback state for the packed wire: one
+    fp32 residual per worker per leaf, [W, ...] sharded over the data
+    axis so each device holds only its own row."""
+    return jax.tree.map(
+        lambda p: jnp.zeros((world,) + tuple(p.shape), jnp.float32), params)
+
+
+def onebit_hyperparams(name: str, cfg: dict) -> dict:
+    """The onebit optimizers' hyperparameters with their defaults —
+    single-sourced between :func:`build_onebit_optimizer` and the
+    engine's compressed-phase program builder."""
+    betas = tuple(cfg.get("betas", (0.9, 0.999)))
+    is_lamb = "lamb" in name
+    hp = {"b1": float(betas[0]), "b2": float(betas[1]),
+          "freeze_step": int(cfg.get("freeze_step", 100)),
+          "weight_decay": float(cfg.get("weight_decay", 0.0)),
+          "eps": float(cfg.get("eps", 1e-6 if is_lamb else 1e-8)),
+          "lamb": is_lamb}
+    if is_lamb:
+        hp["min_trust"] = float(cfg.get("min_coeff", 0.01))
+        hp["max_trust"] = float(cfg.get("max_coeff", 10.0))
+    return hp
 
 
 def onebit_adam(learning_rate, b1: float = 0.9, b2: float = 0.999,
@@ -81,10 +150,8 @@ def onebit_adam(learning_rate, b1: float = 0.9, b2: float = 0.999,
             count, freeze_step).astype(jnp.float32)
 
         def upd(m, v, p):
-            step = (m / bias1) / (jnp.sqrt(v / bias2) + eps)
-            if weight_decay > 0 and p is not None:
-                step = step + weight_decay * p
-            return -lr * step
+            return -lr * adam_step_math(m, v, bias1, bias2, eps,
+                                        weight_decay, p)
 
         updates = (jax.tree.map(upd, m_new, v_new, params)
                    if params is not None else
@@ -119,37 +186,26 @@ def onebit_lamb(learning_rate, b1: float = 0.9, b2: float = 0.999,
             updates = jax.tree.map(
                 lambda u, p: u - lr * weight_decay * p, updates, params)
 
-        def trust(u, p):
-            # The trust ratio is defined on the RAW Adam step (reference
-            # onebit/lamb.py:232-249) — u holds -lr*step, so divide lr back
-            # out of the norm or lr cancels out of the update entirely.
-            p_norm = jnp.linalg.norm(p.reshape(-1))
-            raw_norm = (jnp.linalg.norm(u.reshape(-1)) /
-                        jnp.maximum(lr, 1e-30))
-            ratio = jnp.where(
-                (p_norm > 0) & (raw_norm > 0),
-                jnp.clip(p_norm / raw_norm, min_trust, max_trust), 1.0)
-            return u * ratio
-        updates = jax.tree.map(trust, updates, params)
+        updates = jax.tree.map(
+            lambda u, p: lamb_trust_math(u, p, lr, min_trust, max_trust),
+            updates, params)
         return updates, new_state
 
     return optax.GradientTransformation(init, update)
 
 
 def build_onebit_optimizer(name, cfg, lr):
-    betas = cfg.get("betas", (0.9, 0.999))
-    freeze = int(cfg.get("freeze_step", 100))
+    hp = onebit_hyperparams(name, cfg)
     log_dist(
-        f"{name}: warmup(full-precision) for {freeze} steps, then "
-        f"error-feedback 1-bit momentum with frozen variance", ranks=[0])
-    if "lamb" in name:
-        return onebit_lamb(lr, b1=betas[0], b2=betas[1],
-                           eps=cfg.get("eps", 1e-6),
-                           weight_decay=cfg.get("weight_decay", 0.0),
-                           freeze_step=freeze,
-                           min_trust=cfg.get("min_coeff", 0.01),
-                           max_trust=cfg.get("max_coeff", 10.0))
-    return onebit_adam(lr, b1=betas[0], b2=betas[1],
-                       eps=cfg.get("eps", 1e-8),
-                       weight_decay=cfg.get("weight_decay", 0.0),
-                       freeze_step=freeze)
+        f"{name}: warmup(full-precision) for {hp['freeze_step']} steps, "
+        f"then error-feedback 1-bit momentum with frozen variance",
+        ranks=[0])
+    if hp["lamb"]:
+        return onebit_lamb(lr, b1=hp["b1"], b2=hp["b2"], eps=hp["eps"],
+                           weight_decay=hp["weight_decay"],
+                           freeze_step=hp["freeze_step"],
+                           min_trust=hp["min_trust"],
+                           max_trust=hp["max_trust"])
+    return onebit_adam(lr, b1=hp["b1"], b2=hp["b2"], eps=hp["eps"],
+                       weight_decay=hp["weight_decay"],
+                       freeze_step=hp["freeze_step"])

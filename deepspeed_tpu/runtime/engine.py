@@ -323,6 +323,20 @@ class DeepSpeedEngine:
         self._moe_stats_steps = 0
         self._moe_acc_fn = None
 
+        # ---- 1-bit optimizer wire tier (off by default; docs/onebit.md)
+        # Warmup keeps the dense grad/apply programs bit-for-bit; after
+        # freeze_step the engine swaps to the compressed-phase programs
+        # (_onebit_get_programs): local (unreduced) gradients plus an
+        # error-feedback packed-sign momentum sync — the one-time PLANNED
+        # retrace at the freeze boundary (_enter_onebit_compressed).
+        self._onebit = None
+        self._onebit_phase = "warmup"
+        self._onebit_wire_error = None
+        self._onebit_programs = None
+        self._onebit_sig_cache = {}
+        if self.config.zero_config.low_bandwidth.onebit:
+            self._init_onebit_tier()
+
         # ---- compiled programs --------------------------------------- #
         self._build_functions()
 
@@ -973,6 +987,363 @@ class DeepSpeedEngine:
             donate_argnums=self._apply_donate_argnums)
 
     # ------------------------------------------------------------------ #
+    # 1-bit optimizer wire tier (docs/onebit.md)
+    # ------------------------------------------------------------------ #
+    def _init_onebit_tier(self):
+        """Validate and arm zero_optimization.low_bandwidth.onebit.
+
+        Config-level conflicts (ZeRO stage 3, offload_optimizer, sparse
+        gradients, gradient clipping, a non-onebit optimizer) already
+        raised in config.py; engine-level conflicts — anything that
+        changes the shape of the grad program — raise here, loudly,
+        instead of silently degrading to the numerics-only fallback."""
+        from ..parallel.mesh import DATA_AXIS
+        from .comm.onebit import onebit_hyperparams
+        if self.client_optimizer is not None:
+            raise ValueError(
+                "zero_optimization.low_bandwidth.onebit drives the "
+                "optimizer update itself in the compressed phase — it "
+                "requires the config-built OneBitAdam/OneBitLamb, not a "
+                "client optax optimizer")
+        if getattr(self, "_custom_grad_program", None) is not None:
+            raise ValueError(
+                "zero_optimization.low_bandwidth.onebit: a custom grad "
+                "program (pipeline 1F1B executor) schedules its own "
+                "reduction — the 1-bit momentum wire cannot replace it")
+        for ax in self.mesh_ctx.mesh.axis_names:
+            if ax != DATA_AXIS and self.mesh_ctx.axis_size(ax) > 1:
+                raise ValueError(
+                    "zero_optimization.low_bandwidth.onebit requires a "
+                    "pure data-parallel mesh (the compressed momentum "
+                    f"sync shards worker rows over {DATA_AXIS!r} only); "
+                    f"axis {ax!r} has size {self.mesh_ctx.axis_size(ax)}")
+        if self._moe_stats_enabled:
+            logger.warning(
+                "monitor.moe: the 1-bit compressed-phase grad region does "
+                "not thread routing stats out of its manual collectives — "
+                "disabling MoE routing telemetry for this engine")
+            self._moe_stats_enabled = False
+        lbc = self.config.zero_config.low_bandwidth
+        dp = self.world_size
+        if dp <= 1:
+            logger.warning(
+                "zero_optimization.low_bandwidth.onebit: data-parallel "
+                "world size is 1 — there is no gradient wire to compress; "
+                "the optimizer keeps its numerics-only compression and "
+                "the wire tier stays inert")
+            return
+        block = int(lbc.block_size)
+        if block < 8 or block % 8:
+            raise ValueError(
+                "zero_optimization.low_bandwidth.onebit packs signs "
+                "8-per-byte, so low_bandwidth.block_size must be a "
+                f"multiple of 8 (>= 8); got {block}")
+        G = int(lbc.hpz_group_size or 0)
+        if G > 1 and dp % G:
+            raise ValueError(
+                f"zero_optimization.low_bandwidth.onebit: hpz_group_size="
+                f"{G} must divide the data-parallel world size {dp} for "
+                "the hierarchical (intra-group dense, cross-group 1-bit) "
+                "variant")
+        hp = onebit_hyperparams(self.config.optimizer_name,
+                                self.config.optimizer_params)
+        self._onebit = {"world": dp, "hp": hp,
+                        "freeze_step": hp["freeze_step"], "block": block,
+                        "group_size": G if G > 1 else 0,
+                        "axis": DATA_AXIS}
+        log_dist(
+            f"onebit tier armed: warmup(dense) for {hp['freeze_step']} "
+            f"steps, then packed-sign momentum sync over {dp} workers "
+            f"(block={block}"
+            + (f", hierarchical groups of {G}" if G > 1 else "") + ")",
+            ranks=[0])
+
+    def _maybe_onebit_switch(self):
+        """Freeze-boundary phase switch, called at window starts only.
+        Gated on the host-side global_steps first: the applied count is
+        <= global_steps, so no device sync happens before the boundary is
+        even reachable; after the switch there is nothing left to check.
+        (fp16 overflow-skipped steps do not advance the applied count, so
+        the switch can trail global_steps until the count catches up —
+        the optimizer's own in_warmup gate uses the same count.)"""
+        ob = self._onebit
+        if ob is None or self._onebit_phase != "warmup":
+            return
+        if self.global_steps < ob["freeze_step"]:
+            return
+        if self._applied_step_count() >= ob["freeze_step"]:
+            self._enter_onebit_compressed(planned=True)
+
+    def _enter_onebit_compressed(self, planned: bool):
+        """One-time warmup -> compressed transition.
+
+        Re-places the optimizer state replicated (the synced momentum is
+        definitionally replicated, so the stage-1/2 optimizer-sharding
+        memory win is deliberately undone — docs/onebit.md), allocates
+        the worker-stacked wire-error state, builds (or reuses) the
+        phase-B programs, and tells the RecompileGuard this retrace was
+        PLANNED: counted in the tally (benches pin it at exactly one) but
+        never charged against the storm budget.  A checkpoint load that
+        lands past freeze_step re-enters with planned=False — the resume
+        retrace is already accounted by the guard's restore contract."""
+        from .comm.onebit import init_onebit_wire_error
+        ob = self._onebit
+        if planned and self._recompile_guard is not None:
+            self._recompile_guard.note_planned()
+        replicated = self.mesh_ctx.replicated()
+        self.opt_state = jax.device_put(self.opt_state, replicated)
+        progs = self._onebit_get_programs()
+        self._onebit_wire_error = jax.device_put(
+            init_onebit_wire_error(self.params, ob["world"]),
+            self.mesh_ctx.sharding(ob["axis"]))
+        self._onebit_phase = "compressed"
+        self._lockstep_sig_cache = None
+        if self._fused_step_fn is not None:
+            fb = progs["fused"]
+            self._fused_step_fn = fb["fn"]
+            self._fused_step_raw = fb["raw"]
+            self._fused_donate_argnums = fb["donate_argnums"]
+            self._fused_dispatch_label = fb["label"]
+        log_dist(
+            f"onebit tier: entering compressed phase at applied step "
+            f"{ob['freeze_step']} (planned retrace: {planned}) — dense "
+            "grad allreduce removed, momentum rides the packed wire",
+            ranks=[0])
+
+    def _exit_onebit_compressed(self):
+        """Inverse transition, for loading a warmup-phase checkpoint into
+        an engine already past its switch: the warmup programs were never
+        discarded, so this only restores the phase bookkeeping."""
+        self._onebit_phase = "warmup"
+        self._onebit_wire_error = None
+        self._lockstep_sig_cache = None
+        if self._fused_step_fn is not None and \
+                self._onebit_programs is not None:
+            fa = self._onebit_programs.get("fused_phase_a")
+            if fa is not None:
+                self._fused_step_fn = fa["fn"]
+                self._fused_step_raw = fa["raw"]
+                self._fused_donate_argnums = fa["donate_argnums"]
+                self._fused_dispatch_label = fa["label"]
+        log_dist("onebit tier: back to warmup phase (checkpoint load)",
+                 ranks=[0])
+
+    def _onebit_get_programs(self):
+        """Build (once, cached) the compressed-phase programs.
+
+        Callable on a warmup-phase engine without mutating any engine
+        state — the Program Auditor prices BOTH phase programs at init
+        (engine_targets(phase="compressed")).
+
+        Phase-B grad program: the sparse-gradients shard_map idiom, but
+        gradients stay LOCAL — each worker's grad rides out as row i of a
+        [W, ...] stack sharded over the data axis; the compiler-inserted
+        dense allreduce is gone.  Phase-B apply program: momentum update
+        with the local grad, then the error-feedback packed-sign sync
+        (compressed_allreduce_inner wire="packed") per leaf — with the
+        per-leaf wire-cost gate keeping skinny leaves on an exact dense
+        mean — then Adam/LAMB math on the synced momentum with the frozen
+        variance (bias2 pinned at freeze_step).  The fp16 overflow skip
+        and the sentinel verdict ride one globally-psum'd select
+        predicate: a skipped step reverts params, momentum, count AND the
+        wire-error state."""
+        if self._onebit_programs is not None:
+            return self._onebit_programs
+        from jax.sharding import PartitionSpec
+        from .comm.compressed import compressed_allreduce_inner
+        from .comm.onebit import (OnebitState, adam_step_math,
+                                  lamb_trust_math, onebit_leaf_saves_bytes)
+        ob = self._onebit
+        assert ob is not None, "onebit programs need an armed tier"
+        axis, W = ob["axis"], ob["world"]
+        block, group_size = ob["block"], ob["group_size"]
+        hp = ob["hp"]
+        gas = self.gradient_accumulation_steps()
+        mesh = self.mesh_ctx.mesh
+        compute_dtype = self.compute_dtype
+        apply_model = self._apply_model
+        scaler_cfg = self.scaler_cfg
+        prescale = self.config.prescale_gradients
+        predivide = self.config.gradient_predivide_factor
+        grads_half = (self.config.bf16.enabled
+                      and self.config.bf16.grads_in_compute_dtype)
+        schedule = (self.lr_scheduler.lr_at
+                    if self.lr_scheduler is not None
+                    else float(self.config.optimizer_params.get("lr", 1e-3)))
+        P0 = PartitionSpec()
+        Pax = PartitionSpec(axis)
+        replicated = self.mesh_ctx.replicated()
+        stacked_sharding = self.mesh_ctx.sharding(axis)
+
+        def loss_and_grads(params, scaler_state, rng, *args, **kwargs):
+            args = _tree_cast(args, compute_dtype)
+            kwargs = _tree_cast(kwargs, compute_dtype)
+
+            def batch_spec(a):
+                shape = getattr(a, "shape", ())
+                if len(shape) >= 1 and shape[0] % W == 0:
+                    return Pax
+                return P0
+
+            args_specs = jax.tree.map(batch_spec, args)
+            kwargs_specs = jax.tree.map(batch_spec, kwargs)
+
+            def region(p, ls, r, rargs, rkwargs):
+                # independent dropout per shard (the sparse-region idiom)
+                r = jax.random.fold_in(r, lax.axis_index(axis))
+
+                def loss_fn(pp):
+                    cp = _tree_cast(pp, compute_dtype)
+                    out = apply_model(cp, r, *rargs, **rkwargs)
+                    loss = out[0] if isinstance(out, tuple) else out
+                    return loss.astype(jnp.float32) * ls, loss
+
+                (_, loss), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(p)
+                # grads stay LOCAL — stacked [1, ...] per shard, [W, ...]
+                # globally; synchronization moved to the momentum wire
+                grads = jax.tree.map(lambda g: g[None], grads)
+                return lax.pmean(loss, axis), grads
+
+            loss, grads = jax.shard_map(
+                region, mesh=mesh,
+                in_specs=(P0, P0, P0, args_specs, kwargs_specs),
+                out_specs=(P0, Pax), axis_names={axis},
+                check_vma=False)(
+                params, scaler_state.loss_scale, rng, args, kwargs)
+            if prescale and predivide:
+                grads = jax.tree.map(lambda g: g / predivide, grads)
+            if grads_half:
+                grads = _tree_cast(grads, compute_dtype)
+            return loss, grads
+
+        b1, b2, eps = hp["b1"], hp["b2"], hp["eps"]
+        wd, is_lamb = hp["weight_decay"], hp["lamb"]
+        # v froze at freeze_step, so its bias correction is pinned there —
+        # a STATIC python float (matches the optax path's
+        # b2**min(count, freeze_step) once count > freeze_step)
+        bias2 = 1.0 - b2 ** float(hp["freeze_step"])
+
+        def apply_core(params, opt_state, scaler_state, grads, wire_error,
+                       healthy):
+
+            def region(p_tree, st, sstate, g_tree, e_tree, ok_in):
+                inv = 1.0 / (sstate.loss_scale * gas)
+                g_tree = jax.tree.map(
+                    lambda g: g[0].astype(jnp.float32) * inv, g_tree)
+                e_tree = jax.tree.map(lambda e: e[0], e_tree)
+                # globally-agreed overflow verdict: each worker counts its
+                # own non-finite lanes and the psum makes the skip
+                # collective — local grads differ, so a local isfinite
+                # check alone could diverge the select across workers
+                bad = jnp.zeros((), jnp.float32)
+                for g in jax.tree.leaves(g_tree):
+                    bad += jnp.sum((~jnp.isfinite(g)).astype(jnp.float32))
+                finite = lax.psum(bad, axis) == 0
+                overflow = ~finite
+                ok = finite & ok_in
+                count = st.count + 1
+                m_raw = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g,
+                                     st.m, g_tree)
+                flat_m, treedef = jax.tree.flatten(m_raw)
+                flat_e = jax.tree.leaves(e_tree)
+                synced, new_err = [], []
+                for mr, er in zip(flat_m, flat_e):
+                    if onebit_leaf_saves_bytes(mr.shape, jnp.float32, W,
+                                               block):
+                        r_, e_ = compressed_allreduce_inner(
+                            mr, er, axis, wire="packed", block=block,
+                            group_size=group_size)
+                    else:
+                        # skinny leaf: blockwise-scale overhead loses to a
+                        # dense mean — keep it exact (per-leaf wire gate)
+                        r_, e_ = lax.pmean(mr, axis), er
+                    synced.append(r_)
+                    new_err.append(e_)
+                m_syn = jax.tree.unflatten(treedef, synced)
+                e_new = jax.tree.unflatten(treedef, new_err)
+                bias1 = 1.0 - b1 ** count.astype(jnp.float32)
+                lr = (schedule(count - 1) if callable(schedule)
+                      else schedule)
+                if is_lamb:
+                    lr32 = jnp.asarray(lr, jnp.float32)
+                    upd = jax.tree.map(
+                        lambda m, v: -lr32 * adam_step_math(
+                            m, v, bias1, bias2, eps), m_syn, st.v)
+                    if wd > 0:
+                        upd = jax.tree.map(
+                            lambda u, p: u - lr32 * wd * p, upd, p_tree)
+                    upd = jax.tree.map(
+                        lambda u, p: lamb_trust_math(
+                            u, p, lr32, hp["min_trust"], hp["max_trust"]),
+                        upd, p_tree)
+                else:
+                    upd = jax.tree.map(
+                        lambda m, v, p: -lr * adam_step_math(
+                            m, v, bias1, bias2, eps, wd, p),
+                        m_syn, st.v, p_tree)
+                new_params = jax.tree.map(
+                    lambda p, u: p + jnp.where(ok, u, 0).astype(p.dtype),
+                    p_tree, upd)
+                # a skipped step (overflow or sentinel) reverts momentum,
+                # count and the wire-error state in lockstep with the
+                # params; v and the numerics-only error are frozen
+                # pass-throughs either way
+                m_sel = jax.tree.map(lambda n, o: jnp.where(ok, n, o),
+                                     m_syn, st.m)
+                e_sel = jax.tree.map(lambda n, o: jnp.where(ok, n, o),
+                                     e_new, e_tree)
+                new_count = jnp.where(ok, count, st.count)
+                new_state = OnebitState(new_count, m_sel, st.v, st.error)
+                new_scaler = update_loss_scale(scaler_cfg, sstate,
+                                               overflow)
+                e_out = jax.tree.map(lambda e: e[None], e_sel)
+                return new_params, new_state, new_scaler, overflow, e_out
+
+            return jax.shard_map(
+                region, mesh=mesh,
+                in_specs=(P0, P0, P0, Pax, Pax, P0),
+                out_specs=(P0, P0, P0, P0, Pax), axis_names={axis},
+                check_vma=False)(
+                params, opt_state, scaler_state, grads, wire_error,
+                healthy)
+
+        apply_donate = (0, 1, 3, 4)
+        progs = {
+            "loss_and_grads": loss_and_grads,
+            "grad_fn": jax.jit(
+                loss_and_grads,
+                out_shardings=(replicated, stacked_sharding)),
+            "acc_fn": jax.jit(
+                lambda a, g: jax.tree.map(jnp.add, a, g),
+                out_shardings=stacked_sharding, donate_argnums=(0,)),
+            "apply_core": apply_core,
+            "apply_donate_argnums": apply_donate,
+            "apply_fn": jax.jit(
+                apply_core,
+                out_shardings=(self.param_shardings, replicated,
+                               replicated, replicated, stacked_sharding),
+                donate_argnums=apply_donate),
+            "wire_sharding": stacked_sharding,
+        }
+        if self._fused_step_fn is not None:
+            from .fused_step import build_fused_step
+            progs["fused_phase_a"] = {
+                "fn": self._fused_step_fn,
+                "raw": self._fused_step_raw,
+                "donate_argnums": self._fused_donate_argnums,
+                "label": self._fused_dispatch_label,
+            }
+            progs["fused"] = build_fused_step(self, onebit={
+                "loss_and_grads": loss_and_grads,
+                "apply_core": apply_core,
+                "world": W,
+                "wire_sharding": stacked_sharding,
+            })
+        self._onebit_programs = progs
+        return progs
+
+    # ------------------------------------------------------------------ #
     # data placement
     # ------------------------------------------------------------------ #
     def _shard_batch(self, tree):
@@ -1031,6 +1402,11 @@ class DeepSpeedEngine:
         instead of a separate autograd pass — backward() then only
         accumulates.  This keeps the DeepSpeed call protocol while staying
         single-dispatch on TPU."""
+        if (self._onebit is not None and self._onebit_phase == "warmup"
+                and self._cached_grads is None and self._grad_acc is None):
+            # only at gas-window starts: a phase switch mid-window would
+            # mix dense and local gradients in one accumulation
+            self._maybe_onebit_switch()
         if self.wall_clock_breakdown():
             self.timers(FORWARD_MICRO_TIMER).start()
         if self._is_train_mode:
@@ -1092,13 +1468,18 @@ class DeepSpeedEngine:
         trace_on = self.monitor is not None and self.monitor.trace_active
         if trace_on:
             _tp0 = time.perf_counter()
+        grad_fn = self._grad_fn
+        if self._onebit is not None and self._onebit_phase == "compressed":
+            # compressed phase: local (unreduced) stacked grads — the
+            # dense allreduce left the program at the freeze boundary
+            grad_fn = self._onebit_programs["grad_fn"]
         if self._moe_stats_enabled:
-            loss, grads, moe_stats = self._grad_fn(
+            loss, grads, moe_stats = grad_fn(
                 self.params, self.scaler_state, rng, *args, **kwargs)
             self._moe_note_stats(moe_stats)
         else:
-            loss, grads = self._grad_fn(self.params, self.scaler_state,
-                                        rng, *args, **kwargs)
+            loss, grads = grad_fn(self.params, self.scaler_state,
+                                  rng, *args, **kwargs)
         if trace_on:
             # host DISPATCH window of the grad program (XLA executes
             # asynchronously behind it) — the async-host-loop timeline
@@ -1135,7 +1516,12 @@ class DeepSpeedEngine:
         if self._grad_acc is None:
             self._grad_acc = self._cached_grads
         else:
-            self._grad_acc = self._acc_fn(self._grad_acc, self._cached_grads)
+            acc_fn = self._acc_fn
+            if self._onebit is not None and \
+                    self._onebit_phase == "compressed":
+                # stacked [W, ...] leaves need the stacked out-sharding
+                acc_fn = self._onebit_programs["acc_fn"]
+            self._grad_acc = acc_fn(self._grad_acc, self._cached_grads)
         if trace_on:
             self.monitor.add_phase("accumulate_dispatch", _tp0,
                                    step=self.global_steps + 1)
@@ -1179,6 +1565,16 @@ class DeepSpeedEngine:
         if self._offload_enabled:
             # host-side optimizer: a sentinel skip simply never runs it
             overflow = False if sentinel_skip else self._offload_step()
+        elif self._onebit is not None and self._onebit_phase == "compressed":
+            # compressed-phase apply: momentum sync on the packed wire;
+            # the wire-error state threads through as a donated arg, and
+            # the sentinel verdict rides the same healthy flag as the
+            # dense path (always passed — one program, both postures)
+            (self.params, self.opt_state, self.scaler_state, overflow,
+             self._onebit_wire_error) = self._onebit_programs["apply_fn"](
+                self.params, self.opt_state, self.scaler_state,
+                self._grad_acc, self._onebit_wire_error,
+                jnp.asarray(not sentinel_skip))
         elif self.sentinel is not None:
             (self.params, self.opt_state, self.scaler_state,
              overflow) = self._apply_fn(self.params, self.opt_state,
@@ -1833,6 +2229,8 @@ class DeepSpeedEngine:
         leading scan axis, run the whole-step program, then do the same
         host bookkeeping step() would — minus the per-microbatch fences."""
         from .dataloader import stack_microbatches
+        if self._onebit is not None and self._onebit_phase == "warmup":
+            self._maybe_onebit_switch()
         gas = self.gradient_accumulation_steps()
         batches = []
         for _ in range(gas):
@@ -1854,10 +2252,23 @@ class DeepSpeedEngine:
         trace_on = self.monitor is not None and self.monitor.trace_active
         if trace_on:
             _tp0 = time.perf_counter()
-        fused_out = self._fused_step_fn(
-            self.params, self.opt_state, self.scaler_state,
-            self._fused_sent_state, rng, args, {})
-        if self._moe_stats_enabled:
+        if self._onebit is not None and self._onebit_phase == "compressed":
+            # compressed-phase fused program threads the wire-error state
+            # through as a donated carry (fused_step.py onebit build)
+            (self.params, self.opt_state, self.scaler_state,
+             self._fused_sent_state, self._onebit_wire_error, loss,
+             overflow, sent_flags) = self._fused_step_fn(
+                self.params, self.opt_state, self.scaler_state,
+                self._fused_sent_state, self._onebit_wire_error, rng,
+                args, {})
+            fused_out = None
+        else:
+            fused_out = self._fused_step_fn(
+                self.params, self.opt_state, self.scaler_state,
+                self._fused_sent_state, rng, args, {})
+        if fused_out is None:
+            pass
+        elif self._moe_stats_enabled:
             (self.params, self.opt_state, self.scaler_state,
              self._fused_sent_state, loss, overflow, sent_flags,
              moe_stats) = fused_out
@@ -1974,10 +2385,15 @@ class DeepSpeedEngine:
     def _engine_state(self) -> Dict[str, Any]:
         opt = (self._offload_opt.state_dict() if self._offload_enabled
                else self.opt_state)
-        return {
+        state = {
             "optimizer": opt,
             "scaler": self.scaler_state,
         }
+        if self._onebit_wire_error is not None:
+            # compressed-phase error feedback rides the optimizer state
+            # (it IS optimizer state: per-worker wire residuals)
+            state["onebit_wire_error"] = self._onebit_wire_error
+        return state
 
     def _sharded_checkpoints(self) -> bool:
         cfg = self.config.checkpoint_config.sharded
@@ -1985,12 +2401,37 @@ class DeepSpeedEngine:
             return bool(cfg)
         return jax.process_count() > 1
 
-    def lockstep_signature(self) -> Optional[str]:
+    def lockstep_signature(self, phase: Optional[str] = None
+                           ) -> Optional[str]:
         """Collective lockstep signature of this engine's step programs
         (analysis/signature.py).  Reuses the init-time audit when the
         analysis block ran; otherwise traced lazily ONCE (abstract trace,
         never executed) and cached — save/resume verification must not
-        re-trace on every checkpoint."""
+        re-trace on every checkpoint.
+
+        With the 1-bit tier armed the phase is part of program identity:
+        each side of freeze_step has its OWN pinned signature (cached per
+        phase), and a resume verifies against the phase the checkpoint
+        was saved in (load_checkpoint syncs the phase before verifying)."""
+        if self._onebit is not None and self._onebit.get("world", 0) > 1:
+            phase = phase or self._onebit_phase
+            if phase not in self._onebit_sig_cache:
+                try:
+                    from ..analysis.auditor import engine_targets
+                    from ..analysis.signature import (combine_signatures,
+                                                      lockstep_signature)
+                    sigs = [lockstep_signature(t.closed_jaxpr)[0]
+                            for t in engine_targets(self, phase=phase)]
+                    self._onebit_sig_cache[phase] = combine_signatures(
+                        sigs)
+                except Exception as e:  # noqa: BLE001 — degrade to "no
+                    # signature", never block a checkpoint save
+                    logger.warning(
+                        f"lockstep signature trace failed for onebit "
+                        f"phase {phase!r} ({e}) — resume re-verification "
+                        "will be skipped for this phase")
+                    self._onebit_sig_cache[phase] = ""
+            return self._onebit_sig_cache[phase] or None
         if self.program_audit is not None and \
                 self.program_audit.signature is not None:
             return self.program_audit.signature
@@ -2065,6 +2506,10 @@ class DeepSpeedEngine:
         # the analysis block already traced it for free.
         from .resilience import reshard as reshard_mod
         client[reshard_mod.TOPOLOGY_KEY] = self._partition_topology()
+        if self._onebit is not None:
+            # phase is program identity: a resume re-enters the right
+            # phase programs BEFORE verifying the lockstep signature
+            client["onebit_phase"] = self._onebit_phase
         if self.resilience.enabled or self.program_audit is not None:
             sig = self.lockstep_signature()
             if sig:
@@ -2182,9 +2627,6 @@ class DeepSpeedEngine:
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False):
-        module_tmpl = {"module": self.params}
-        opt_tmpl = (None if load_module_only or not load_optimizer_states
-                    else self._engine_state())
         resolved_tag = tag or ckpt_mod.read_latest_tag(load_dir)
         if self.resilience.verify_enabled:
             resolved_tag = self._resolve_verified_tag(load_dir, tag)
@@ -2198,11 +2640,26 @@ class DeepSpeedEngine:
         resharded = reshard_mod.check_reshard(
             str(resolved_tag), saved_client, self._partition_topology(),
             current_world_size=self.world_size)
+        # ---- 1-bit phase sync (before the signature verify AND before
+        # the optimizer-state template: a cross-freeze load must verify
+        # against the saved phase's signature and restore into the saved
+        # phase's state structure — wire-error included or not) --------- #
+        saved_phase = saved_client.get("onebit_phase")
+        if self._onebit is not None and saved_phase:
+            if (saved_phase == "compressed"
+                    and self._onebit_phase == "warmup"):
+                self._enter_onebit_compressed(planned=False)
+            elif (saved_phase == "warmup"
+                    and self._onebit_phase == "compressed"):
+                self._exit_onebit_compressed()
         if self.resilience.lockstep_resume_enabled and (
                 saved_client.get(reshard_mod.SIGNATURE_KEY) or resharded):
             reshard_mod.verify_lockstep_resume(
                 str(resolved_tag), saved_client, self.lockstep_signature(),
                 resharded)
+        module_tmpl = {"module": self.params}
+        opt_tmpl = (None if load_module_only or not load_optimizer_states
+                    else self._engine_state())
         sharded_index = os.path.join(load_dir, str(resolved_tag),
                                      "model_index.json")
         if os.path.isfile(sharded_index):
@@ -2239,6 +2696,10 @@ class DeepSpeedEngine:
             else:
                 self.opt_state = opt_state["optimizer"]
             self.scaler_state = opt_state["scaler"]
+            if opt_state.get("onebit_wire_error") is not None:
+                # error-feedback residuals resume exactly — a restore
+                # mid-compression must not re-zero the feedback loop
+                self._onebit_wire_error = opt_state["onebit_wire_error"]
         elif self._offload_enabled:
             # No optimizer state loaded (load_module_only /
             # load_optimizer_states=False): the host fp32 master would

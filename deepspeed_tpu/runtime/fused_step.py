@@ -115,7 +115,7 @@ def fused_fallback_reason(engine) -> Optional[str]:
     return None
 
 
-def build_fused_step(engine):
+def build_fused_step(engine, onebit=None):
     """Compile the fused whole-step program for `engine`.
 
     Signature of the returned jitted callable::
@@ -128,15 +128,33 @@ def build_fused_step(engine):
     ``batch_args``/``batch_kwargs`` carry a leading ``[gas]`` microbatch
     axis on every leaf (dataloader.stack_microbatches).  params/opt_state
     are donated and alias the outputs; grad buffers are program-internal.
+
+    ``onebit`` (engine._onebit_get_programs) selects the compressed-phase
+    twin: the scan body is the phase-B grad program (local [W, ...]
+    stacked grads — no dense allreduce) and the epilogue the phase-B
+    apply (packed-sign momentum sync, wire-error state threaded through
+    as a donated carry).  The onebit build returns a dict
+    {fn, raw, donate_argnums, label} and does NOT touch the engine's
+    telemetry attributes — the engine installs them at the phase switch.
+    The onebit callable's signature gains the wire-error carry::
+
+        (params, opt_state, scaler_state, sent_state, wire_error, rng,
+         batch_args, batch_kwargs)
+          -> (params', opt_state', scaler_state', sent_state',
+              wire_error', mean_loss, overflow, (flagged, nonfinite))
     """
     gas = engine.gradient_accumulation_steps()
-    loss_and_grads = engine._loss_and_grads
+    loss_and_grads = (onebit["loss_and_grads"] if onebit is not None
+                      else engine._loss_and_grads)
     # MoE routing stats (monitor.moe): the scan body's aux RoutingStats
     # ride out as stacked scan outputs and are summed over the [gas]
     # axis IN-program — the accumulator crosses the microbatch scan
-    # without a host touch (docs/telemetry.md)
-    moe_stats = getattr(engine, "_moe_stats_enabled", False)
-    apply_core = engine._apply_core
+    # without a host touch (docs/telemetry.md).  The onebit tier disables
+    # MoE telemetry at init, so the onebit build never threads stats.
+    moe_stats = (getattr(engine, "_moe_stats_enabled", False)
+                 and onebit is None)
+    apply_core = (onebit["apply_core"] if onebit is not None
+                  else engine._apply_core)
     if apply_core is None:  # pragma: no cover — guarded by fallback_reason
         raise RuntimeError("fused_step requires the compiled apply path")
     compute_dtype = engine.compute_dtype
@@ -187,10 +205,17 @@ def build_fused_step(engine):
         return flagged, nonfinite, new_state
 
     def fused_step(params, opt_state, scaler_state, sent_state, rng,
-                   batch_args, batch_kwargs):
+                   batch_args, batch_kwargs, wire_error=None):
         rngs = jax.random.split(rng, gas)
-        zeros = jax.tree.map(
-            lambda p: jnp.zeros(p.shape, _grad_dtype(p)), params)
+        if onebit is not None:
+            # phase-B grads are worker-stacked: [W, ...] per leaf
+            wn = onebit["world"]
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros((wn,) + tuple(p.shape),
+                                    _grad_dtype(p)), params)
+        else:
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, _grad_dtype(p)), params)
 
         def body(carry, xs):
             acc, loss_sum = carry
@@ -226,6 +251,12 @@ def build_fused_step(engine):
                 # NaN loss also NaNs the grads, so the apply's own finite
                 # check would catch it even without the sentinel
                 healthy = ~flagged
+        if onebit is not None:
+            (new_params, new_opt, new_scaler, overflow,
+             new_wire) = apply_core(params, opt_state, scaler_state,
+                                    grads, wire_error, healthy)
+            return (new_params, new_opt, new_scaler, new_sent, new_wire,
+                    mean_loss, overflow, (flagged, nonfinite))
         new_params, new_opt, new_scaler, overflow = apply_core(
             params, opt_state, scaler_state, grads, healthy)
         out = (new_params, new_opt, new_scaler, new_sent, mean_loss,
@@ -237,6 +268,25 @@ def build_fused_step(engine):
     replicated = engine.mesh_ctx.replicated()
     sent_shardings = jax.tree.map(lambda _: replicated,
                                   engine._fused_sent_state)
+    if onebit is not None:
+        # positional wire-error carry (donation needs a positional slot)
+        def fused_step_onebit(params, opt_state, scaler_state, sent_state,
+                              wire_error, rng, batch_args, batch_kwargs):
+            return fused_step(params, opt_state, scaler_state, sent_state,
+                              rng, batch_args, batch_kwargs,
+                              wire_error=wire_error)
+
+        donate = (0, 1, 4)
+        out_shardings = (engine.param_shardings, replicated, replicated,
+                         sent_shardings, onebit["wire_sharding"],
+                         replicated, replicated, (replicated, replicated))
+        return {
+            "fn": jax.jit(fused_step_onebit, out_shardings=out_shardings,
+                          donate_argnums=donate),
+            "raw": fused_step_onebit,
+            "donate_argnums": donate,
+            "label": f"fused_step(gas={gas},onebit)",
+        }
     # The un-jitted body, the donation facts, and the scan structure are
     # recorded on the engine for the Program Auditor (analysis/
     # auditor.py), which traces this exact program abstractly and audits

@@ -142,6 +142,9 @@ _SLOW_PREFIXES = (
     # allgather attention parity); the full trajectory matrices run slow
     "test_3d_matrix.py::test_pipe_expert_matches_baseline",
     "test_3d_matrix.py::test_pipe_seq_matches_baseline",
+    # HLO-compiles every candidate in the search (the dense twin's wire
+    # is GSPMD-inserted, so monotonicity needs the compiled view)
+    "test_autotuner.py::test_onebit_never_increases_wire_bytes",
     "test_bench_harness.py::test_sigterm_emits_one_diagnostic_json_line",
     "test_checkpoint_matrix.py::test_roundtrip",
     "test_convergence.py::test_gpt2_engine_converges",

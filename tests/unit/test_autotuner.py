@@ -243,6 +243,47 @@ def test_fcm_never_increases_wire_bytes():
     assert pairs >= 1
 
 
+def test_onebit_never_increases_wire_bytes():
+    """ISSUE 16 satellite: the autotuning.onebit axis swaps the base
+    optimizer for its OneBit counterpart and prices the candidate on
+    its STEADY-STATE (compressed-phase) program.  The dense twin's grad
+    allreduce is GSPMD-inserted (jaxpr-invisible), so monotonicity is
+    asserted on the compiled-HLO wire — which the 1-bit candidate's
+    explicit packed sync must undercut, never exceed."""
+    raw = copy.deepcopy(BASE)
+    raw["analysis"] = {"hlo_audit": True}
+    raw["autotuning"] = {"chips": 8, "global_batch": 16,
+                         "max_candidates": 12, "zero_stages": [2],
+                         "micro_batches": [2], "fused": [False],
+                         "onebit": [False, True]}
+    ds.reset_mesh_context()
+    try:
+        outcome = run_search(raw, chips=8)
+    finally:
+        ds.reset_mesh_context()
+    by_name = {rc.candidate.name: rc for rc in outcome.ranked}
+    pairs = 0
+    for name, rc in by_name.items():
+        if "-1bit-" not in name:
+            continue
+        twin = by_name.get(name.replace("-1bit-", "-"))
+        assert twin is not None, f"no onebit-off twin for {name}"
+        assert rc.candidate.knobs["onebit"] is True
+        assert twin.candidate.knobs["onebit"] is False
+        # the compressed program's wire is explicit -> jaxpr-counted
+        assert rc.report.wire_bytes_per_step > 0
+        assert (rc.report.hlo["hlo_wire_bytes_per_step"]
+                <= twin.report.hlo["hlo_wire_bytes_per_step"]), (
+            f"{name} moved MORE compiled wire than its dense twin")
+        pairs += 1
+    assert pairs >= 1
+    # the 1-bit candidate rode in on a OneBit optimizer swap
+    onebit_rc = next(rc for rc in outcome.ranked
+                     if rc.candidate.knobs["onebit"])
+    opt = onebit_rc.candidate.config[C.OPTIMIZER]["type"].lower()
+    assert opt.startswith("onebit"), opt
+
+
 def test_shrinking_hbm_budget_never_adds_candidates(example_outcome):
     """Budget monotonicity, both pruning layers.  Traced layer: a full
     search under a mid budget must survive a strict SUBSET of the

@@ -52,6 +52,13 @@ def test_resilience_package_imports_cleanly():
             # fused collective-matmul kernels: lazily reachable through
             # the streaming context's fcm routing and the bench fcm row
             "deepspeed_tpu.ops.collective_matmul",
+            # 1-bit optimizer wire tier: the compressed transport and
+            # wire accounting are lazily imported by the engine (only
+            # when low_bandwidth.onebit is on) and by bench.py's
+            # gpt2_onebit row
+            "deepspeed_tpu.runtime.comm.onebit",
+            "deepspeed_tpu.runtime.comm.compressed",
+            "deepspeed_tpu.runtime.comm.low_bandwidth",
             # telemetry monitor: lazily imported by the engines (only
             # when the monitor block is on)
             "deepspeed_tpu.monitor",
