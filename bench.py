@@ -369,6 +369,31 @@ def _program_audit_fields(engine, measured_step_s=None):
                 report, measured_step_s)
     except Exception as e:  # noqa: BLE001 — provenance is best-effort
         out["lockstep_signature"] = f"audit-failed: {e}"[:80]
+    out.update(_resilience_fields(engine))
+    return out
+
+
+def _resilience_fields(engine):
+    """Resilience provenance for a ladder row (docs/resilience.md):
+    which fallback tiers this process ran on (degradation registry) and
+    the I/O retry tally, so a row produced under degraded conditions —
+    python-tier aio, jsonl-tier metrics, retried swap writes — carries
+    that context next to its numbers instead of looking like a clean
+    regression.  Best-effort, like the audit fields."""
+    out = {}
+    try:
+        from deepspeed_tpu.runtime.resilience.degradation import \
+            get_registry
+        events = get_registry().events()
+        if events:
+            out["degradation_events"] = events
+        policy = getattr(engine, "_retry_policy", None)
+        if policy is not None:
+            snap = policy.snapshot()
+            if snap.get("attempts"):
+                out["retry_counters"] = snap
+    except Exception:  # noqa: BLE001 — provenance is best-effort
+        pass
     return out
 
 

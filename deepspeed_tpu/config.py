@@ -720,6 +720,8 @@ class MonitorConfig:
     straggler_min_ratio: float = C.MONITOR_STRAGGLER_MIN_RATIO_DEFAULT
     divergence_rel_spread: float = C.MONITOR_DIVERGENCE_REL_SPREAD_DEFAULT
     health_warmup_windows: int = C.MONITOR_HEALTH_WARMUP_WINDOWS_DEFAULT
+    fleet_exchange_deadline_s: float = (
+        C.MONITOR_FLEET_EXCHANGE_DEADLINE_S_DEFAULT)
     capture: MonitorCaptureConfig = field(
         default_factory=MonitorCaptureConfig)
     moe: MonitorMoeConfig = field(default_factory=MonitorMoeConfig)
@@ -779,6 +781,9 @@ class MonitorConfig:
             health_warmup_windows=int(get_scalar_param(
                 d, C.MONITOR_HEALTH_WARMUP_WINDOWS,
                 C.MONITOR_HEALTH_WARMUP_WINDOWS_DEFAULT)),
+            fleet_exchange_deadline_s=float(get_scalar_param(
+                d, C.MONITOR_FLEET_EXCHANGE_DEADLINE_S,
+                C.MONITOR_FLEET_EXCHANGE_DEADLINE_S_DEFAULT)),
             capture=MonitorCaptureConfig.from_dict(
                 d.get(C.MONITOR_CAPTURE)),
             moe=MonitorMoeConfig.from_dict(d.get(C.MONITOR_MOE)),
@@ -830,6 +835,11 @@ class MonitorConfig:
             raise DeepSpeedConfigError(
                 "monitor.health_warmup_windows must be >= 0, got "
                 f"{cfg.health_warmup_windows}")
+        if cfg.fleet_exchange_deadline_s < 0:
+            raise DeepSpeedConfigError(
+                "monitor.fleet_exchange_deadline_s must be >= 0 "
+                f"(0 disables the watchdog), got "
+                f"{cfg.fleet_exchange_deadline_s}")
         return cfg
 
 
@@ -1308,9 +1318,31 @@ class PreemptionConfig:
         if grace < 0:
             raise DeepSpeedConfigError(
                 f"resilience.preemption.grace_s must be >= 0, got {grace}")
+        enabled = get_scalar_param(d, C.PREEMPTION_ENABLED,
+                                   C.PREEMPTION_ENABLED_DEFAULT)
+        if enabled and grace > 0:
+            # The grace-deadline forced save runs on a single host's
+            # timer thread; on a multi-process run it would write a
+            # one-host checkpoint while the other hosts are mid-step —
+            # never collective-consistent.  The config used to accept
+            # this silently; fail loudly at parse time instead.
+            try:
+                import jax
+                nproc = jax.process_count()
+            except Exception:  # noqa: BLE001 — no jax at parse time
+                nproc = 1
+            if nproc > 1:
+                raise DeepSpeedConfigError(
+                    "resilience.preemption.grace_s forced saves are "
+                    "single-process only: the grace deadline fires on a "
+                    "per-host timer thread and cannot coordinate a "
+                    f"collective save across {nproc} processes. Set "
+                    "grace_s to 0 on multihost and rely on the "
+                    "step-boundary emergency save (the default "
+                    "preemption path), which stops every host at the "
+                    "same completed step.")
         return PreemptionConfig(
-            enabled=get_scalar_param(d, C.PREEMPTION_ENABLED,
-                                     C.PREEMPTION_ENABLED_DEFAULT),
+            enabled=enabled,
             signals=tuple(signals),
             emergency_tag_prefix=get_scalar_param(
                 d, C.PREEMPTION_EMERGENCY_TAG_PREFIX,
@@ -1373,6 +1405,53 @@ class SentinelConfig:
 
 
 @dataclass
+class ChaosConfig:
+    """Deterministic fault-injection plane (resilience/chaos.py) — off
+    by default.  ``faults`` is a tuple of fault-spec dicts, each
+    validated at parse time against the injection-point catalog: a
+    typo'd point or a kind that makes no sense at that surface fails
+    here, not by silently never firing."""
+    enabled: bool = C.CHAOS_ENABLED_DEFAULT
+    seed: int = C.CHAOS_SEED_DEFAULT
+    faults: tuple = C.CHAOS_FAULTS_DEFAULT
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "ChaosConfig":
+        d = d or {}
+        faults = d.get(C.CHAOS_FAULTS, C.CHAOS_FAULTS_DEFAULT)
+        if isinstance(faults, dict):
+            faults = [faults]
+        try:
+            faults = tuple(faults)
+        except TypeError:
+            raise DeepSpeedConfigError(
+                "resilience.chaos.faults must be a list of fault specs "
+                f"(dicts), got {faults!r}")
+        cfg = ChaosConfig(
+            enabled=bool(get_scalar_param(d, C.CHAOS_ENABLED,
+                                          C.CHAOS_ENABLED_DEFAULT)),
+            seed=int(get_scalar_param(d, C.CHAOS_SEED,
+                                      C.CHAOS_SEED_DEFAULT)),
+            faults=faults,
+        )
+        # validate every spec against the catalog (lazy import: the
+        # chaos module is only needed when the block is present)
+        from .runtime.resilience.chaos import ChaosFault
+        for spec in cfg.faults:
+            if not isinstance(spec, dict):
+                raise DeepSpeedConfigError(
+                    "resilience.chaos.faults entries must be dicts "
+                    f"(point/kind/trigger), got {spec!r}")
+            try:
+                ChaosFault.from_dict(spec)
+            except (ValueError, TypeError) as e:
+                raise DeepSpeedConfigError(
+                    f"resilience.chaos.faults entry {spec!r} is "
+                    f"invalid: {e}")
+        return cfg
+
+
+@dataclass
 class ResilienceConfig:
     """Fault-tolerance block (all off by default — the engine is
     byte-identical to the pre-resilience behavior when disabled, except
@@ -1385,10 +1464,15 @@ class ResilienceConfig:
     keep_every: int = C.RESILIENCE_KEEP_EVERY_DEFAULT
     io_retries: int = C.RESILIENCE_IO_RETRIES_DEFAULT
     io_backoff_seconds: float = C.RESILIENCE_IO_BACKOFF_SECONDS_DEFAULT
+    retry_jitter: float = C.RESILIENCE_RETRY_JITTER_DEFAULT
+    retry_seed: int = C.RESILIENCE_RETRY_SEED_DEFAULT
+    retry_max_backoff_seconds: float = (
+        C.RESILIENCE_RETRY_MAX_BACKOFF_SECONDS_DEFAULT)
     verify_lockstep_on_resume: bool = (
         C.RESILIENCE_VERIFY_LOCKSTEP_ON_RESUME_DEFAULT)
     preemption: PreemptionConfig = field(default_factory=PreemptionConfig)
     sentinel: SentinelConfig = field(default_factory=SentinelConfig)
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
 
     @property
     def atomic_enabled(self) -> bool:
@@ -1431,12 +1515,22 @@ class ResilienceConfig:
             io_backoff_seconds=float(get_scalar_param(
                 d, C.RESILIENCE_IO_BACKOFF_SECONDS,
                 C.RESILIENCE_IO_BACKOFF_SECONDS_DEFAULT)),
+            retry_jitter=float(get_scalar_param(
+                d, C.RESILIENCE_RETRY_JITTER,
+                C.RESILIENCE_RETRY_JITTER_DEFAULT)),
+            retry_seed=int(get_scalar_param(
+                d, C.RESILIENCE_RETRY_SEED,
+                C.RESILIENCE_RETRY_SEED_DEFAULT)),
+            retry_max_backoff_seconds=float(get_scalar_param(
+                d, C.RESILIENCE_RETRY_MAX_BACKOFF_SECONDS,
+                C.RESILIENCE_RETRY_MAX_BACKOFF_SECONDS_DEFAULT)),
             verify_lockstep_on_resume=get_scalar_param(
                 d, C.RESILIENCE_VERIFY_LOCKSTEP_ON_RESUME,
                 C.RESILIENCE_VERIFY_LOCKSTEP_ON_RESUME_DEFAULT),
             preemption=PreemptionConfig.from_dict(
                 d.get(C.RESILIENCE_PREEMPTION)),
             sentinel=SentinelConfig.from_dict(d.get(C.RESILIENCE_SENTINEL)),
+            chaos=ChaosConfig.from_dict(d.get(C.RESILIENCE_CHAOS)),
         )
         if cfg.keep_last_n < 0 or cfg.keep_every < 0:
             raise DeepSpeedConfigError(
@@ -1445,7 +1539,27 @@ class ResilienceConfig:
         if cfg.io_retries < 0:
             raise DeepSpeedConfigError(
                 f"resilience.io_retries must be >= 0, got {cfg.io_retries}")
+        if cfg.retry_jitter < 0:
+            raise DeepSpeedConfigError(
+                f"resilience.retry_jitter must be >= 0, got "
+                f"{cfg.retry_jitter}")
+        if cfg.retry_max_backoff_seconds <= 0:
+            raise DeepSpeedConfigError(
+                "resilience.retry_max_backoff_seconds must be > 0, got "
+                f"{cfg.retry_max_backoff_seconds}")
         return cfg
+
+    def build_retry_policy(self, sleep=None):
+        """The shared RetryPolicy for NVMe swap I/O and checkpoint
+        staging, or None when resilience is off / retries are 0."""
+        if not self.enabled or self.io_retries <= 0:
+            return None
+        from .runtime.resilience.retry import RetryPolicy
+        return RetryPolicy(retries=self.io_retries,
+                           backoff_s=self.io_backoff_seconds,
+                           max_backoff_s=self.retry_max_backoff_seconds,
+                           jitter=self.retry_jitter,
+                           seed=self.retry_seed, sleep=sleep)
 
 
 @dataclass

@@ -383,6 +383,13 @@ MONITOR_DIVERGENCE_REL_SPREAD = "divergence_rel_spread"
 MONITOR_DIVERGENCE_REL_SPREAD_DEFAULT = 1e-3
 MONITOR_HEALTH_WARMUP_WINDOWS = "health_warmup_windows"
 MONITOR_HEALTH_WARMUP_WINDOWS_DEFAULT = 2
+# Exchange deadline watchdog (monitor/fleet.py): the window allgather
+# runs under a timer; on deadline the watchdog names the hosts whose
+# heartbeats went dark and raises ExchangeTimeout (the monitor converts
+# it into the fleet_disabled diagnostic + supervisor eviction events).
+# 0 = off (the allgather may block indefinitely, as before).
+MONITOR_FLEET_EXCHANGE_DEADLINE_S = "fleet_exchange_deadline_s"
+MONITOR_FLEET_EXCHANGE_DEADLINE_S_DEFAULT = 0.0
 # ---- anomaly-triggered deep profiling (monitor/capture.py) ----------- #
 MONITOR_CAPTURE = "capture"
 MONITOR_CAPTURE_ENABLED = "enabled"
@@ -834,6 +841,14 @@ RESILIENCE_IO_RETRIES = "io_retries"
 RESILIENCE_IO_RETRIES_DEFAULT = 3
 RESILIENCE_IO_BACKOFF_SECONDS = "io_backoff_seconds"
 RESILIENCE_IO_BACKOFF_SECONDS_DEFAULT = 0.5
+# RetryPolicy extras (resilience/retry.py): seeded jitter keeps the
+# backoff sequence reproducible; the cap bounds the exponential.
+RESILIENCE_RETRY_JITTER = "retry_jitter"
+RESILIENCE_RETRY_JITTER_DEFAULT = 0.25
+RESILIENCE_RETRY_SEED = "retry_seed"
+RESILIENCE_RETRY_SEED_DEFAULT = 0
+RESILIENCE_RETRY_MAX_BACKOFF_SECONDS = "retry_max_backoff_seconds"
+RESILIENCE_RETRY_MAX_BACKOFF_SECONDS_DEFAULT = 30.0
 # Lockstep-signature re-verify on resume (resilience/reshard.py): a
 # same-topology resume must reproduce the checkpoint's saved collective
 # lockstep signature; a resharded resume re-verifies multihost
@@ -876,6 +891,18 @@ SENTINEL_ANOMALY_BUDGET = "anomaly_budget"  # consecutive anomalies → abort
 SENTINEL_ANOMALY_BUDGET_DEFAULT = 5
 SENTINEL_MONITOR_GRAD_NORM = "monitor_grad_norm"
 SENTINEL_MONITOR_GRAD_NORM_DEFAULT = True
+
+# -- chaos sub-block (resilience/chaos.py) --------------------------- #
+# Seeded deterministic fault injection, off by default.  `faults` is a
+# list of {point, kind, at_call|at_step|after_bytes, repeat, args}
+# specs validated against the injection-point catalog at config time.
+RESILIENCE_CHAOS = "chaos"
+CHAOS_ENABLED = "enabled"
+CHAOS_ENABLED_DEFAULT = False
+CHAOS_SEED = "seed"
+CHAOS_SEED_DEFAULT = 0
+CHAOS_FAULTS = "faults"
+CHAOS_FAULTS_DEFAULT = ()
 
 #############################################
 # Elasticity (reference: deepspeed/elasticity/constants.py)

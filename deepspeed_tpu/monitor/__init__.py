@@ -18,7 +18,8 @@ attribution (health.py), a per-host heartbeat liveness protocol backing
 
 from . import record
 from .capture import TRIGGER_FLAGS, ProfileCapture
-from .fleet import (VEC_FIELDS, FleetAggregator, decode_window_vector,
+from .fleet import (VEC_FIELDS, ExchangeTimeout, FleetAggregator,
+                    decode_window_vector,
                     encode_window_vector, format_fleet_line,
                     summarize_fleet)
 from .health import (FleetHealth, attribute_straggler_lane,
@@ -59,7 +60,8 @@ __all__ = [
     "snapshot_from_record", "summarize_window", "validate_snapshot",
     "FLAG_HBM_ABOVE_BAND", "FLAG_HBM_BELOW_BAND", "FLAG_MODEL_VIOLATION",
     "FLAG_STEP_TIME_ABOVE_BAND", "FLAG_SWAP_BELOW_CEILING",
-    "FleetAggregator", "FleetHealth", "HEARTBEAT_DIR", "HeartbeatWriter",
+    "ExchangeTimeout", "FleetAggregator", "FleetHealth", "HEARTBEAT_DIR",
+    "HeartbeatWriter",
     "JsonlWriter", "KIND_FLEET", "KIND_FLEET_HOST", "KIND_HEALTH",
     "KIND_META", "KIND_RECONCILE", "KIND_STEP",
     "METRICS_CSV", "METRICS_JSONL", "MetricsStream", "MetricsWriter",

@@ -31,6 +31,7 @@ byte matrix.
 """
 
 import math
+import threading
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -118,6 +119,34 @@ def _default_gather(vec: np.ndarray) -> np.ndarray:
     return out
 
 
+class ExchangeTimeout(RuntimeError):
+    """The window allgather missed its deadline.  Carries per-host
+    attribution (``missing``: (process_index, host) pairs whose arrival
+    evidence went dark) and converts into supervisor eviction events via
+    :meth:`as_events` — a hang becomes an evictable, attributed event
+    instead of a wedge."""
+
+    def __init__(self, message: str,
+                 missing: Optional[List[tuple]] = None,
+                 deadline_s: float = 0.0):
+        super().__init__(message)
+        self.missing = list(missing or [])
+        self.deadline_s = float(deadline_s)
+
+    def missing_hosts(self) -> List[str]:
+        return [f"p{p}:{h}" for p, h in self.missing] or ["<unattributed>"]
+
+    def as_events(self) -> List[Dict[str, Any]]:
+        """EVENT_DEAD-shaped dicts for SupervisorPolicy.observe_window —
+        the watchdog's output feeds the existing eviction pathway."""
+        detail = str(self)
+        if not self.missing:
+            return [{"event": "dead_worker", "process_index": None,
+                     "host": None, "detail": detail}]
+        return [{"event": "dead_worker", "process_index": p, "host": h,
+                 "detail": detail} for p, h in self.missing]
+
+
 class FleetAggregator:
     """Window-boundary fleet exchange + record assembly.
 
@@ -125,19 +154,32 @@ class FleetAggregator:
     synthetic multi-host matrices (the fake-fleet harness) without a
     real distributed world.  With ``process_count == 1`` the exchange is
     a local stack — single-host runs emit the degenerate 1-host fleet
-    records, so the record shape downstream tooling sees is identical."""
+    records, so the record shape downstream tooling sees is identical.
+
+    ``deadline_s > 0`` arms the exchange watchdog: the (blocking)
+    allgather runs on a daemon thread under a timer, and on deadline an
+    :class:`ExchangeTimeout` is raised naming the hosts whose arrival
+    evidence (``arrival_fn``: process_index -> seconds since last seen,
+    usually heartbeat file ages) exceeds the deadline.  Without a
+    deadline the allgather may block forever, exactly as before."""
 
     def __init__(self, process_index: int = 0, process_count: int = 1,
                  host: Optional[str] = None,
                  gather_fn: Optional[Callable[[np.ndarray],
-                                              np.ndarray]] = None):
+                                              np.ndarray]] = None,
+                 deadline_s: float = 0.0,
+                 arrival_fn: Optional[Callable[[], Dict[int, float]]]
+                 = None):
         self.process_index = int(process_index)
         self.process_count = int(process_count)
         ident = R.identity(process_index=process_index,
                            world_size=process_count, host=host)
         self.host = ident[R.F_HOST]
         self._gather = gather_fn
+        self.deadline_s = float(deadline_s)
+        self._arrival_fn = arrival_fn
         self.exchanges = 0
+        self.timeouts = 0
         self._hosts: Optional[List[str]] = None
 
     # ------------------------------------------------------------------ #
@@ -159,11 +201,74 @@ class FleetAggregator:
                 self.process_count = len(self._hosts)
         return self._hosts
 
+    def _missing_hosts(self) -> List[tuple]:
+        """Per-host arrival accounting at timeout: every peer whose last
+        evidence of life is older than the deadline gets named."""
+        hosts = self._hosts or []
+        if self._arrival_fn is None:
+            return []
+        try:
+            ages = self._arrival_fn() or {}
+        except Exception:  # noqa: BLE001 — attribution is best-effort
+            return []
+        out = []
+        for p in range(self.process_count):
+            if p == self.process_index:
+                continue
+            age = ages.get(p)
+            if age is None or age > self.deadline_s:
+                name = hosts[p] if p < len(hosts) else f"p{p}"
+                out.append((p, name))
+        return out
+
+    def _gather_window(self, vec: np.ndarray) -> np.ndarray:
+        """The exchange work itself, chaos surface included — a hang
+        fault sleeps INSIDE here, so the watchdog deadline catches it
+        exactly like a genuinely wedged collective."""
+        try:
+            from ..runtime.resilience import chaos
+        except Exception:  # pragma: no cover — partial install
+            chaos = None
+        if chaos is not None:
+            chaos.maybe_fire(chaos.POINT_FLEET_EXCHANGE)
+        return self._do_gather(vec)
+
+    def _gather_under_deadline(self, vec: np.ndarray) -> np.ndarray:
+        box: Dict[str, Any] = {}
+
+        def work():
+            try:
+                box["mat"] = self._gather_window(vec)
+            except BaseException as e:  # noqa: BLE001 — rethrown below
+                box["exc"] = e
+
+        t = threading.Thread(target=work, name="fleet-exchange",
+                             daemon=True)
+        t.start()
+        t.join(self.deadline_s)
+        if t.is_alive():
+            self.timeouts += 1
+            missing = self._missing_hosts()
+            names = ", ".join(f"p{p}:{h}" for p, h in missing) \
+                or "<no per-host arrival evidence — enable " \
+                   "monitor.heartbeat for attribution>"
+            raise ExchangeTimeout(
+                f"fleet exchange missed its {self.deadline_s:.1f}s "
+                f"deadline (window {self.exchanges + 1}); missing hosts: "
+                f"{names}", missing=missing, deadline_s=self.deadline_s)
+        if "exc" in box:
+            raise box["exc"]
+        return box["mat"]
+
     def exchange(self, summary: Dict[str, Any]) -> np.ndarray:
         """One flush window's collective: encode, allgather, return the
         [P, VEC_LEN] matrix (every process gets the full fleet view)."""
         self.host_names()  # resolve labels before the first window
-        mat = self._do_gather(encode_window_vector(summary))
+        vec = encode_window_vector(summary)
+        if self.deadline_s > 0:
+            mat = self._gather_under_deadline(vec)
+        else:
+            mat = self._gather_window(vec)
         self.exchanges += 1
         if mat.shape != (self.process_count, VEC_LEN):
             raise ValueError(

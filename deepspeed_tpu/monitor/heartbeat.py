@@ -88,6 +88,20 @@ class HeartbeatWriter:
     def beat(self, step: Optional[int] = None,
              status: str = STATUS_RUNNING,
              extra: Optional[Dict[str, Any]] = None) -> None:
+        fault = self._chaos_fire()
+        if fault is not None:
+            if fault.kind == "stale":
+                return  # beat silently skipped: the file goes stale
+            if fault.kind == "corrupt":
+                # torn/garbage write-back: readers must surface this as
+                # a "corrupt" row, never crash on it
+                try:
+                    os.makedirs(self.directory, exist_ok=True)
+                    with open(self.path, "w") as f:
+                        f.write('{"host": "')
+                except OSError:
+                    pass
+                return
         now = time.time()
         payload = {
             "host": self.host,
@@ -120,6 +134,16 @@ class HeartbeatWriter:
                 from ..utils.logging import logger
                 logger.warning(f"monitor: heartbeat write failed ({e}) — "
                                "further heartbeat errors suppressed")
+
+    @staticmethod
+    def _chaos_fire():
+        """Chaos hook at the liveness surface (guarded import: this
+        module must stay importable by the jax-free watch controller)."""
+        try:
+            from ..runtime.resilience import chaos
+        except Exception:  # pragma: no cover — partial install
+            return None
+        return chaos.maybe_fire(chaos.POINT_HEARTBEAT)
 
     def close(self, step: Optional[int] = None) -> None:
         self.beat(step=step, status=STATUS_STOPPED)

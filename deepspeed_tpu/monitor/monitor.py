@@ -80,7 +80,9 @@ class MetricsStream:
                  assemble_records: bool = True,
                  moe_stats_fn: Optional[Callable[[],
                                                  Optional[dict]]] = None,
-                 moe_hook: Optional[Callable] = None):
+                 moe_hook: Optional[Callable] = None,
+                 extra_records_fn: Optional[Callable[[],
+                                                     List[dict]]] = None):
         self.window = max(1, int(window))
         self._sink = sink
         self._boundary_fn = boundary_fn
@@ -109,6 +111,13 @@ class MetricsStream:
         # may exit at different times and a collective there would hang
         # the survivors.
         self._window_hook = window_hook
+        # drained at each flush: out-of-band resilience records (fired
+        # chaos faults, degradation-registry events) ride the stream at
+        # boundary cadence — no hot-loop work, no new host reads
+        self._extra_records_fn = extra_records_fn
+        # set when the window hook died on an ExchangeTimeout: the
+        # supervisor harness reads the attributed timeout from here
+        self.last_exchange_timeout = None
         self._pending: List[dict] = []
         self._t_prev: Optional[float] = None
         self._t_start: Optional[float] = None      # first forward this step
@@ -259,6 +268,11 @@ class MetricsStream:
             if rec is not None and self._assemble_records:
                 records.append(rec)
         records.extend(moe_records)
+        if self._extra_records_fn is not None and self._assemble_records:
+            try:
+                records.extend(self._extra_records_fn() or [])
+            except Exception as e:  # noqa: BLE001 — telemetry only
+                logger.warning(f"monitor: extra-records hook failed ({e})")
         for rec in records:
             for k, v in self._identity.items():
                 rec.setdefault(k, v)
@@ -313,10 +327,24 @@ class MetricsStream:
                     f"monitor: fleet window hook failed ({e}) — fleet "
                     "aggregation DISABLED on this host for the rest of "
                     "the run")
-                extra = [{R.F_KIND: R.KIND_META,
-                          "fleet_disabled": str(e)[:200],
-                          **self._identity}] if self._assemble_records \
-                    else None
+                try:
+                    from ..runtime.resilience import degradation
+                    degradation.record(
+                        "fleet_monitor", "aggregating", "disabled",
+                        str(e)[:200])
+                except Exception:  # noqa: BLE001 — partial install
+                    pass
+                meta = {R.F_KIND: R.KIND_META,
+                        "fleet_disabled": str(e)[:200],
+                        **self._identity}
+                from .fleet import ExchangeTimeout
+                if isinstance(e, ExchangeTimeout):
+                    # the watchdog attributed the wedge: name the hosts
+                    # in the stream so the supervisor/operator can evict
+                    # the right workers, not guess
+                    meta["missing_hosts"] = e.missing_hosts()
+                    self.last_exchange_timeout = e
+                extra = [meta] if self._assemble_records else None
             if extra:
                 records.extend(extra)
         self.records_emitted += len(records)
@@ -347,7 +375,9 @@ class TrainingMonitor:
                  host: Optional[str] = None,
                  gather_fn: Optional[Callable] = None,
                  health_sink: Optional[Callable[[dict], None]] = None,
-                 profiler: Any = None):
+                 profiler: Any = None,
+                 extra_records_fn: Optional[Callable[[],
+                                                     List[dict]]] = None):
         self.cfg = cfg
         self.out_dir = os.path.join(cfg.output_path, cfg.job_name or "")
         self.predictions = predictions
@@ -400,7 +430,9 @@ class TrainingMonitor:
             self.fleet = FleetAggregator(
                 process_index=self.process_index,
                 process_count=self.world_size,
-                host=self.identity[R.F_HOST], gather_fn=gather_fn)
+                host=self.identity[R.F_HOST], gather_fn=gather_fn,
+                deadline_s=getattr(cfg, "fleet_exchange_deadline_s", 0.0),
+                arrival_fn=self._heartbeat_ages)
             moe_knobs = {}
             if getattr(cfg, "moe", None) is not None:
                 moe_knobs = dict(
@@ -465,6 +497,7 @@ class TrainingMonitor:
                           else None),
             moe_hook=(self._moe_window if self.moe_agg is not None
                       else None),
+            extra_records_fn=extra_records_fn,
             # non-emitter ranks have no writers: skip record assembly
             # and the records-only boundary reads on them
             assemble_records=self.is_emitter)
@@ -591,6 +624,30 @@ class TrainingMonitor:
                      "imbalance": rec.get(R.M_IMBALANCE)})
             log_dist(format_moe_line(rec), ranks=[0])
         return rec, fields
+
+    def _heartbeat_ages(self) -> Dict[int, float]:
+        """Per-host arrival evidence for the exchange watchdog: seconds
+        since each peer's heartbeat file last moved.  File mtimes (not
+        payload timestamps) so a corrupt-but-moving file still counts as
+        alive; hosts with no file at all simply have no entry — the
+        watchdog treats absence as missing."""
+        hb_dir = os.path.join(self.out_dir, HEARTBEAT_DIR)
+        ages: Dict[int, float] = {}
+        try:
+            names = os.listdir(hb_dir)
+        except OSError:
+            return ages
+        now = time.time()
+        for name in names:
+            if not (name.startswith("hb_") and name.endswith(".json")):
+                continue
+            try:
+                pidx = int(name[len("hb_"):-len(".json")])
+                mtime = os.path.getmtime(os.path.join(hb_dir, name))
+            except (ValueError, OSError):
+                continue
+            ages[pidx] = max(0.0, now - mtime)
+        return ages
 
     def _fleet_window(self, summary: Dict[str, Any]) -> List[dict]:
         """FULL-window hook: one fixed-shape allgather of this host's

@@ -39,6 +39,11 @@ KIND_HEALTH = "health"
 # summarizing the device-resident RoutingStats accumulator — expert
 # popularity, drop/overflow accounting, router entropy/confidence
 KIND_MOE = "moe"
+# resilience plane (runtime/resilience): a fired chaos-injected fault
+# (post-mortems separate injected from organic failures) and a fallback-
+# ladder step-down from the degradation registry
+KIND_CHAOS = "chaos"
+KIND_DEGRADATION = "degradation"
 
 # ---- per-step field names (the schema) ------------------------------- #
 F_KIND = "kind"
@@ -56,6 +61,9 @@ F_SENTINEL_ANOMALIES = "sentinel_anomalies"
 F_SENTINEL_SKIPS = "sentinel_skips"
 F_RETRACES = "retraces"
 F_DISPATCHES_PER_STEP = "dispatches_per_step"
+# cumulative transient-I/O retries absorbed by the RetryPolicy
+# (resilience/retry.py) — nonzero means the run rode out real faults
+F_IO_RETRIES = "io_retries"
 F_SWAP_READ_GBPS = "swap_read_gbps"
 F_SWAP_OVERLAP_FRACTION = "swap_overlap_fraction"
 F_SWAP_READ_VS_CEILING = "swap_read_vs_ceiling"
@@ -79,6 +87,9 @@ STEP_RECORD_FIELDS = (
     F_DISPATCHES_PER_STEP,
     F_SWAP_READ_GBPS, F_SWAP_OVERLAP_FRACTION, F_SWAP_READ_VS_CEILING,
     F_HOST_GAP_S, F_HOST, F_PROCESS_INDEX, F_WORLD_SIZE,
+    # appended after the released v2 set (position-readers keep their
+    # shared prefix): retry counters ride every step record
+    F_IO_RETRIES,
 )
 
 # ---- fleet field names (fleet.py / health.py payloads) --------------- #
@@ -231,7 +242,7 @@ def make_step_record(step: int, loss: Optional[float], wall_s: float,
     rec[F_LOSS_SCALE] = boundary.get("loss_scale")
     rec.update(memory)
     for k in (F_SKIPPED_STEPS, F_SENTINEL_ANOMALIES, F_SENTINEL_SKIPS,
-              F_RETRACES, F_DISPATCHES_PER_STEP):
+              F_RETRACES, F_DISPATCHES_PER_STEP, F_IO_RETRIES):
         rec[k] = counters.get(k)
     if swap:
         rec[F_SWAP_READ_GBPS] = swap.get("read_gbps")

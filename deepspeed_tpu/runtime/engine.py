@@ -278,6 +278,13 @@ class DeepSpeedEngine:
         # ---- resilience (all off by default; see docs/resilience.md) - #
         res = self.config.resilience_config
         self.resilience = res
+        # chaos plane: installed process-globally (chaos.install) because
+        # the subsystems that fire faults — atomic checkpoint functions,
+        # aio handles, heartbeat writers — hold no engine reference
+        if res.chaos.enabled:
+            from .resilience.chaos import ChaosPlane, install
+            install(ChaosPlane.from_config(res.chaos))
+        self._retry_policy = res.build_retry_policy()
         self.sentinel = None
         if res.sentinel.enabled:
             from .resilience.sentinel import TrainingSentinel
@@ -510,6 +517,11 @@ class DeepSpeedEngine:
             f"mesh={dict(self.mesh_ctx.mesh.shape)} "
             f"micro_batch={self.train_micro_batch_size_per_gpu()} "
             f"gas={self.gradient_accumulation_steps()}", ranks=[0])
+        from .resilience.degradation import get_registry
+        degraded = get_registry().summary()
+        if degraded:
+            log_dist(f"DeepSpeedEngine: degraded tiers: {degraded}",
+                     ranks=[0])
 
     # ------------------------------------------------------------------ #
     # configuration accessors (reference: engine.py:260-540)
@@ -687,6 +699,8 @@ class DeepSpeedEngine:
             # metrics degrade, engine init must not crash (old contract)
             errors.append(f"jsonl fallback: {e}")
             logger.warning("tensorboard unavailable: " + "; ".join(errors))
+            from .resilience.degradation import record as degrade
+            degrade("tensorboard", "torch", "disabled", "; ".join(errors))
             return None
         # name the REAL failures (a broken-protobuf torch is not the same
         # problem as an absent torch) so the operator debugs the right one
@@ -694,6 +708,8 @@ class DeepSpeedEngine:
             "tensorboard requested but no SummaryWriter backend worked "
             f"({'; '.join(errors)}) — scalars will be written as JSONL "
             f"to {writer.path} instead")
+        from .resilience.degradation import record as degrade
+        degrade("tensorboard", "torch", "jsonl", "; ".join(errors))
         return writer
 
     # ------------------------------------------------------------------ #
@@ -1440,6 +1456,8 @@ class DeepSpeedEngine:
             kwargs = dict(kwargs)
             kwargs["pld_theta"] = jnp.float32(
                 self.progressive_layer_drop.get_theta())
+        if self._is_train_mode:
+            args, kwargs = self._chaos_batch(args, kwargs)
         self._observe_retrace((args, kwargs))
         if self.monitor is not None:
             self._monitor_note_batch((args, kwargs))
@@ -1590,6 +1608,7 @@ class DeepSpeedEngine:
         self._grad_acc = None
         self._last_overflow = overflow
         self.global_steps += 1
+        self._chaos_step_boundary()
         if self._moe_stats_enabled:
             self._moe_stats_steps += 1
         if self.progressive_layer_drop is not None:
@@ -1733,6 +1752,9 @@ class DeepSpeedEngine:
             # own loss/grad-norm anomalies (docs/resilience.md)
             health_sink=(self.sentinel.record_health_event
                          if self.sentinel is not None else None),
+            # boundary-cadence drain of chaos fired-fault log and the
+            # degradation registry into the record stream
+            extra_records_fn=self._drain_resilience_records,
             meta={"engine": type(self).__name__,
                   "zero_stage": self.config.zero_optimization_stage,
                   "dtype": str(self.compute_dtype.__name__),
@@ -1752,6 +1774,40 @@ class DeepSpeedEngine:
             out["loss_scale"] = None
         return out
 
+    def _chaos_batch(self, args, kwargs):
+        """batch.next chaos surface: a fired poison fault corrupts the
+        host batch (NaN by default, or a huge finite spike via
+        args.value) BEFORE sharding — exactly where a broken data
+        loader would.  The sentinel is the intended detection path."""
+        from .resilience import chaos
+        fault = chaos.maybe_fire(chaos.POINT_BATCH,
+                                 step=self.global_steps + 1)
+        if fault is not None and fault.kind == chaos.KIND_POISON:
+            value = float(fault.args.get("value", float("nan")))
+            args, kwargs = chaos.poison_batch((args, kwargs), value=value)
+        return args, kwargs
+
+    def _chaos_step_boundary(self) -> None:
+        """step.boundary chaos surface (sigterm / crash at step N),
+        fired AFTER global_steps advances so ``at_step: N`` means "the
+        boundary right after step N completed" — the same boundary the
+        preemption handler and emergency save key off."""
+        from .resilience import chaos
+        chaos.maybe_fire(chaos.POINT_STEP, step=self.global_steps)
+
+    def _drain_resilience_records(self):
+        """Boundary-cadence drain: the chaos plane's fired-fault log
+        and the degradation registry both ride the monitor stream as
+        structured meta records (docs/resilience.md)."""
+        from .resilience import chaos
+        from .resilience.degradation import get_registry
+        records = []
+        plane = chaos.active()
+        if plane is not None:
+            records.extend(plane.drain_records())
+        records.extend(get_registry().drain_records())
+        return records
+
     def _monitor_counters(self) -> Dict[str, Any]:
         """Host-side integers only — free to copy every step."""
         from ..monitor import record as mrec
@@ -1764,6 +1820,9 @@ class DeepSpeedEngine:
         if self._recompile_guard is not None:
             counters[mrec.F_RETRACES] = (
                 self._recompile_guard.counters().get("retraces_seen"))
+        if self._retry_policy is not None:
+            counters[mrec.F_IO_RETRIES] = self._retry_policy.counters[
+                "retries"]
         return counters
 
     # ------------------------------------------------------------------ #
@@ -2532,6 +2591,10 @@ class DeepSpeedEngine:
             if self._recompile_guard is not None:
                 audit.update(self._recompile_guard.counters())
             client["program_audit"] = audit
+        if self._retry_policy is not None:
+            # I/O retry tally rides client state like the sentinel and
+            # audit counters: a resumed run keeps its retry history
+            client["retry_counters"] = self._retry_policy.snapshot()
         res = self.resilience
         atomic = res.atomic_enabled
         if atomic and jax.process_count() > 1 and \
@@ -2546,13 +2609,25 @@ class DeepSpeedEngine:
                 "multi-process consolidated checkpoints — saving with the "
                 "legacy in-place layout (set checkpoint.sharded=true for "
                 "atomic multi-process saves)")
+            from .resilience.degradation import record as degrade
+            degrade("checkpoint", "atomic", "in_place",
+                    "multi-process consolidated layout cannot stage "
+                    "atomic commits")
             atomic = False
 
         def run_io(fn, what):
-            if not res.enabled:
+            from .resilience import chaos
+
+            def attempt():
+                chaos.maybe_fire(chaos.POINT_CKPT_STAGE,
+                                 step=self.global_steps)
                 return fn()
+            if not res.enabled:
+                return attempt()
+            if self._retry_policy is not None:
+                return self._retry_policy.run(attempt, what=what)
             from .resilience.atomic import retry_io
-            return retry_io(fn, retries=res.io_retries,
+            return retry_io(attempt, retries=res.io_retries,
                             backoff_seconds=res.io_backoff_seconds,
                             what=what)
 
@@ -2726,6 +2801,9 @@ class DeepSpeedEngine:
                 # training run" across a resume (mirrors the sentinel
                 # counter round-trip)
                 self._recompile_guard.load_counters(client["program_audit"])
+            if self._retry_policy is not None and client.get(
+                    "retry_counters"):
+                self._retry_policy.restore(client["retry_counters"])
             if self.quantizer is not None and client.get("quantizer"):
                 self.quantizer.load_state_dict(client["quantizer"])
             if self.curriculum_scheduler is not None and client.get(

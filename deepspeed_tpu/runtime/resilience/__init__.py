@@ -11,25 +11,32 @@ from .atomic import (cleanup_tmp_dirs, commit_tag_dir, file_crc32,
                      has_manifest, is_tmp_dir, is_working_dir, retry_io,
                      tmp_tag_dir, verify_manifest, write_latest_atomic,
                      write_manifest, MANIFEST_FILE)
+from .chaos import (ChaosFault, ChaosPlane, InjectedCrash, InjectedFault,
+                    crash_after_bytes, measure_save_bytes, poison_batch)
+from .degradation import DegradationEvent, DegradationRegistry
 from .preemption import PreemptionHandler, TrainingInterrupted
 from .recovery import (gc_checkpoints, list_tags, rescue_renamed_aside,
                        resolve_intact_tag, tag_problems, tag_step)
 from .reshard import (LockstepResumeError, ReshardError, check_reshard,
                       read_saved_client_state, verify_lockstep_resume)
+from .retry import CorruptionError, RetryPolicy, is_transient
 from .sentinel import SentinelAbort, TrainingSentinel
 from .supervisor import (CycleResult, FleetDecision, FleetSupervisor,
                          ResumePlan, SupervisorPolicy, choose_world_size,
                          plan_resume)
 
 __all__ = [
-    "CycleResult", "FleetDecision", "FleetSupervisor",
+    "ChaosFault", "ChaosPlane", "CorruptionError", "CycleResult",
+    "DegradationEvent", "DegradationRegistry", "FleetDecision",
+    "FleetSupervisor", "InjectedCrash", "InjectedFault",
     "LockstepResumeError", "MANIFEST_FILE", "PreemptionHandler",
-    "ReshardError", "ResumePlan", "SentinelAbort", "SupervisorPolicy",
-    "TrainingInterrupted", "TrainingSentinel", "check_reshard",
-    "choose_world_size", "cleanup_tmp_dirs", "commit_tag_dir",
-    "file_crc32", "gc_checkpoints", "has_manifest", "is_tmp_dir",
-    "is_working_dir", "list_tags", "plan_resume",
-    "read_saved_client_state", "rescue_renamed_aside",
+    "ReshardError", "ResumePlan", "RetryPolicy", "SentinelAbort",
+    "SupervisorPolicy", "TrainingInterrupted", "TrainingSentinel",
+    "check_reshard", "choose_world_size", "cleanup_tmp_dirs",
+    "commit_tag_dir", "crash_after_bytes", "file_crc32",
+    "gc_checkpoints", "has_manifest", "is_tmp_dir", "is_transient",
+    "is_working_dir", "list_tags", "measure_save_bytes", "plan_resume",
+    "poison_batch", "read_saved_client_state", "rescue_renamed_aside",
     "resolve_intact_tag", "retry_io", "tag_problems", "tag_step",
     "tmp_tag_dir", "verify_lockstep_resume", "verify_manifest",
     "write_latest_atomic", "write_manifest",

@@ -86,6 +86,8 @@ def write_manifest(ckpt_dir: str) -> str:
 
     Written last inside the tmp dir, so a manifest's presence implies the
     listed files were completely written before it."""
+    from . import chaos
+    fault = chaos.maybe_fire(chaos.POINT_CKPT_MANIFEST)  # enospc raises
     entries: Dict[str, Dict] = {}
     for name in sorted(os.listdir(ckpt_dir)):
         path = os.path.join(ckpt_dir, name)
@@ -99,6 +101,12 @@ def write_manifest(ckpt_dir: str) -> str:
         json.dump({"version": 1, "files": entries}, f, indent=0)
         f.flush()
         os.fsync(f.fileno())
+    if fault is not None and fault.kind == chaos.KIND_TORN_MANIFEST:
+        # simulate a torn write-back: the manifest loses its tail, so a
+        # verify must flag the tag instead of trusting half a file list
+        size = os.path.getsize(manifest_path)
+        with open(manifest_path, "r+b") as f:
+            f.truncate(max(1, size // 2))
     return manifest_path
 
 
@@ -156,6 +164,13 @@ def commit_tag_dir(save_dir: str, tag: str, tmp_dir: str) -> str:
     final_dir = os.path.join(save_dir, str(tag))
     write_manifest(tmp_dir)
     fsync_dir(tmp_dir)
+    # the crash-between-stage-and-rename window: everything is staged
+    # and durable under the .tmp. name, nothing is promoted yet — a
+    # crash fault here leaves exactly the partial state a real process
+    # death leaves (cleanup_tmp_dirs sweeps it; `latest` still points
+    # at the previous intact tag)
+    from . import chaos
+    chaos.maybe_fire(chaos.POINT_CKPT_COMMIT)
     old_dir = None
     if os.path.isdir(final_dir):
         old_dir = f"{final_dir}{OLD_MARKER}{uuid.uuid4().hex[:8]}"

@@ -1,130 +1,19 @@
-"""Deterministic fault injection for resilience tests.
+"""Deprecated shim — the injectors moved into the chaos plane.
 
-``crash_after_bytes(n)`` patches ``open`` (both ``builtins.open`` and
-``io.open`` — zipfile/np.savez go through the latter) so that, after `n`
-bytes have been written to files under the scoped path, the next write
-raises ``InjectedCrash``.  Sweeping `n` across a save's total write
-volume simulates a ``kill -9`` landing between any two file writes:
-the exception propagates out of the save like a process death would,
-leaving exactly the partial on-disk state a real crash leaves.
-
-``poison_batch`` is the forced-NaN hook for sentinel tests: under jit a
-host-side step counter cannot fire inside the compiled loss (the trace
-runs once), so the deterministic way to force a NaN loss on step k is to
-poison step k's *input batch* — NaN propagates through the model to the
-loss and gradients exactly as a real data glitch would.
+``crash_after_bytes``/``measure_save_bytes`` (the crash-after-N-bytes
+``open()`` wrapper), ``poison_batch`` and ``InjectedCrash`` now live in
+:mod:`deepspeed_tpu.runtime.resilience.chaos`, the single config-driven
+fault-injection mechanism.  This module re-exports them so existing
+call sites (tests, scripts) keep working; new code should import from
+``chaos`` directly — there is one injection mechanism, not two.
 """
 
-import builtins
-import io
-import os
-from typing import Optional
+from .chaos import (  # noqa: F401 — re-exports
+    InjectedCrash,
+    crash_after_bytes,
+    measure_save_bytes,
+    poison_batch,
+)
 
-import numpy as np
-
-
-class InjectedCrash(RuntimeError):
-    """Simulated mid-save process death (deliberately NOT an OSError so
-    the resilience retry wrapper does not absorb it)."""
-
-
-class _CountingFile:
-    def __init__(self, f, injector):
-        self._f = f
-        self._injector = injector
-
-    def write(self, data):
-        if self._injector.crashed:
-            # the simulated process is dead: later writes (e.g. zipfile
-            # finalizers unwinding) go nowhere instead of re-raising
-            return len(data)
-        self._injector.charge(len(data))
-        return self._f.write(data)
-
-    def writelines(self, lines):
-        for line in lines:
-            self.write(line)
-
-    def __getattr__(self, name):
-        return getattr(self._f, name)
-
-    def __enter__(self):
-        self._f.__enter__()
-        return self
-
-    def __exit__(self, *exc):
-        return self._f.__exit__(*exc)
-
-    def __iter__(self):
-        return iter(self._f)
-
-
-class crash_after_bytes:
-    """Context manager: writes under `path_prefix` crash once `nbytes`
-    have been written.  `bytes_written` after a clean exit reports the
-    total write volume — sweep budgets in [0, total) to cover every
-    inter-write crash point."""
-
-    def __init__(self, nbytes: float, path_prefix: Optional[str] = None):
-        self.budget = nbytes
-        self.prefix = (os.path.abspath(path_prefix)
-                       if path_prefix is not None else None)
-        self.bytes_written = 0
-        self.crashed = False
-        self._real_open = None
-
-    def charge(self, n: int) -> None:
-        if self.bytes_written + n > self.budget:
-            self.crashed = True
-            raise InjectedCrash(
-                f"injected crash after {self.bytes_written} bytes "
-                f"(budget {self.budget}, next write {n})")
-        self.bytes_written += n
-
-    def _in_scope(self, file, mode: str) -> bool:
-        if not any(m in mode for m in ("w", "a", "x", "+")):
-            return False
-        if not isinstance(file, (str, bytes, os.PathLike)):
-            return False
-        path = os.path.abspath(os.fsdecode(file))
-        return self.prefix is None or path.startswith(self.prefix)
-
-    def __enter__(self) -> "crash_after_bytes":
-        self._real_open = builtins.open
-
-        def opener(file, mode="r", *args, **kwargs):
-            f = self._real_open(file, mode, *args, **kwargs)
-            if self._in_scope(file, mode):
-                return _CountingFile(f, self)
-            return f
-
-        builtins.open = opener
-        io.open = opener  # np.savez/zipfile resolve io.open at call time
-        return self
-
-    def __exit__(self, *exc):
-        builtins.open = self._real_open
-        io.open = self._real_open
-        return False
-
-
-def measure_save_bytes(save_fn, path_prefix: Optional[str] = None) -> int:
-    """Run `save_fn()` under an unlimited counter; returns total bytes
-    written — the sweep range for crash_after_bytes."""
-    with crash_after_bytes(float("inf"), path_prefix) as counter:
-        save_fn()
-    return counter.bytes_written
-
-
-def poison_batch(batch, value: float = float("nan")):
-    """Return `batch` with every float array replaced by `value` — the
-    deterministic forced-NaN (or Inf/spike) loss hook."""
-
-    def poison(x):
-        arr = np.asarray(x)
-        if np.issubdtype(arr.dtype, np.floating):
-            return np.full_like(arr, value)
-        return x
-
-    import jax
-    return jax.tree.map(poison, batch)
+__all__ = ["InjectedCrash", "crash_after_bytes", "measure_save_bytes",
+           "poison_batch"]

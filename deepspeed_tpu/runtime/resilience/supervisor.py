@@ -226,6 +226,13 @@ class SupervisorPolicy:
     def observe_dead(self, worker: Any) -> None:
         self._pending_dead.add(worker)
 
+    def observe_exchange_timeout(self, timeout) -> None:
+        """A fleet-exchange deadline miss (monitor/fleet.py
+        ExchangeTimeout): the named missing hosts enter the eviction
+        pathway as dead workers — a hang is an attributed, evictable
+        event, not a wedge."""
+        self.observe_window(timeout.as_events())
+
     def readmit(self, worker: Any) -> None:
         self.evicted.discard(worker)
         self._strikes.pop(worker, None)

@@ -39,6 +39,25 @@ _BACKEND_IDS = {AIO_BACKEND_THREADPOOL: 0,
 _URING_FALLBACK_WARNED = False
 
 
+def _chaos_fire(point):
+    """Chaos-plane hook at the real AIO failure surface.  Import is
+    guarded (this module must stay loadable standalone); a fired raising
+    fault propagates like the native engine's own -EIO would."""
+    try:
+        from ..resilience import chaos as _chaos
+    except Exception:  # pragma: no cover — partial install
+        return None
+    return _chaos.maybe_fire(point)
+
+
+def _degraded(from_tier, to_tier, reason):
+    try:
+        from ..resilience import degradation as _deg
+    except Exception:  # pragma: no cover — partial install
+        return
+    _deg.record("aio", from_tier, to_tier, reason)
+
+
 def get_aio_lib():
     global _LIB, _TRIED
     if not _TRIED:
@@ -101,6 +120,8 @@ def resolve_backend(backend: str = AIO_BACKEND_AUTO) -> str:
                 "policy that allows it) — falling back to the batched-"
                 "submission pool.  Expect the aio_sweep 'batched' ceiling, "
                 "not the io_uring one.")
+        _degraded(AIO_BACKEND_IO_URING, AIO_BACKEND_BATCHED,
+                  "io_uring probe failed on this kernel/sandbox")
         return AIO_BACKEND_BATCHED
     return backend
 
@@ -144,6 +165,8 @@ class AsyncIOHandle:
                 # probe raced a policy change — same loud fallback
                 logger.warning("io_uring engine creation failed after a "
                                "successful probe; using the batched pool")
+                _degraded(AIO_BACKEND_IO_URING, AIO_BACKEND_BATCHED,
+                          "engine creation failed after a successful probe")
                 resolved = AIO_BACKEND_BATCHED
                 self._handle = self._lib.ds_aio_create2(
                     block_size, queue_depth, int(single_submit),
@@ -151,6 +174,12 @@ class AsyncIOHandle:
                     _BACKEND_IDS[resolved])
             if self._handle is not None:
                 self.backend = resolved
+        if self._handle is None:
+            # synchronous Python file I/O — the bottom of the ladder
+            _degraded(str(backend), "python",
+                      "native async_io engine unavailable "
+                      "(AsyncIOBuilder load failed or handle creation "
+                      "returned NULL)")
 
     @property
     def using_native(self) -> bool:
@@ -182,6 +211,7 @@ class AsyncIOHandle:
         keep `buffer` alive until wait() — the engine reads/writes the raw
         pointer (same contract as the reference's pinned bounce buffers)."""
         self._check_buffer(buffer, "pread")
+        _chaos_fire("aio.pread")  # injected EIO/short-read/latency
         nbytes = buffer.nbytes
         if self._handle is not None:
             rc = self._lib.ds_aio_pread(
@@ -205,6 +235,7 @@ class AsyncIOHandle:
     def pwrite(self, buffer: np.ndarray, path: str,
                async_op: bool = False) -> None:
         self._check_buffer(buffer, "pwrite")
+        _chaos_fire("aio.pwrite")  # injected EIO/ENOSPC/latency
         if self._handle is not None:
             rc = self._lib.ds_aio_pwrite(
                 self._handle, buffer.ctypes.data_as(ctypes.c_void_p),

@@ -118,9 +118,13 @@ class PartitionedParamSwapper:
     """
 
     def __init__(self, swap_dir: str, groups: Dict[str, Any],
-                 buffer_count: int = 4, aio_config=None):
+                 buffer_count: int = 4, aio_config=None,
+                 retry_policy=None):
         os.makedirs(swap_dir, exist_ok=True)
         self.swap_dir = swap_dir
+        # transient-EIO/ENOSPC retry around the swap I/O submissions
+        # (resilience/retry.py); None = fail on first error, as before
+        self.retry_policy = retry_policy
         self.groups = {name: _Group(name, tree)
                        for name, tree in groups.items()}
         kw = handle_kwargs(aio_config)
@@ -159,6 +163,14 @@ class PartitionedParamSwapper:
     # ------------------------------------------------------------------ #
     def _path(self, name: str) -> str:
         return os.path.join(self.swap_dir, f"param_group_{name}.bin")
+
+    def _io(self, fn, what: str):
+        """Run one I/O submission under the retry policy (when set).
+        Retry is safe here: pread/pwrite submissions are idempotent —
+        re-reading a file or re-writing the same buffer converges."""
+        if self.retry_policy is None:
+            return fn()
+        return self.retry_policy.run(fn, what=what)
 
     @property
     def resident_groups(self) -> List[str]:
@@ -211,7 +223,8 @@ class PartitionedParamSwapper:
         self._inflight_writes.append(flat)
         self._write_events.append({"name": name, "bytes": float(g.nbytes),
                                    "t_issue": time.perf_counter()})
-        self.write_handle.pwrite(flat, self._path(name), async_op=async_op)
+        self._io(lambda: self.write_handle.pwrite(
+            flat, self._path(name), async_op=async_op), "swap.pwrite")
         self.stats["write_bytes"] += g.nbytes
         if not async_op:
             self.flush_writes()
@@ -242,7 +255,8 @@ class PartitionedParamSwapper:
         g = self.groups[name]
         idx = self._evict_for(name)
         buf = self._buffers[idx][:g.nbytes]
-        self._read_handles[idx].pread(buf, self._path(name), async_op=True)
+        self._io(lambda: self._read_handles[idx].pread(
+            buf, self._path(name), async_op=True), "swap.pread")
         self._pending[name] = idx
 
     def swap_in(self, name: str) -> InflightGroupRead:
@@ -267,8 +281,8 @@ class PartitionedParamSwapper:
             self.stats["serialized_reads"] += 1
             idx = self._evict_for(name)
             buf = self._buffers[idx][:g.nbytes]
-            self._read_handles[idx].pread(buf, self._path(name),
-                                          async_op=False)
+            self._io(lambda: self._read_handles[idx].pread(
+                buf, self._path(name), async_op=False), "swap.pread")
             self._resident[name] = idx
             self._lru.append(name)
         else:

@@ -179,7 +179,9 @@ class ZeroInfinityEngine:
             self._swapper = PartitionedParamSwapper(
                 swap_dir, groups_compute,
                 buffer_count=max(2, op.buffer_count),
-                aio_config=self.config.aio_config)
+                aio_config=self.config.aio_config,
+                retry_policy=self.config.resilience_config
+                .build_retry_policy())
             for name, tree in groups_compute.items():
                 self._swapper.write(name, tree, async_op=True)
             self._swapper.flush_writes()

@@ -685,6 +685,15 @@ class Zero3StreamContext:
                 "through stacked_params/extra_xs instead",
                 ranks=[0], level=logging.WARNING)
         self.last_plan = plan
+        if plan.forfeited and not self._plan_logged:
+            # requested overlap fell back to serialized gathers — a
+            # capacity fallback the operator should see once, loudly
+            try:
+                from ..resilience.degradation import record as degrade
+                degrade("zero3_prefetch", "overlapped", "serialized",
+                        plan.forfeited)
+            except Exception:  # pragma: no cover — partial install
+                pass
         if not self._plan_logged:
             lb = ""
             if self.lbc is not None:

@@ -937,12 +937,17 @@ def test_cli_error_mode_hbm_budget_exits_nonzero(tmp_path, capsys):
 # the suite here before it can burn a pod.  The same gate runs as a
 # workflow step (.github/workflows/tier1.yml) via the real CLI.
 # --------------------------------------------------------------------- #
-def test_ci_gate_examples_error_mode(capsys):
+def test_ci_gate_examples_error_mode(capsys, request):
     from deepspeed_tpu.analysis.cli import main as cli_main
     examples = sorted((REPO / "docs" / "examples").glob("*.json"))
     assert EXAMPLE_CFG in examples and EXAMPLE_STREAM_CFG in examples
     assert EXAMPLE_FCM_CFG in examples and EXAMPLE_HLO_CFG in examples
     golden_stream = json.loads(GOLDEN_STREAM.read_text())
+    # gpt2_chaos.json installs the process-global chaos plane at engine
+    # init; its faults are at_step-triggered (audits never step, so none
+    # can fire here) but the plane must not outlive this gate
+    from deepspeed_tpu.runtime.resilience import chaos as _chaos
+    request.addfinalizer(_chaos.uninstall)
     for cfg_path in examples:
         ds.reset_mesh_context()
         rc = cli_main(["--config", str(cfg_path), "--mode", "error",

@@ -31,6 +31,12 @@ def test_resilience_package_imports_cleanly():
             "deepspeed_tpu.runtime.resilience.preemption",
             "deepspeed_tpu.runtime.resilience.sentinel",
             "deepspeed_tpu.runtime.resilience.fault_injection",
+            # chaos plane + retry/degradation (round 21): fired lazily
+            # from guarded imports at every injection surface — a broken
+            # standalone import would silently disable fault injection
+            "deepspeed_tpu.runtime.resilience.chaos",
+            "deepspeed_tpu.runtime.resilience.retry",
+            "deepspeed_tpu.runtime.resilience.degradation",
             # elastic self-healing layer: reshard validation is lazily
             # imported inside save/load_checkpoint; the supervisor is
             # jax-free and imported by controller-side scripts only
