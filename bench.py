@@ -783,7 +783,7 @@ def _zero3_stream_setup(row_name, batch, seq=1024):
     cfg = GPT2Config(n_positions=seq, bf16=True)
     model = GPT2Model(cfg)
     per_layer = sum(
-        int(np.prod(l.shape[1:])) for l in jax.tree.leaves(
+        int(np.prod(leaf.shape[1:])) for leaf in jax.tree.leaves(
             model.init_params(jax.random.PRNGKey(0))["h"]))
     rng = np.random.RandomState(0)
     global_batch = max(1, batch // zero_world) * zero_world

@@ -1,0 +1,37 @@
+"""Source-level invariant auditor for the host plane (ISSUE 20).
+
+The analysis stack audits what we trace (jaxpr lint), what we schedule
+(overlap/liveness), and what XLA compiles (HLO cross-check) — this
+subpackage audits the SOURCE that grew around it: writer threads,
+watchdog timers, the chaos plane's determinism contract, the
+degradation registry, and checkpoint client-state round-trips.  It is a
+dependency-free ``ast`` walker + rule registry whose findings mirror
+the Program Auditor's ``rule_id/severity/provenance`` shape
+(docs/source_lint.md).
+
+Entry points:
+
+    python -m deepspeed_tpu.analysis lint-source [--json]
+
+and in-process (the fast-lane twin in tests/unit/test_source_lint.py):
+
+    from deepspeed_tpu.analysis.source_lint import run_source_lint
+    report = run_source_lint()
+    assert not report.has_errors
+"""
+
+from .core import (  # noqa: F401
+    ALL_SOURCE_RULES,
+    RULE_CHECKPOINT_STATE,
+    RULE_DEGRADATION_COVERAGE,
+    RULE_DETERMINISM,
+    RULE_KNOB_TRI_SOURCING,
+    RULE_SUPPRESSION,
+    RULE_THREAD_DISCIPLINE,
+    LintContext,
+    ParsedFile,
+    SourceFinding,
+    SourceLintReport,
+    Suppression,
+)
+from .runner import lint_source_main, run_source_lint  # noqa: F401

@@ -1,0 +1,341 @@
+"""Core of the source lint: finding schema, suppressions, file walker.
+
+Everything here is dependency-free stdlib (``ast`` + ``re``): the lint
+must run in CI before anything is installed beyond the package itself,
+and in-process from the fast lane without importing jax.
+
+Finding schema mirrors ``analysis/findings.py`` (rule / severity /
+message / provenance / fix_hint) so CI, tests, and the CLI consume the
+same shape at every audit altitude — jaxpr, schedule, HLO, and now
+source (docs/program_auditor.md's altitude table).
+
+Suppression contract: a finding is suppressed for one file by a comment
+
+    # ds-lint: disable=<rule>(<reason>)
+
+anywhere in that file.  The reason is MANDATORY — a reasonless
+``disable=`` is itself an error-severity finding (``suppression``), so
+the shipped tree can never accumulate unexplained waivers.  Multiple
+rules: ``disable=rule-a(why),rule-b(why)``.
+"""
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+SEVERITIES = ("error", "warning", "info")
+
+# stable rule ids (tests, docs, and suppression comments key off these)
+RULE_THREAD_DISCIPLINE = "thread-discipline"
+RULE_DETERMINISM = "determinism"
+RULE_DEGRADATION_COVERAGE = "degradation-coverage"
+RULE_KNOB_TRI_SOURCING = "knob-tri-sourcing"
+RULE_CHECKPOINT_STATE = "checkpoint-state"
+# meta-rule: malformed / reasonless / unknown-rule suppression comments
+RULE_SUPPRESSION = "suppression"
+# meta-rule: a walked file failed to parse at all
+RULE_PARSE = "parse"
+
+ALL_SOURCE_RULES = (
+    RULE_THREAD_DISCIPLINE,
+    RULE_DETERMINISM,
+    RULE_DEGRADATION_COVERAGE,
+    RULE_KNOB_TRI_SOURCING,
+    RULE_CHECKPOINT_STATE,
+    RULE_SUPPRESSION,
+    RULE_PARSE,
+)
+
+
+@dataclass
+class SourceFinding:
+    """One source-lint hit: what rule fired, how bad, and exactly where
+    (file:line provenance plus the enclosing def/class qualname)."""
+    rule: str                 # one of ALL_SOURCE_RULES
+    severity: str             # "error" | "warning" | "info"
+    message: str              # human-readable defect statement
+    path: str = ""            # repo-relative file path
+    line: int = 0             # 1-based line number (0 = whole file)
+    scope: str = ""           # enclosing qualname ("Class.method")
+    fix_hint: str = ""        # one actionable sentence
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"severity {self.severity!r} not in "
+                             f"{SEVERITIES}")
+        if self.rule not in ALL_SOURCE_RULES:
+            raise ValueError(f"unknown source rule id {self.rule!r}")
+
+    @property
+    def provenance(self) -> str:
+        where = self.path + (f":{self.line}" if self.line else "")
+        return where + (f" @ {self.scope}" if self.scope else "")
+
+    def format(self) -> str:
+        hint = f"  hint: {self.fix_hint}" if self.fix_hint else ""
+        return (f"[{self.severity.upper():7s}] {self.rule}: "
+                f"{self.message} ({self.provenance}){hint}")
+
+
+@dataclass
+class Suppression:
+    """One parsed ``# ds-lint: disable=rule(reason)`` entry."""
+    rule: str
+    reason: str
+    path: str
+    line: int
+    used: bool = False
+
+
+# everything after the disable marker is the entry list; entries are
+# rule(reason) pairs separated by commas OUTSIDE parens (reasons may
+# contain commas).  Only real COMMENT tokens are scanned — a docstring
+# quoting the syntax is not a suppression.
+_SUPPRESS_RE = re.compile(r"#\s*ds-lint:\s*disable=(.*)$")
+_ENTRY_RE = re.compile(r"^\s*([A-Za-z0-9_-]+)\s*(?:\(([^()]*)\))?\s*$")
+
+
+def _split_entries(raw: str) -> List[str]:
+    out, depth, cur = [], 0, []
+    for ch in raw:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth = max(0, depth - 1)
+        if ch == "," and depth == 0:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        out.append("".join(cur))
+    return [e for e in (x.strip() for x in out) if e]
+
+
+def parse_suppressions(path: str, lines: List[str]
+                       ) -> Tuple[List[Suppression], List[SourceFinding]]:
+    """Parse every ds-lint disable comment in one file.  Returns the
+    valid suppressions plus findings for contract violations (missing
+    reason, unparseable entry, unknown rule id)."""
+    sups: List[Suppression] = []
+    findings: List[SourceFinding] = []
+    comments: List[Tuple[int, str]] = []
+    try:
+        toks = tokenize.generate_tokens(
+            io.StringIO("\n".join(lines) + "\n").readline)
+        for tok in toks:
+            if tok.type == tokenize.COMMENT:
+                comments.append((tok.start[0], tok.string))
+    except tokenize.TokenizeError:
+        pass
+    for lineno, text in comments:
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        for entry in _split_entries(m.group(1)):
+            em = _ENTRY_RE.match(entry)
+            if not em:
+                findings.append(SourceFinding(
+                    RULE_SUPPRESSION, "error",
+                    f"unparseable suppression entry {entry!r}",
+                    path=path, line=lineno,
+                    fix_hint="write `# ds-lint: disable=<rule>(<reason>)`"))
+                continue
+            rule, reason = em.group(1), (em.group(2) or "").strip()
+            if rule not in ALL_SOURCE_RULES:
+                findings.append(SourceFinding(
+                    RULE_SUPPRESSION, "warning",
+                    f"suppression names unknown rule {rule!r}",
+                    path=path, line=lineno,
+                    fix_hint=f"known rules: {', '.join(ALL_SOURCE_RULES)}"))
+                continue
+            if not reason:
+                findings.append(SourceFinding(
+                    RULE_SUPPRESSION, "error",
+                    f"suppression of {rule!r} carries no reason",
+                    path=path, line=lineno,
+                    fix_hint="a reason is mandatory: "
+                             f"`# ds-lint: disable={rule}(<why>)`"))
+                continue
+            sups.append(Suppression(rule=rule, reason=reason,
+                                    path=path, line=lineno))
+    return sups, findings
+
+
+class _QualnameVisitor(ast.NodeVisitor):
+    """Annotates every node with ``_ds_qualname`` (enclosing
+    Class.method path) and ``_ds_parent`` so rules can report scope
+    provenance and walk upward without re-deriving it."""
+
+    def __init__(self):
+        self._stack: List[str] = []
+
+    def visit(self, node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            self._stack.append(node.name)
+            node._ds_qualname = ".".join(self._stack)
+            for child in ast.iter_child_nodes(node):
+                child._ds_parent = node
+                self.visit(child)
+            self._stack.pop()
+        else:
+            node._ds_qualname = ".".join(self._stack)
+            for child in ast.iter_child_nodes(node):
+                child._ds_parent = node
+                self.visit(child)
+
+
+@dataclass
+class ParsedFile:
+    """One source file the walker loaded: path, text, AST (annotated
+    with qualname/parent), and its suppression table."""
+    path: str                       # repo-relative, forward slashes
+    lines: List[str]
+    tree: ast.AST
+    suppressions: List[Suppression] = field(default_factory=list)
+
+    def qualname_of(self, node: ast.AST) -> str:
+        return getattr(node, "_ds_qualname", "")
+
+    def suppressed(self, rule: str) -> Optional[Suppression]:
+        for s in self.suppressions:
+            if s.rule == rule:
+                return s
+        return None
+
+
+@dataclass
+class LintContext:
+    """Everything a rule sees: the parsed package files plus the repo
+    root (rules that read docs/ or README reach through it)."""
+    root: str
+    files: List[ParsedFile] = field(default_factory=list)
+    # parse failures (path, message) — reported as findings by runner
+    parse_errors: List[Tuple[str, str]] = field(default_factory=list)
+
+    def get(self, path: str) -> Optional[ParsedFile]:
+        for pf in self.files:
+            if pf.path == path:
+                return pf
+        return None
+
+
+def parse_file(path: str, text: str) -> ParsedFile:
+    lines = text.splitlines()
+    tree = ast.parse(text, filename=path)
+    _QualnameVisitor().visit(tree)
+    sups, sup_findings = parse_suppressions(path, lines)
+    pf = ParsedFile(path=path, lines=lines, tree=tree, suppressions=sups)
+    # stash the contract-violation findings on the file so the runner
+    # folds them into the report (they are never themselves
+    # suppressible — that would defeat the contract)
+    pf._contract_findings = sup_findings
+    return pf
+
+
+@dataclass
+class SourceLintReport:
+    """Everything one source-lint pass learned about the tree."""
+    findings: List[SourceFinding] = field(default_factory=list)
+    files_scanned: int = 0
+    # suppressions that actually ate a finding: (path, rule, reason)
+    suppressed: List[Tuple[str, str, str]] = field(default_factory=list)
+
+    def counts(self) -> Dict[str, int]:
+        out = {s: 0 for s in SEVERITIES}
+        for f in self.findings:
+            out[f.severity] += 1
+        return out
+
+    @property
+    def has_errors(self) -> bool:
+        return any(f.severity == "error" for f in self.findings)
+
+    def summary_line(self) -> str:
+        c = self.counts()
+        return (f"source lint: {c['error']} error(s), "
+                f"{c['warning']} warning(s), {c['info']} info over "
+                f"{self.files_scanned} file(s); "
+                f"{len(self.suppressed)} finding(s) suppressed "
+                f"with reasons")
+
+    def to_json(self, indent: Optional[int] = 2) -> str:
+        import json
+        return json.dumps({
+            "findings": [asdict(f) for f in self.findings],
+            "files_scanned": self.files_scanned,
+            "suppressed": [list(s) for s in self.suppressed],
+            "counts": self.counts(),
+        }, indent=indent)
+
+
+# ---------------------------------------------------------------- #
+# rule registry
+# ---------------------------------------------------------------- #
+
+# rule_id -> check(ctx) -> List[SourceFinding]; populated by the rule
+# modules at import time via @register
+RULE_CHECKS: Dict[str, object] = {}
+
+
+def register(rule_id: str):
+    if rule_id not in ALL_SOURCE_RULES:
+        raise ValueError(f"unknown source rule id {rule_id!r}")
+
+    def deco(fn):
+        RULE_CHECKS[rule_id] = fn
+        return fn
+    return deco
+
+
+# ---------------------------------------------------------------- #
+# small AST helpers shared by the rules
+# ---------------------------------------------------------------- #
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target: ``threading.Thread(...)`` ->
+    ``threading.Thread``; ``Thread(...)`` -> ``Thread``."""
+    return dotted(node.func)
+
+
+def dotted(node: ast.AST) -> str:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    return ""
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    """The string value of a Constant, or the leading literal chunk of
+    an f-string (``f"ds-pump-{host}"`` -> ``"ds-pump-"``)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr) and node.values:
+        first = node.values[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            return first.value
+    return None
+
+
+def enclosing_function(node: ast.AST) -> Optional[ast.AST]:
+    cur = getattr(node, "_ds_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = getattr(cur, "_ds_parent", None)
+    return None
+
+
+def enclosing_class(node: ast.AST) -> Optional[ast.ClassDef]:
+    cur = getattr(node, "_ds_parent", None)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur
+        cur = getattr(cur, "_ds_parent", None)
+    return None

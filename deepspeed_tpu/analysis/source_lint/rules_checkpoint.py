@@ -1,0 +1,184 @@
+"""checkpoint-state: counter attributes must round-trip save/load.
+
+The PR 16 bug class: the engine grew ``_onebit_phase`` but
+``save_checkpoint`` didn't persist it, so a resumed run silently
+restarted the 1-bit warmup.  For every class declared in
+``manifest.STATE_CLASSES`` this rule derives the candidate state set —
+public attributes initialized in ``__init__`` to an int or dict
+literal AND mutated outside ``__init__``/save/load (a literal-int attr
+nobody mutates is config, not state) plus the manifest's
+``extra_attrs`` — and requires each to be *visible* in BOTH the save
+and the load method: referenced as ``self.<attr>`` or named in a
+string constant (client-state keys drop a leading underscore, so
+``_onebit_phase`` matches ``"onebit_phase"``).  Same-class helper
+methods called from save/load are searched too (one level), so a
+``state_dict`` that returns ``self.counters()`` still counts.
+"""
+
+import ast
+from typing import List, Optional, Set
+
+from . import manifest
+from .core import (
+    RULE_CHECKPOINT_STATE,
+    LintContext,
+    ParsedFile,
+    SourceFinding,
+    register,
+)
+
+
+def _find_class(pf: ParsedFile, name: str) -> Optional[ast.ClassDef]:
+    for node in ast.walk(pf.tree):
+        if isinstance(node, ast.ClassDef) and node.name == name:
+            return node
+    return None
+
+
+def _method(cls: ast.ClassDef, name: str) -> Optional[ast.AST]:
+    for node in cls.body:
+        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == name):
+            return node
+    return None
+
+
+def _is_state_literal(value: ast.AST) -> bool:
+    if isinstance(value, ast.Constant) and isinstance(value.value, int) \
+            and not isinstance(value.value, bool):
+        return True
+    if (isinstance(value, ast.UnaryOp) and isinstance(value.op, ast.USub)
+            and isinstance(value.operand, ast.Constant)
+            and isinstance(value.operand.value, int)):
+        return True
+    if isinstance(value, ast.Dict):
+        # {} (a tally filled at runtime) or an all-int dict (a counters
+        # table) is state; a populated mixed dict is a static table
+        return not value.values or all(
+            isinstance(v, ast.Constant) and isinstance(v.value, int)
+            for v in value.values)
+    return False
+
+
+def _self_assign_targets(node: ast.stmt) -> List[str]:
+    out: List[str] = []
+    targets: List[ast.AST] = []
+    if isinstance(node, ast.Assign):
+        targets = list(node.targets)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    for t in targets:
+        if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                and t.value.id == "self"):
+            out.append(t.attr)
+    return out
+
+
+def _candidates(cls: ast.ClassDef, save: str, load: str) -> Set[str]:
+    init = _method(cls, "__init__")
+    if init is None:
+        return set()
+    literal_inits: Set[str] = set()
+    for node in ast.walk(init):
+        if isinstance(node, ast.Assign) and _is_state_literal(node.value):
+            literal_inits.update(a for a in _self_assign_targets(node)
+                                 if not a.startswith("_"))
+    mutated: Set[str] = set()
+    for meth in cls.body:
+        if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if meth.name in ("__init__", save, load):
+            continue
+        for node in ast.walk(meth):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                mutated.update(_self_assign_targets(node))
+            # dict-state mutation: self.counts[k] = / .update( / +=
+            if (isinstance(node, ast.Subscript)
+                    and isinstance(node.ctx, ast.Store)
+                    and isinstance(node.value, ast.Attribute)
+                    and isinstance(node.value.value, ast.Name)
+                    and node.value.value.id == "self"):
+                mutated.add(node.value.attr)
+    return literal_inits & mutated
+
+
+def _visible_names(cls: ast.ClassDef, method: ast.AST) -> Set[str]:
+    """Attribute names + string constants visible from a method body,
+    expanding one level of same-class ``self.helper()`` calls."""
+    seen_methods = {method}
+    for node in ast.walk(method):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"):
+            helper = _method(cls, node.func.attr)
+            if helper is not None:
+                seen_methods.add(helper)
+    out: Set[str] = set()
+    for meth in seen_methods:
+        for node in ast.walk(meth):
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                out.add(node.attr)
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                out.add(node.value)
+    return out
+
+
+def _covered(attr: str, names: Set[str]) -> bool:
+    return attr in names or attr.lstrip("_") in names
+
+
+@register(RULE_CHECKPOINT_STATE)
+def check(ctx: LintContext) -> List[SourceFinding]:
+    findings: List[SourceFinding] = []
+    for decl in manifest.STATE_CLASSES:
+        pf = ctx.get(decl["path"])
+        if pf is None:
+            continue
+        cls = _find_class(pf, decl["cls"])
+        if cls is None:
+            findings.append(SourceFinding(
+                RULE_CHECKPOINT_STATE, "error",
+                f"manifest declares class {decl['cls']} but it is not "
+                f"in {decl['path']}",
+                path=decl["path"],
+                fix_hint="update source_lint/manifest.py STATE_CLASSES"))
+            continue
+        save = _method(cls, decl["save"])
+        load = _method(cls, decl["load"])
+        if save is None or load is None:
+            missing = decl["save"] if save is None else decl["load"]
+            findings.append(SourceFinding(
+                RULE_CHECKPOINT_STATE, "error",
+                f"{decl['cls']} has no method {missing!r} declared as "
+                "its checkpoint surface",
+                path=decl["path"], line=cls.lineno, scope=decl["cls"],
+                fix_hint="update source_lint/manifest.py STATE_CLASSES"))
+            continue
+        attrs = _candidates(cls, decl["save"], decl["load"])
+        attrs.update(decl.get("extra_attrs", ()))
+        exempt = decl.get("exempt_attrs", {})
+        save_names = _visible_names(cls, save)
+        load_names = _visible_names(cls, load)
+        for attr in sorted(attrs):
+            if attr in exempt:
+                continue
+            for side, names in (("save", save_names),
+                                ("load", load_names)):
+                if not _covered(attr, names):
+                    findings.append(SourceFinding(
+                        RULE_CHECKPOINT_STATE, "error",
+                        f"{decl['cls']}.{attr} looks like mutable "
+                        f"counter state but does not round-trip: not "
+                        f"visible in {side} method {decl[side]!r}",
+                        path=decl["path"], line=cls.lineno,
+                        scope=f"{decl['cls']}.{attr}",
+                        fix_hint="persist it through the declared "
+                                 "save/load pair, or exempt it with a "
+                                 "reason in STATE_CLASSES "
+                                 "exempt_attrs (the onebit_phase bug "
+                                 "class, PR 16)"))
+    return findings

@@ -1,0 +1,88 @@
+"""degradation-coverage: silent fallback ladders must be registered.
+
+The resilience layer's contract is that every *persistent* downgrade —
+a writer giving up, deep-profiling disarming, static predictions
+disabled — lands in ``resilience/degradation.py``'s registry so the
+run's final summary (and the fleet monitor) can say what quietly got
+worse.  A broad ``except`` that swallows the exception (no ``raise`` on
+any path) and carries on is exactly the ladder this rule exists for:
+it must call ``degradation.record(...)`` in the handler, be listed in
+``manifest.DEGRADATION_WAIVERS`` with a reason (per-window transients,
+best-effort cleanup), or it is a finding.
+
+Narrow excepts (``KeyError`` on a parse, ``ImportError`` on an optional
+dep probe) are out of scope: the rule keys on catches of ``Exception``
+/ ``BaseException`` / bare ``except`` — the shape that eats *anything*.
+"""
+
+import ast
+from typing import List
+
+from . import manifest
+from .core import (
+    RULE_DEGRADATION_COVERAGE,
+    LintContext,
+    SourceFinding,
+    dotted,
+    register,
+)
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [dotted(e) for e in t.elts]
+    else:
+        names = [dotted(t)]
+    return any(n in _BROAD for n in names)
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    """True when no path out of the handler re-raises."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return False
+    return True
+
+
+def _registers(handler: ast.ExceptHandler) -> bool:
+    """The handler (or code it directly contains) calls into the
+    degradation registry: ``degradation.record(...)``, ``record(...)``
+    imported from it, or ``<registry>.degrade(...)``."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Call):
+            leaf = dotted(node.func).rsplit(".", 1)[-1]
+            if leaf in ("record", "degrade"):
+                return True
+    return False
+
+
+@register(RULE_DEGRADATION_COVERAGE)
+def check(ctx: LintContext) -> List[SourceFinding]:
+    findings: List[SourceFinding] = []
+    for pf in ctx.files:
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node) or not _swallows(node):
+                continue
+            if _registers(node):
+                continue
+            qual = pf.qualname_of(node) or "<module>"
+            if (pf.path, qual) in manifest.DEGRADATION_WAIVERS:
+                continue
+            findings.append(SourceFinding(
+                RULE_DEGRADATION_COVERAGE, "error",
+                "broad except swallows the exception and continues "
+                "without registering in the degradation registry",
+                path=pf.path, line=node.lineno, scope=qual,
+                fix_hint="call resilience.degradation.record(subsystem, "
+                         "from_tier, to_tier, reason) in the handler, "
+                         "or waive it with a reason in "
+                         "source_lint/manifest.py DEGRADATION_WAIVERS"))
+    return findings

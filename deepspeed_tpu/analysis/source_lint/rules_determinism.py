@@ -1,0 +1,74 @@
+"""determinism: no wall clocks or global random state in the
+deterministic planes.
+
+The chaos plane's contract (docs/resilience.md) is bitwise: the same
+seed and schedule must produce byte-identical fired logs, retry backoff
+sequences, and fleet-health verdicts, or chaos reproductions and the
+golden tests built on them rot.  So inside the declared planes
+(``manifest.DETERMINISTIC_PLANES``) this rule bans:
+
+- ``time.time`` / ``time.time_ns`` / ``time.monotonic`` /
+  ``time.perf_counter`` and ``datetime.now/utcnow/today`` — decisions
+  must come from injected clocks or step counts, not wall time
+  (``time.sleep`` is allowed: it delays, it never *decides*);
+- module-level ``random.*`` calls — only instantiated, seeded
+  ``random.Random(seed)`` generators are deterministic; the process
+  global is shared mutable state any import can perturb.
+"""
+
+import ast
+from typing import List
+
+from . import manifest
+from .core import (
+    RULE_DETERMINISM,
+    LintContext,
+    SourceFinding,
+    call_name,
+    register,
+)
+
+_BANNED_TIME = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "datetime.now", "datetime.utcnow", "datetime.today",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+}
+# random.Random / random.SystemRandom construction is the SANCTIONED
+# path (a seeded instance); everything else on the module is banned
+_ALLOWED_RANDOM_ATTRS = {"Random", "SystemRandom"}
+
+
+@register(RULE_DETERMINISM)
+def check(ctx: LintContext) -> List[SourceFinding]:
+    findings: List[SourceFinding] = []
+    for pf in ctx.files:
+        if pf.path not in manifest.DETERMINISTIC_PLANES:
+            continue
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cn = call_name(node)
+            if cn in _BANNED_TIME:
+                findings.append(SourceFinding(
+                    RULE_DETERMINISM, "error",
+                    f"{cn}() read inside the deterministic plane",
+                    path=pf.path, line=node.lineno,
+                    scope=pf.qualname_of(node),
+                    fix_hint="inject the clock (parameter / attribute "
+                             "set by the caller) or key off step "
+                             "counts — the fired-log contract is "
+                             "bitwise (docs/resilience.md)"))
+            elif (cn.startswith("random.")
+                  and cn.split(".", 1)[1] not in _ALLOWED_RANDOM_ATTRS):
+                findings.append(SourceFinding(
+                    RULE_DETERMINISM, "error",
+                    f"module-level {cn}() inside the deterministic "
+                    "plane",
+                    path=pf.path, line=node.lineno,
+                    scope=pf.qualname_of(node),
+                    fix_hint="use a seeded random.Random(seed) instance "
+                             "owned by the plane (the process-global "
+                             "generator is perturbed by any import)"))
+    return findings

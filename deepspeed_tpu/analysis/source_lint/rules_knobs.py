@@ -1,0 +1,125 @@
+"""knob-tri-sourcing: every config knob exists in three places.
+
+A *knob* is a ``constants.py`` key constant (``NAME = "json_key"``)
+that ships a ``NAME_DEFAULT`` sibling — the repo's convention for "this
+is a user-facing config field".  Each knob must be:
+
+1. **declared** in ``constants.py`` (that's how it enters the set),
+2. **validated** — the constant is referenced by a declared validator
+   module (``manifest.VALIDATOR_MODULES``: config.py parses/validates
+   engine blocks, elasticity.py its own), and
+3. **documented** — the JSON key string appears in ``docs/``
+   or ``README.md``.
+
+Orphans (declared but never validated: dead surface or a typo'd
+rename) and doc-drift (validated but undocumented) are named per key.
+Constants reserved for upstream-config parity can be waived by prefix
+in ``manifest.RESERVED_KNOB_PREFIXES`` with a reason.
+"""
+
+import ast
+import os
+import re
+from typing import Dict, List
+
+from . import manifest
+from .core import (
+    RULE_KNOB_TRI_SOURCING,
+    LintContext,
+    SourceFinding,
+    register,
+)
+
+_CONSTANTS = "deepspeed_tpu/constants.py"
+
+
+def _knobs(pf) -> Dict[str, tuple]:
+    """NAME -> (json_key, lineno) for every constant with a _DEFAULT
+    sibling."""
+    assigns: Dict[str, tuple] = {}
+    names = set()
+    for node in pf.tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            name = node.targets[0].id
+            names.add(name)
+            if (isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)):
+                assigns[name] = (node.value.value, node.lineno)
+    return {n: v for n, v in assigns.items()
+            if not n.endswith("_DEFAULT") and f"{n}_DEFAULT" in names}
+
+
+def _read(ctx: LintContext, rel: str) -> str:
+    pf = ctx.get(rel)
+    if pf is not None:
+        return "\n".join(pf.lines)
+    try:
+        with open(os.path.join(ctx.root, rel)) as f:
+            return f.read()
+    except OSError:
+        return ""
+
+
+def _docs_corpus(ctx: LintContext) -> str:
+    chunks: List[str] = []
+    docs_dir = os.path.join(ctx.root, "docs")
+    for dirpath, _dirnames, filenames in os.walk(docs_dir):
+        for fn in sorted(filenames):
+            if fn.endswith(".md"):
+                try:
+                    with open(os.path.join(dirpath, fn)) as f:
+                        chunks.append(f.read())
+                except OSError:
+                    pass
+    readme = os.path.join(ctx.root, "README.md")
+    try:
+        with open(readme) as f:
+            chunks.append(f.read())
+    except OSError:
+        pass
+    return "\n".join(chunks)
+
+
+def _waived(name: str) -> str:
+    for prefix, reason in manifest.RESERVED_KNOB_PREFIXES.items():
+        if name.startswith(prefix):
+            return reason
+    return ""
+
+
+@register(RULE_KNOB_TRI_SOURCING)
+def check(ctx: LintContext) -> List[SourceFinding]:
+    pf = ctx.get(_CONSTANTS)
+    if pf is None:
+        return []
+    knobs = _knobs(pf)
+    validators = "\n".join(_read(ctx, m)
+                           for m in manifest.VALIDATOR_MODULES)
+    docs = _docs_corpus(ctx)
+
+    findings: List[SourceFinding] = []
+    for name in sorted(knobs):
+        key, lineno = knobs[name]
+        if _waived(name):
+            continue
+        if not re.search(rf"\b{re.escape(name)}\b", validators):
+            findings.append(SourceFinding(
+                RULE_KNOB_TRI_SOURCING, "error",
+                f"knob {name} (json key {key!r}) is declared in "
+                "constants.py but referenced by no validator module",
+                path=_CONSTANTS, line=lineno,
+                fix_hint="validate it in config.py (or another "
+                         "manifest.VALIDATOR_MODULES entry), delete the "
+                         "dead constant, or reserve its prefix with a "
+                         "reason in RESERVED_KNOB_PREFIXES"))
+            continue
+        if not re.search(rf"\b{re.escape(key)}\b", docs):
+            findings.append(SourceFinding(
+                RULE_KNOB_TRI_SOURCING, "error",
+                f"knob {name}: json key {key!r} appears nowhere in "
+                "docs/ or README.md",
+                path=_CONSTANTS, line=lineno,
+                fix_hint="document the key (docs/config_reference.md "
+                         "is the catalog of last resort)"))
+    return findings

@@ -1,0 +1,278 @@
+"""thread-discipline: lifecycle + naming + lock hygiene for host threads.
+
+Three checks over every ``threading.Thread`` / ``threading.Timer``
+creation site (the bug classes behind PR 11's grace-deadline double-save
+and PR 10's pump-thread SIGPIPE):
+
+1. Every thread must be *daemon'd and named* with a ``ds-`` prefix (so
+   py-spy dumps and stack traces attribute them to this package), OR
+   *provably joined* — an unconditional ``t.join()`` with no timeout in
+   the creating function.  A timed join can return with the thread
+   still alive, so it does not count.
+2. ``Lock`` / ``RLock`` / ``Condition`` acquisition only via ``with`` —
+   a bare ``.acquire()`` orphans the lock on any exception between it
+   and the ``release()``.
+3. Attributes written inside a thread target and read outside it are
+   cross-thread shared state: each must appear in the declared lock map
+   (``manifest.LOCK_MAP``) with a reason, or the rule fires.
+"""
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from . import manifest
+from .core import (
+    RULE_THREAD_DISCIPLINE,
+    LintContext,
+    ParsedFile,
+    SourceFinding,
+    call_name,
+    const_str,
+    dotted,
+    enclosing_class,
+    enclosing_function,
+    register,
+)
+
+_THREAD_CTORS = {"threading.Thread", "threading.Timer"}
+_BARE_CTORS = {"Thread", "Timer"}
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",
+               "Lock", "RLock", "Condition"}
+
+
+def _threading_imports(pf: ParsedFile) -> Set[str]:
+    """Names imported *from* threading in this file (so a bare
+    ``Thread(...)`` is only a thread ctor if it came from threading)."""
+    out: Set[str] = set()
+    for node in ast.walk(pf.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "threading":
+            for alias in node.names:
+                out.add(alias.asname or alias.name)
+    return out
+
+
+def _is_thread_ctor(name: str, bare_ok: Set[str]) -> bool:
+    return name in _THREAD_CTORS or (name in _BARE_CTORS
+                                     and name in bare_ok)
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _assigned_name(call: ast.Call) -> Optional[str]:
+    """``t = threading.Thread(...)`` -> ``"t"`` (simple Name targets
+    only; attribute targets like self._thread return the dotted path)."""
+    parent = getattr(call, "_ds_parent", None)
+    if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+        return dotted(parent.targets[0]) or None
+    return None
+
+
+def _body_of_scope(call: ast.Call) -> List[ast.stmt]:
+    fn = enclosing_function(call)
+    return fn.body if fn is not None else []
+
+
+def _post_creation_facts(var: str, body: List[ast.stmt],
+                         after_line: int) -> Dict[str, object]:
+    """Scan the creating scope for ``var.daemon = True``,
+    ``var.name = "..."``, and ``var.join()`` (timeout-free)."""
+    facts: Dict[str, object] = {"daemon": False, "name": None,
+                                "joined": False}
+    for node in ast.walk(ast.Module(body=body, type_ignores=[])):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Attribute)
+                and dotted(node.targets[0].value) == var):
+            attr = node.targets[0].attr
+            if attr == "daemon" and isinstance(node.value, ast.Constant):
+                facts["daemon"] = node.value.value is True
+            elif attr == "name":
+                facts["name"] = const_str(node.value)
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"
+                and dotted(node.func.value) == var
+                and not node.args
+                and not any(kw.arg == "timeout" for kw in node.keywords)):
+            facts["joined"] = True
+    return facts
+
+
+def _target_callable(call: ast.Call, name: str) -> Optional[ast.AST]:
+    """The thread's entry callable: ``target=`` kwarg for Thread, second
+    positional (the function) for Timer."""
+    tgt = _kwarg(call, "target")
+    if tgt is None and name.endswith("Timer") and len(call.args) >= 2:
+        tgt = call.args[1]
+    if tgt is None and name.endswith("Timer"):
+        tgt = _kwarg(call, "function")
+    return tgt
+
+
+def _self_attr_writes(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                out.add(t.attr)
+    return out
+
+
+def _self_attr_reads(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.ctx, ast.Load)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            out.add(node.attr)
+    return out
+
+
+def _check_creation(pf: ParsedFile, call: ast.Call, name: str,
+                    findings: List[SourceFinding]) -> None:
+    qual = pf.qualname_of(call)
+    daemon_kw = _kwarg(call, "daemon")
+    name_kw = _kwarg(call, "name")
+    daemon = (isinstance(daemon_kw, ast.Constant)
+              and daemon_kw.value is True)
+    tname = const_str(name_kw) if name_kw is not None else None
+
+    var = _assigned_name(call)
+    if var is not None and "." not in var:
+        facts = _post_creation_facts(var, _body_of_scope(call),
+                                     call.lineno)
+        daemon = daemon or bool(facts["daemon"])
+        tname = tname if tname is not None else facts["name"]
+        if facts["joined"]:
+            return  # provably joined: lifecycle is bounded by the scope
+
+    if daemon and tname is not None and tname.startswith("ds-"):
+        return
+    if not daemon:
+        findings.append(SourceFinding(
+            RULE_THREAD_DISCIPLINE, "error",
+            f"{name} is neither daemon'd nor provably joined "
+            "(an unconditional timeout-free join in the creating scope)",
+            path=pf.path, line=call.lineno, scope=qual,
+            fix_hint="pass daemon=True (or set t.daemon = True before "
+                     "start) so a wedged thread cannot block process "
+                     "exit, or join it unconditionally"))
+    if tname is None or not tname.startswith("ds-"):
+        have = f"name {tname!r}" if tname is not None else "no name"
+        findings.append(SourceFinding(
+            RULE_THREAD_DISCIPLINE, "error",
+            f"{name} has {have}; host-plane threads must be named "
+            "with the ds- prefix",
+            path=pf.path, line=call.lineno, scope=qual,
+            fix_hint="name it 'ds-<subsystem>-<role>' so py-spy/stack "
+                     "dumps attribute it to this package"))
+
+
+def _check_shared_attrs(pf: ParsedFile, call: ast.Call, name: str,
+                        findings: List[SourceFinding]) -> None:
+    tgt = _target_callable(call, name)
+    if tgt is None:
+        return
+    cls = enclosing_class(call)
+    target_fn: Optional[ast.AST] = None
+    if (isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name)
+            and tgt.value.id == "self" and cls is not None):
+        for node in cls.body:
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node.name == tgt.attr):
+                target_fn = node
+                break
+    elif isinstance(tgt, ast.Name):
+        fn = enclosing_function(call)
+        for node in ast.walk(fn) if fn is not None else []:
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node.name == tgt.id):
+                target_fn = node
+                break
+    if target_fn is None or cls is None:
+        return
+
+    written = _self_attr_writes(target_fn)
+    if not written:
+        return
+    read_outside: Set[str] = set()
+    for node in cls.body:
+        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node is not target_fn):
+            read_outside |= _self_attr_reads(node)
+    shared = written & read_outside
+    declared = manifest.LOCK_MAP.get((pf.path, cls.name), {})
+    for attr in sorted(shared - set(declared)):
+        findings.append(SourceFinding(
+            RULE_THREAD_DISCIPLINE, "error",
+            f"attribute self.{attr} is written inside thread target "
+            f"{cls.name}.{target_fn.name} and read outside it, but is "
+            "not in the declared lock map",
+            path=pf.path, line=target_fn.lineno,
+            scope=f"{cls.name}.{target_fn.name}",
+            fix_hint="guard it with a lock or declare it (with the "
+                     "safety argument) in source_lint/manifest.py "
+                     "LOCK_MAP"))
+
+
+def _lock_vars(pf: ParsedFile, bare_ok: Set[str]) -> Set[str]:
+    """Dotted names assigned from a threading lock/condition ctor."""
+    out: Set[str] = set()
+    for node in ast.walk(pf.tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.value, ast.Call)):
+            cn = call_name(node.value)
+            if cn in _LOCK_CTORS and (cn.startswith("threading.")
+                                      or cn in bare_ok):
+                name = dotted(node.targets[0])
+                if name:
+                    out.add(name)
+    return out
+
+
+def _check_acquire(pf: ParsedFile, bare_ok: Set[str],
+                   findings: List[SourceFinding]) -> None:
+    known_locks = _lock_vars(pf, bare_ok)
+    for node in ast.walk(pf.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "acquire"):
+            continue
+        recv = dotted(node.func.value)
+        leaf = recv.rsplit(".", 1)[-1].lower()
+        if recv in known_locks or "lock" in leaf or "cond" in leaf:
+            findings.append(SourceFinding(
+                RULE_THREAD_DISCIPLINE, "error",
+                f"bare {recv}.acquire() — lock acquisition only via "
+                "`with`",
+                path=pf.path, line=node.lineno,
+                scope=pf.qualname_of(node),
+                fix_hint="use `with <lock>:` so the lock releases on "
+                         "every exception path"))
+
+
+@register(RULE_THREAD_DISCIPLINE)
+def check(ctx: LintContext) -> List[SourceFinding]:
+    findings: List[SourceFinding] = []
+    for pf in ctx.files:
+        bare_ok = _threading_imports(pf)
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.Call):
+                cn = call_name(node)
+                if _is_thread_ctor(cn, bare_ok):
+                    _check_creation(pf, node, cn, findings)
+                    _check_shared_attrs(pf, node, cn, findings)
+        _check_acquire(pf, bare_ok, findings)
+    return findings

@@ -1,0 +1,134 @@
+"""Walker + runner + CLI entry for the source lint.
+
+``run_source_lint(root)`` walks every ``deepspeed_tpu/**/*.py`` under
+``root`` (default: this repo), runs the registered rules, applies the
+per-file suppression tables, and returns a ``SourceLintReport``.
+
+``lint_source_main(argv)`` is the CLI behind
+
+    python -m deepspeed_tpu.analysis lint-source [--json] [--root DIR]
+
+exit code 1 when error-severity findings survive, 0 otherwise — the
+tier1.yml gate contract, twinned in-process by
+tests/unit/test_source_lint.py.
+"""
+
+import argparse
+import os
+from typing import List, Optional
+
+from .core import (
+    RULE_CHECKS,
+    RULE_PARSE,
+    RULE_SUPPRESSION,
+    LintContext,
+    SourceFinding,
+    SourceLintReport,
+    parse_file,
+)
+
+# rule modules register themselves on import (order = report order)
+from . import rules_thread  # noqa: F401  (registration side effect)
+from . import rules_determinism  # noqa: F401
+from . import rules_degradation  # noqa: F401
+from . import rules_knobs  # noqa: F401
+from . import rules_checkpoint  # noqa: F401
+
+_EXCLUDED_DIRS = {"__pycache__", "build", ".git"}
+_PACKAGE_DIR = "deepspeed_tpu"
+
+
+def default_root() -> str:
+    # .../repo/deepspeed_tpu/analysis/source_lint/runner.py -> repo
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(os.path.dirname(here)))
+
+
+def build_context(root: Optional[str] = None) -> LintContext:
+    root = os.path.abspath(root or default_root())
+    ctx = LintContext(root=root)
+    pkg = os.path.join(root, _PACKAGE_DIR)
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in _EXCLUDED_DIRS)
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, root).replace(os.sep, "/")
+            try:
+                with open(full, encoding="utf-8") as f:
+                    text = f.read()
+                ctx.files.append(parse_file(rel, text))
+            except (OSError, SyntaxError) as e:
+                ctx.parse_errors.append((rel, str(e)))
+    return ctx
+
+
+def run_source_lint(root: Optional[str] = None) -> SourceLintReport:
+    ctx = build_context(root)
+    report = SourceLintReport(files_scanned=len(ctx.files))
+
+    # suppression-contract violations are never themselves suppressible
+    for pf in ctx.files:
+        report.findings.extend(getattr(pf, "_contract_findings", []))
+    for rel, msg in ctx.parse_errors:
+        report.findings.append(SourceFinding(
+            RULE_PARSE, "error", f"file failed to parse: {msg}",
+            path=rel, fix_hint="fix the syntax error"))
+
+    raw: List[SourceFinding] = []
+    for rule_id, check in RULE_CHECKS.items():
+        raw.extend(check(ctx))
+
+    for f in raw:
+        pf = ctx.get(f.path)
+        sup = pf.suppressed(f.rule) if pf is not None else None
+        if sup is not None and f.rule not in (RULE_SUPPRESSION,
+                                              RULE_PARSE):
+            sup.used = True
+            report.suppressed.append((f.path, f.rule, sup.reason))
+        else:
+            report.findings.append(f)
+
+    # a suppression that ate nothing is stale — warn so waivers cannot
+    # quietly outlive the finding they excused
+    for pf in ctx.files:
+        for sup in pf.suppressions:
+            if not sup.used:
+                report.findings.append(SourceFinding(
+                    RULE_SUPPRESSION, "warning",
+                    f"stale suppression: {sup.rule!r} has no finding "
+                    "left to suppress in this file",
+                    path=pf.path, line=sup.line,
+                    fix_hint="delete the ds-lint comment"))
+
+    report.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return report
+
+
+def build_lint_source_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.analysis lint-source",
+        description="AST-based source lint of the host plane "
+                    "(docs/source_lint.md): thread discipline, "
+                    "deterministic-plane clock/random bans, degradation-"
+                    "registry coverage, knob tri-sourcing, checkpoint-"
+                    "state round-trips.")
+    p.add_argument("--root", default=None,
+                   help="repo root to lint (default: this checkout)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the full report as JSON on stdout")
+    return p
+
+
+def lint_source_main(argv=None) -> int:
+    args = build_lint_source_parser().parse_args(argv)
+    report = run_source_lint(args.root)
+    if args.json:
+        print(report.to_json())
+    else:
+        for f in report.findings:
+            print(f.format())
+        print(report.summary_line())
+    return 1 if report.has_errors else 0

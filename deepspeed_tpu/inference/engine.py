@@ -121,7 +121,7 @@ class InferenceEngine:
 
         if specs is None:
             return jax.tree.map(
-                lambda l: place_leaf(l, None), params,
+                lambda leaf: place_leaf(leaf, None), params,
                 is_leaf=lambda x: isinstance(x, QuantizedWeight))
         # specs is a prefix tree of PartitionSpecs aligned with params
         flat_p = jax.tree_util.tree_flatten_with_path(

@@ -293,7 +293,6 @@ def _attach_seq_parallel_aux(module, cfg: GPT2Config):
             head = lnp["lm_head"]
         h = fused_layer_norm(h, w, b, cfg.layer_norm_eps)
         logits = (h @ head.astype(h.dtype)).astype(jnp.float32)
-        sp = lax.psum(1, seq_axis)
         idx = lax.axis_index(seq_axis)
         bsz, s_local = h.shape[0], h.shape[1]
         s = y_full.shape[1]

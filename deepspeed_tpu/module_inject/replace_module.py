@@ -72,7 +72,7 @@ def replace_transformer_layer(model, policy: Optional[type] = None,
     """
     policy_cls, layers = _detect_policy(model, policy)
     stacked = _stack_layers(
-        [policy_cls(l).layer_params() for l in layers])
+        [policy_cls(layer).layer_params() for layer in layers])
     name = type(model).__name__
 
     if not policy_cls.scale_attention:

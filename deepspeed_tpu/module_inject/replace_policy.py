@@ -52,20 +52,20 @@ class HFGPT2LayerPolicy(InjectBasePolicy):
     causal = True
 
     def layer_params(self):
-        l = self.layer
+        layer = self.layer
         return {
-            "attn_qkvw": _np(l.attn.c_attn.weight),          # [H, 3H] Conv1D
-            "attn_qkvb": _np(l.attn.c_attn.bias),
-            "attn_ow": _np(l.attn.c_proj.weight),            # [H, H]
-            "attn_ob": _np(l.attn.c_proj.bias),
-            "norm_w": _np(l.ln_1.weight),                    # pre-attn LN
-            "norm_b": _np(l.ln_1.bias),
-            "attn_nw": _np(l.ln_2.weight),                   # pre-MLP LN
-            "attn_nb": _np(l.ln_2.bias),
-            "inter_w": _np(l.mlp.c_fc.weight),               # [H, 4H]
-            "inter_b": _np(l.mlp.c_fc.bias),
-            "output_w": _np(l.mlp.c_proj.weight),            # [4H, H]
-            "output_b": _np(l.mlp.c_proj.bias),
+            "attn_qkvw": _np(layer.attn.c_attn.weight),          # [H, 3H] Conv1D
+            "attn_qkvb": _np(layer.attn.c_attn.bias),
+            "attn_ow": _np(layer.attn.c_proj.weight),            # [H, H]
+            "attn_ob": _np(layer.attn.c_proj.bias),
+            "norm_w": _np(layer.ln_1.weight),                    # pre-attn LN
+            "norm_b": _np(layer.ln_1.bias),
+            "attn_nw": _np(layer.ln_2.weight),                   # pre-MLP LN
+            "attn_nb": _np(layer.ln_2.bias),
+            "inter_w": _np(layer.mlp.c_fc.weight),               # [H, 4H]
+            "inter_b": _np(layer.mlp.c_fc.bias),
+            "output_w": _np(layer.mlp.c_proj.weight),            # [4H, H]
+            "output_b": _np(layer.mlp.c_proj.bias),
         }
 
 
@@ -77,8 +77,8 @@ class HFBertLayerPolicy(InjectBasePolicy):
     causal = False
 
     def layer_params(self):
-        l = self.layer
-        att = l.attention.self
+        layer = self.layer
+        att = layer.attention.self
         qkvw = np.concatenate(
             [_np(att.query.weight).T, _np(att.key.weight).T,
              _np(att.value.weight).T], axis=1)               # -> [H, 3H]
@@ -87,16 +87,16 @@ class HFBertLayerPolicy(InjectBasePolicy):
         return {
             "attn_qkvw": qkvw,
             "attn_qkvb": qkvb,
-            "attn_ow": _np(l.attention.output.dense.weight).T,
-            "attn_ob": _np(l.attention.output.dense.bias),
-            "attn_nw": _np(l.attention.output.LayerNorm.weight),  # post-attn
-            "attn_nb": _np(l.attention.output.LayerNorm.bias),
-            "inter_w": _np(l.intermediate.dense.weight).T,
-            "inter_b": _np(l.intermediate.dense.bias),
-            "output_w": _np(l.output.dense.weight).T,
-            "output_b": _np(l.output.dense.bias),
-            "norm_w": _np(l.output.LayerNorm.weight),            # post-MLP
-            "norm_b": _np(l.output.LayerNorm.bias),
+            "attn_ow": _np(layer.attention.output.dense.weight).T,
+            "attn_ob": _np(layer.attention.output.dense.bias),
+            "attn_nw": _np(layer.attention.output.LayerNorm.weight),  # post-attn
+            "attn_nb": _np(layer.attention.output.LayerNorm.bias),
+            "inter_w": _np(layer.intermediate.dense.weight).T,
+            "inter_b": _np(layer.intermediate.dense.bias),
+            "output_w": _np(layer.output.dense.weight).T,
+            "output_b": _np(layer.output.dense.bias),
+            "norm_w": _np(layer.output.LayerNorm.weight),            # post-MLP
+            "norm_b": _np(layer.output.LayerNorm.bias),
         }
 
 
@@ -111,8 +111,8 @@ class HFGPTNEOLayerPolicy(InjectBasePolicy):
     scale_attention = False
 
     def layer_params(self):
-        l = self.layer
-        att = l.attn.attention
+        layer = self.layer
+        att = layer.attn.attention
         h = _np(att.q_proj.weight).shape[1]
         qkvw = np.concatenate(
             [_np(att.q_proj.weight).T, _np(att.k_proj.weight).T,
@@ -128,12 +128,12 @@ class HFGPTNEOLayerPolicy(InjectBasePolicy):
                  bias_of(att.v_proj)]),
             "attn_ow": _np(att.out_proj.weight).T,
             "attn_ob": bias_of(att.out_proj),
-            "norm_w": _np(l.ln_1.weight), "norm_b": _np(l.ln_1.bias),
-            "attn_nw": _np(l.ln_2.weight), "attn_nb": _np(l.ln_2.bias),
-            "inter_w": _np(l.mlp.c_fc.weight).T,
-            "inter_b": _np(l.mlp.c_fc.bias),
-            "output_w": _np(l.mlp.c_proj.weight).T,
-            "output_b": _np(l.mlp.c_proj.bias),
+            "norm_w": _np(layer.ln_1.weight), "norm_b": _np(layer.ln_1.bias),
+            "attn_nw": _np(layer.ln_2.weight), "attn_nb": _np(layer.ln_2.bias),
+            "inter_w": _np(layer.mlp.c_fc.weight).T,
+            "inter_b": _np(layer.mlp.c_fc.bias),
+            "output_w": _np(layer.mlp.c_proj.weight).T,
+            "output_b": _np(layer.mlp.c_proj.bias),
         }
 
 
@@ -167,11 +167,11 @@ class MegatronLayerPolicy(InjectBasePolicy):
                 .reshape(rows, *rest))
 
     def layer_params(self):
-        l = self.layer
-        att = getattr(l, "attention", None)
+        layer = self.layer
+        att = getattr(layer, "attention", None)
         v2 = att is None  # .self_attention == new source == interleaved qkv
         if v2:
-            att = l.self_attention
+            att = layer.self_attention
 
         def bias_of(lin):
             b = getattr(lin, "bias", None)
@@ -190,14 +190,14 @@ class MegatronLayerPolicy(InjectBasePolicy):
             "attn_qkvb": qkvb,
             "attn_ow": _np(att.dense.weight).T,
             "attn_ob": bias_of(att.dense),
-            "norm_w": _np(l.input_layernorm.weight),          # pre-attn LN
-            "norm_b": _np(l.input_layernorm.bias),
-            "attn_nw": _np(l.post_attention_layernorm.weight),  # pre-MLP LN
-            "attn_nb": _np(l.post_attention_layernorm.bias),
-            "inter_w": _np(l.mlp.dense_h_to_4h.weight).T,
-            "inter_b": bias_of(l.mlp.dense_h_to_4h),
-            "output_w": _np(l.mlp.dense_4h_to_h.weight).T,
-            "output_b": bias_of(l.mlp.dense_4h_to_h),
+            "norm_w": _np(layer.input_layernorm.weight),          # pre-attn LN
+            "norm_b": _np(layer.input_layernorm.bias),
+            "attn_nw": _np(layer.post_attention_layernorm.weight),  # pre-MLP LN
+            "attn_nb": _np(layer.post_attention_layernorm.bias),
+            "inter_w": _np(layer.mlp.dense_h_to_4h.weight).T,
+            "inter_b": bias_of(layer.mlp.dense_h_to_4h),
+            "output_w": _np(layer.mlp.dense_4h_to_h.weight).T,
+            "output_b": bias_of(layer.mlp.dense_4h_to_h),
         }
 
 

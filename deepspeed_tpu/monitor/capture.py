@@ -93,6 +93,9 @@ class ProfileCapture:
             logger.warning(
                 f"monitor: profiler capture failed to arm ({e}) — "
                 "deep-profiling disabled for the rest of the run")
+            from ..runtime.resilience.degradation import record as degrade
+            degrade("profiling", "jax-profiler", "off",
+                    f"capture failed to arm: {e}")
             return False
         self.armed = True
         self._steps_captured = 0

@@ -242,7 +242,7 @@ class FleetAggregator:
             except BaseException as e:  # noqa: BLE001 — rethrown below
                 box["exc"] = e
 
-        t = threading.Thread(target=work, name="fleet-exchange",
+        t = threading.Thread(target=work, name="ds-fleet-exchange",
                              daemon=True)
         t.start()
         t.join(self.deadline_s)

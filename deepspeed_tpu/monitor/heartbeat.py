@@ -134,6 +134,10 @@ class HeartbeatWriter:
                 from ..utils.logging import logger
                 logger.warning(f"monitor: heartbeat write failed ({e}) — "
                                "further heartbeat errors suppressed")
+                from ..runtime.resilience.degradation import \
+                    record as degrade
+                degrade("heartbeat", "file", "silent",
+                        f"heartbeat write failed: {e}")
 
     @staticmethod
     def _chaos_fire():

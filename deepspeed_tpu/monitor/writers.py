@@ -129,6 +129,10 @@ class TensorBoardWriter(MetricsWriter):
                 self._warned = True
                 logger.warning(f"monitor: tensorboard writer failed ({e}) "
                                "— further tensorboard errors suppressed")
+                from ..runtime.resilience.degradation import \
+                    record as degrade
+                degrade("tensorboard", "summary-writer", "silent",
+                        f"tensorboard write failed: {e}")
 
     def flush(self) -> None:
         try:
@@ -211,6 +215,11 @@ class WriterThread:
                                 f"monitor: writer {type(w).__name__} "
                                 f"failed ({e}) — further writer errors "
                                 "suppressed")
+                            from ..runtime.resilience.degradation \
+                                import record as degrade
+                            degrade("monitor-writer",
+                                    type(w).__name__, "silent",
+                                    f"writer failed: {e}")
             for w in self.writers:
                 try:
                     w.flush()

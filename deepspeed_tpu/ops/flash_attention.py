@@ -376,10 +376,10 @@ def _fa_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(ki == num_k_blocks - 1)
     def _finalize():
-        l = l_scr[...][:, :1]
+        denom = l_scr[...][:, :1]
         # Fully-masked rows have l == 0; emit zeros not NaN.
-        l = jnp.where(l == 0.0, 1.0, l)
-        _st(o_ref, (acc_scr[...] / l).astype(o_ref.dtype))
+        denom = jnp.where(denom == 0.0, 1.0, denom)
+        _st(o_ref, (acc_scr[...] / denom).astype(o_ref.dtype))
         # logsumexp residual for the backward pass (FlashAttention-2 style)
         lse = m_scr[...][:, :1] + jnp.log(l_scr[...][:, :1] + 1e-37)
         lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])

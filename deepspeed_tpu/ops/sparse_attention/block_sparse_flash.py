@@ -104,9 +104,9 @@ def _bsf_fwd_kernel(idx_ref, val_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(j == max_deg - 1)
     def _finalize():
-        l = l_scr[...][:, :1]
-        l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        denom = l_scr[...][:, :1]
+        denom = jnp.where(denom == 0.0, 1.0, denom)
+        o_ref[0, 0] = (acc_scr[...] / denom).astype(o_ref.dtype)
         lse = m_scr[...][:, :1] + jnp.log(l_scr[...][:, :1] + 1e-37)
         lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
 

@@ -155,8 +155,8 @@ def _sparse_attention_impl(q, k, v, idx, valid, block: int,
     # at ~DEFAULT_MASK_VALUE); a fully-masked row then outputs 0 instead
     # of the reference kernel's NaN
     p = p * (flat > DEFAULT_MASK_VALUE / 2)
-    l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
-    p = (p / l).reshape(b, h, nb, block, max_deg, block)
+    denom = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    p = (p / denom).reshape(b, h, nb, block, max_deg, block)
 
     out = jnp.einsum("bhiqjk,bhijkd->bhiqd", p.astype(v.dtype), vg)
     return out.reshape(b, h, s, d)

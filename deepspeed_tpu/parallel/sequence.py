@@ -69,7 +69,7 @@ def ring_attention_inner(q, k, v, axis_name: str = SEQ_AXIS,
     # step i the block on shard `idx` originated on shard (idx - i) % sp.
     perm = [(j, (j + 1) % sp) for j in range(sp)]
 
-    def block(m, l, acc, k_cur, v_cur, src):
+    def block(m, denom, acc, k_cur, v_cur, src):
         """Flash-style online-softmax update with one remote K/V block.
 
         Matmuls run in the INPUT dtype with fp32 accumulation (bf16 inputs
@@ -92,28 +92,28 @@ def ring_attention_inner(q, k, v, axis_name: str = SEQ_AXIS,
             # otherwise pollute the running sum.
             p = jnp.where(valid, p, 0.0)
         corr = jnp.exp(m - m_new)
-        l = l * corr + jnp.sum(p, axis=-1)
+        denom = denom * corr + jnp.sum(p, axis=-1)
         acc = acc * corr[..., None] + jnp.einsum(
             "bhqk,bhkd->bhqd", p.astype(v_cur.dtype), v_cur,
             preferred_element_type=jnp.float32)
-        return m_new, l, acc
+        return m_new, denom, acc
 
     def step(carry, i):
         # Rotate first, then consume: the local (i=0) block is handled
         # outside the loop, so only sp-1 ppermutes ride the ring.
-        k_cur, v_cur, m, l, acc = carry
+        k_cur, v_cur, m, denom, acc = carry
         k_cur = lax.ppermute(k_cur, axis_name, perm)
         v_cur = lax.ppermute(v_cur, axis_name, perm)
-        m, l, acc = block(m, l, acc, k_cur, v_cur, (idx - i) % sp)
-        return (k_cur, v_cur, m, l, acc), None
+        m, denom, acc = block(m, denom, acc, k_cur, v_cur, (idx - i) % sp)
+        return (k_cur, v_cur, m, denom, acc), None
 
     m0 = jnp.full((b, h, q_len), DEFAULT_MASK_VALUE, jnp.float32)
     l0 = jnp.zeros((b, h, q_len), jnp.float32)
     a0 = jnp.zeros((b, h, q_len, d), jnp.float32)
     m0, l0, a0 = block(m0, l0, a0, k, v, idx)
-    (_, _, _, l, acc), _ = lax.scan(step, (k, v, m0, l0, a0),
+    (_, _, _, denom, acc), _ = lax.scan(step, (k, v, m0, l0, a0),
                                     jnp.arange(1, sp))
-    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    out = acc / jnp.maximum(denom, 1e-30)[..., None]
     return out.astype(orig_dtype)
 
 

@@ -174,9 +174,9 @@ def get_model_profile(fn: Callable, args: Tuple = (), kwargs=None,
     macs = (breakdown.get("dot_general", 0) + breakdown.get("conv", 0)) // 2
     n_params = 0
     if params is not None:
-        n_params = sum(int(np.prod(l.shape, initial=1))
-                       for l in jax.tree.leaves(params)
-                       if hasattr(l, "shape"))
+        n_params = sum(int(np.prod(leaf.shape, initial=1))
+                       for leaf in jax.tree.leaves(params)
+                       if hasattr(leaf, "shape"))
     if as_string:
         return (_fmt(flops, "FLOPS"), _fmt(macs, "MACs"),
                 _fmt(n_params, "params"))
@@ -222,9 +222,9 @@ class FlopsProfiler:
                      self.breakdown.get("conv", 0)) // 2
 
     def set_params(self, params: Any) -> None:
-        self.params = sum(int(np.prod(l.shape, initial=1))
-                          for l in jax.tree.leaves(params)
-                          if hasattr(l, "shape"))
+        self.params = sum(int(np.prod(leaf.shape, initial=1))
+                          for leaf in jax.tree.leaves(params)
+                          if hasattr(leaf, "shape"))
 
     def stop_profile(self) -> None:
         self.latency = time.time() - self._t0

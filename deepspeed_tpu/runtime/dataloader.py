@@ -98,7 +98,7 @@ def stack_microbatches(batches):
                 f"!= microbatch 0's {first} — all gas microbatches must "
                 "collate identically")
     return jax.tree.map(
-        lambda *leaves: np.stack([np.asarray(l) for l in leaves]), *batches)
+        lambda *leaves: np.stack([np.asarray(leaf) for leaf in leaves]), *batches)
 
 
 class RepeatingLoader:

@@ -15,9 +15,9 @@ import jax.numpy as jnp
 
 
 def _normalize(tree):
-    sq = sum(jnp.vdot(l, l).real for l in jax.tree.leaves(tree))
+    sq = sum(jnp.vdot(leaf, leaf).real for leaf in jax.tree.leaves(tree))
     norm = jnp.sqrt(sq)
-    return jax.tree.map(lambda l: l / (norm + 1e-12), tree), norm
+    return jax.tree.map(lambda leaf: leaf / (norm + 1e-12), tree), norm
 
 
 class Eigenvalue:
@@ -33,9 +33,9 @@ class Eigenvalue:
     def random_like(self, params: Any, rng) -> Any:
         leaves, treedef = jax.tree_util.tree_flatten(params)
         return jax.tree_util.tree_unflatten(treedef, [
-            jax.random.normal(jax.random.fold_in(rng, i), l.shape,
+            jax.random.normal(jax.random.fold_in(rng, i), leaf.shape,
                               jnp.float32)
-            for i, l in enumerate(leaves)])
+            for i, leaf in enumerate(leaves)])
 
     def power_iterate(self, hvp: Callable[[Any], Any],
                       v0: Any) -> Tuple[float, Any]:

@@ -1725,6 +1725,9 @@ class DeepSpeedEngine:
                 logger.warning(
                     f"monitor: static predictions unavailable ({e}) — "
                     "reconciliation will carry measured values only")
+                from .resilience.degradation import record as degrade
+                degrade("monitor-predictions", "static-audit",
+                        "measured-only", f"audit trace failed: {e}")
         predictions = None
         if report is not None and report.step_time is not None:
             from ..analysis import per_lane_predictions
@@ -2281,7 +2284,7 @@ class DeepSpeedEngine:
             losses.append(loss)
         # one host fetch AFTER the whole window is dispatched (not one per
         # microbatch) so the queue stays deep across the accumulation loop
-        return float(np.mean([np.asarray(l) for l in losses]))
+        return float(np.mean([np.asarray(loss) for loss in losses]))
 
     def _fused_train_batch(self, data_iter):
         """One fused dispatch: pull gas microbatches, stack them on a
@@ -2489,6 +2492,9 @@ class DeepSpeedEngine:
                         f"lockstep signature trace failed for onebit "
                         f"phase {phase!r} ({e}) — resume re-verification "
                         "will be skipped for this phase")
+                    from .resilience.degradation import record as degrade
+                    degrade("lockstep-signature", "traced", "skipped",
+                            f"onebit phase {phase!r} trace failed: {e}")
                     self._onebit_sig_cache[phase] = ""
             return self._onebit_sig_cache[phase] or None
         if self.program_audit is not None and \
@@ -2508,6 +2514,9 @@ class DeepSpeedEngine:
                 logger.warning(
                     f"lockstep signature trace failed ({e}) — resume "
                     "re-verification will be skipped for this engine")
+                from .resilience.degradation import record as degrade
+                degrade("lockstep-signature", "traced", "skipped",
+                        f"signature trace failed: {e}")
                 self._lockstep_sig_cache = ""
         return self._lockstep_sig_cache or None
 

@@ -44,7 +44,7 @@ class PipeLayer:
         raise NotImplementedError
 
     def num_params(self, params) -> int:
-        return sum(int(np.prod(np.shape(l))) for l in jax.tree.leaves(params))
+        return sum(int(np.prod(np.shape(leaf))) for leaf in jax.tree.leaves(params))
 
 
 class FnLayer(PipeLayer):
@@ -141,8 +141,8 @@ def _params_signature(params) -> tuple:
     be stacked into one scanned/vmapped body."""
     leaves, treedef = jax.tree_util.tree_flatten(params)
     return (str(treedef),
-            tuple((tuple(np.shape(l)), str(np.asarray(l).dtype))
-                  for l in leaves))
+            tuple((tuple(np.shape(leaf)), str(np.asarray(leaf).dtype))
+                  for leaf in leaves))
 
 
 class PipelineModule:
@@ -154,8 +154,8 @@ class PipelineModule:
                  partition_method: str = "parameters",
                  activation_checkpoint_interval: int = 0, seed_layers=False,
                  base_seed: int = 1234):
-        self.layer_specs = [l if isinstance(l, LayerSpec) else LayerSpec(l)
-                            if callable(l) else l for l in layers]
+        self.layer_specs = [layer if isinstance(layer, LayerSpec) else LayerSpec(layer)
+                            if callable(layer) else layer for layer in layers]
         self.num_stages = num_stages or 1
         self.loss_fn = loss_fn
         # "uniform" and "parameters" coincide for the stacked homogeneous
@@ -216,7 +216,7 @@ class PipelineModule:
                 x = jax.eval_shape(lambda p, xx, f=spec.forward_fn: f(p, xx),
                                    params, x)
             else:
-                x = jax.eval_shape(lambda p, xx, l=layer: l.apply(p, xx),
+                x = jax.eval_shape(lambda p, xx, lyr=layer: lyr.apply(p, xx),
                                    params, x)
             x = jnp.zeros(x.shape, x.dtype) if hasattr(x, "shape") else x
 

@@ -439,9 +439,9 @@ def make_gated_1f1b_grad_fn(*, mesh, stage_apply: Callable,
                     po, ti, o = args
 
                     def scaled_loss(po, ti, o):
-                        l = post_fn(po, ti, o, pick_mb(ym, f_mb), f_mb,
+                        raw_loss = post_fn(po, ti, o, pick_mb(ym, f_mb), f_mb,
                                     rng_post)
-                        return l.astype(jnp.float32) * loss_scale, l
+                        return raw_loss.astype(jnp.float32) * loss_scale, raw_loss
 
                     (_, loss_val), (gpo, gti, g_out) = jax.value_and_grad(
                         scaled_loss, argnums=(0, 1, 2), has_aux=True)(
@@ -679,8 +679,8 @@ def make_1f1b_grad_fn(*, module, constrain, stage_apply: Callable,
             yb = pick_mb(ym, f_mb[S - 1])
 
             def scaled_loss(po, ti, o):
-                l = post_loss(po, ti, o, yb, f_mb[S - 1], rng_post)
-                return l.astype(jnp.float32) * loss_scale, l
+                raw_loss = post_loss(po, ti, o, yb, f_mb[S - 1], rng_post)
+                return raw_loss.astype(jnp.float32) * loss_scale, raw_loss
 
             (_, loss_val), (gpo, gti, g_out) = jax.value_and_grad(
                 scaled_loss, argnums=(0, 1, 2), has_aux=True)(

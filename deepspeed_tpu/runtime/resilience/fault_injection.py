@@ -8,7 +8,16 @@ call sites (tests, scripts) keep working; new code should import from
 ``chaos`` directly — there is one injection mechanism, not two.
 """
 
-from .chaos import (  # noqa: F401 — re-exports
+import warnings
+
+warnings.warn(
+    "deepspeed_tpu.runtime.resilience.fault_injection is deprecated: "
+    "the injectors moved to deepspeed_tpu.runtime.resilience.chaos — "
+    "import InjectedCrash/crash_after_bytes/measure_save_bytes/"
+    "poison_batch from there",
+    DeprecationWarning, stacklevel=2)
+
+from .chaos import (  # noqa: F401,E402 — re-exports
     InjectedCrash,
     crash_after_bytes,
     measure_save_bytes,

@@ -172,7 +172,7 @@ class MegatronSDLoader:
         (path_fmt.format(rank))."""
         paths = []
         for r, tree in enumerate(split_state_dict(params, specs, mp_size)):
-            flat = {jax.tree_util.keystr(p): np.asarray(l) for p, l in
+            flat = {jax.tree_util.keystr(p): np.asarray(leaf) for p, leaf in
                     jax.tree_util.tree_flatten_with_path(tree)[0]}
             path = path_fmt.format(r)
             np.savez(path, **flat)

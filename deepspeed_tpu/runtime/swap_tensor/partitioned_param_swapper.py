@@ -42,8 +42,8 @@ class _Group:
     def __init__(self, name: str, tree: Any):
         self.name = name
         leaves, self.treedef = jax.tree_util.tree_flatten(tree)
-        self.shapes = [tuple(np.shape(l)) for l in leaves]
-        self.dtypes = [np.asarray(l).dtype for l in leaves]
+        self.shapes = [tuple(np.shape(leaf)) for leaf in leaves]
+        self.dtypes = [np.asarray(leaf).dtype for leaf in leaves]
         self.sizes = [int(np.prod(s)) if s else 1 for s in self.shapes]
         self.nbytes = sum(sz * dt.itemsize
                           for sz, dt in zip(self.sizes, self.dtypes))

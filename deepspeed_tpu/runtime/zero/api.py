@@ -66,7 +66,7 @@ class Init:
         shapes = jax.eval_shape(init_fn, rng, *args)
         shardings = self._partitioner.param_shardings(shapes, base_specs)
         params = jax.jit(init_fn, out_shardings=shardings)(rng, *args)
-        n = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+        n = sum(int(np.prod(leaf.shape)) for leaf in jax.tree.leaves(params))
         log_dist(f"zero.Init: materialized {n} params sharded at stage "
                  f"{self.stage}", ranks=[0])
         return params
@@ -102,7 +102,7 @@ class GatheredParameters:
         # np.array on a sharded jax.Array performs the gather; copy=True
         # yields writable host buffers for in-place surgery
         self._full = jax.tree.map(
-            lambda l: np.array(l) if isinstance(l, jax.Array) else l,
+            lambda leaf: np.array(leaf) if isinstance(leaf, jax.Array) else leaf,
             self.params)
         return self._full
 

@@ -312,8 +312,8 @@ class ZeroInfinityEngine:
                       "aio_backend": self.aio_backend,
                       "prefetch_depth": self._prefetch_depth,
                       "sweep_ceiling": self.sweep_ceiling})
-        n_params = sum(int(np.prod(np.shape(l)))
-                       for l in jax.tree.leaves(model_parameters))
+        n_params = sum(int(np.prod(np.shape(leaf)))
+                       for leaf in jax.tree.leaves(model_parameters))
         log_dist(
             f"ZeroInfinityEngine: {n_params:,} params in "
             f"{len(self._order)} streamed groups, params_on="
@@ -359,11 +359,11 @@ class ZeroInfinityEngine:
         for name in self._order:
             tree = self._group_host(name)
             group_bytes[name] = sum(
-                np.asarray(l).nbytes for l in jax.tree.leaves(tree))
+                np.asarray(leaf).nbytes for leaf in jax.tree.leaves(tree))
         total = sum(group_bytes.values())
         hbm_window = 2 * max(group_bytes.values())
-        n = sum(int(np.prod(np.shape(l))) for name in self._order
-                for l in jax.tree.leaves(self._group_host(name)))
+        n = sum(int(np.prod(np.shape(leaf))) for name in self._order
+                for leaf in jax.tree.leaves(self._group_host(name)))
         return {
             "hbm_param_window": hbm_window,
             "host_or_nvme_params": total,

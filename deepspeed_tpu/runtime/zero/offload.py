@@ -57,7 +57,8 @@ class HostOffloadOptimizer:
         self.gradient_clipping = float(gradient_clipping or 0.0)
         log_dist(
             f"ZeRO-Offload: host {name} over "
-            f"{sum(l.size for l in jax.tree.leaves(self.opt.params))} params, "
+            f"{sum(leaf.size for leaf in jax.tree.leaves(self.opt.params))}"
+            f" params, "
             f"native={self.opt.using_native}", ranks=[0])
 
     @property

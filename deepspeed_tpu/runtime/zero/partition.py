@@ -241,7 +241,7 @@ class ZeroPartitioner:
         master copies) get that parameter's shard spec; scalars (step counts)
         replicate.
         """
-        param_shapes = {_leaf_shape(l) for l in jax.tree.leaves(params)}
+        param_shapes = {_leaf_shape(leaf) for leaf in jax.tree.leaves(params)}
         spec_by_shape = {}
         leaves = jax.tree.leaves(params)
         base_list = self._aligned_base_list(params, base_specs)
@@ -317,7 +317,7 @@ class ZeroPartitioner:
                         optimizer_multiplier: int = 8) -> dict:
         """Per-chip memory estimate, the analog of
         stage2.py:2141 memory_estimators (returns bytes)."""
-        n = sum(int(np.prod(_leaf_shape(l))) for l in jax.tree.leaves(params))
+        n = sum(int(np.prod(_leaf_shape(leaf))) for leaf in jax.tree.leaves(params))
         z = self.zero_size
         param_b = n * bytes_per_param
         grad_b = n * bytes_per_param

@@ -208,13 +208,13 @@ def _body_uses_pallas(body, init_carry, p_tree, p_leaves, extra_xs) -> bool:
     off."""
     try:
         layer0 = p_tree.unflatten(
-            [jax.ShapeDtypeStruct(l.shape[1:], l.dtype) for l in p_leaves])
+            [jax.ShapeDtypeStruct(leaf.shape[1:], leaf.dtype) for leaf in p_leaves])
         extras0 = jax.tree.map(
             lambda e: jax.ShapeDtypeStruct(e.shape[1:], e.dtype), extra_xs)
         carry0 = jax.tree.map(
             lambda c: jax.ShapeDtypeStruct(c.shape, c.dtype), init_carry)
         jaxpr = jax.make_jaxpr(
-            lambda c, l, e: body(c, (l,) + tuple(e)))(
+            lambda c, leaf, e: body(c, (leaf,) + tuple(e)))(
             carry0, layer0, extras0)
         return _jaxpr_has_pallas(jaxpr.jaxpr)
     except Exception:  # noqa: BLE001 — conservative on any trace failure
@@ -272,7 +272,7 @@ _all_gather_f32grad.defvjp(_ag_fwd, _ag_bwd)
 def _index_tree(tree, i):
     """Dynamic per-group slice of a ``[steps, ...]``-stacked pytree."""
     return jax.tree.map(
-        lambda l: lax.dynamic_index_in_dim(l, i, keepdims=False), tree)
+        lambda leaf: lax.dynamic_index_in_dim(leaf, i, keepdims=False), tree)
 
 
 def _body_closes_over_tracers(body) -> bool:
@@ -290,8 +290,8 @@ def _body_closes_over_tracers(body) -> bool:
 
     def has_tracer(v):
         try:
-            return any(isinstance(l, jax.core.Tracer)
-                       for l in jax.tree.leaves(v))
+            return any(isinstance(leaf, jax.core.Tracer)
+                       for leaf in jax.tree.leaves(v))
         except Exception:  # noqa: BLE001 — exotic leaves: assume clean
             return False
 
@@ -403,11 +403,11 @@ def _build_carried_stream(steps: int, gather_group, run_group,
         c_pen, c_ins, params_g, extras_g = res
         ex_leaves = jax.tree.leaves(extras_g)
         ex_tree = jax.tree.structure(extras_g)
-        is_float = [jnp.issubdtype(l.dtype, jnp.inexact)
-                    for l in ex_leaves]
+        is_float = [jnp.issubdtype(leaf.dtype, jnp.inexact)
+                    for leaf in ex_leaves]
 
         def float_only(g_ex):
-            return [l for l, f in zip(jax.tree.leaves(g_ex), is_float)
+            return [leaf for leaf, f in zip(jax.tree.leaves(g_ex), is_float)
                     if f]
 
         def group_vjp(c_in, full, ex_i, g_c):
@@ -621,7 +621,7 @@ class Zero3StreamContext:
         leaves = jax.tree.leaves(stacked_params)
         num_layers = int(leaves[0].shape[0])
         per_layer = sum(
-            int(np.prod(l.shape[1:])) for l in leaves)
+            int(np.prod(leaf.shape[1:])) for leaf in leaves)
         return plan_layer_streaming(num_layers, per_layer,
                                     self.max_live_parameters,
                                     self.prefetch_bucket_size,
@@ -735,8 +735,8 @@ class Zero3StreamContext:
         if len(tp_list) != len(p_leaves):
             raise ValueError("param_tp_specs must mirror stacked_params")
         p_manual = self.param_manual  # == manual unless hpZ restricts it
-        inner_specs = [self._per_layer_zero_spec(l, s)
-                       for l, s in zip(p_leaves, tp_list)]
+        inner_specs = [self._per_layer_zero_spec(leaf, s)
+                       for leaf, s in zip(p_leaves, tp_list)]
         in_param_specs = [
             PartitionSpec(None, *list(_restrict_to_manual(s, p_manual)))
             for s in inner_specs]
@@ -757,7 +757,7 @@ class Zero3StreamContext:
         # hot-loop per-layer gathers, which hpZ is buying back, stay at
         # the quantized/native width, and the boundary grad psum must be
         # fp32 anyway (accumulation + the XLA-CPU abort above).
-        leaf_dtypes = [l.dtype for l in p_leaves]
+        leaf_dtypes = [leaf.dtype for leaf in p_leaves]
 
         def _covered_axes(dims):
             cov = set()
@@ -774,8 +774,8 @@ class Zero3StreamContext:
             return leaf.reshape((steps, g) + tuple(leaf.shape[1:]))
 
         grouped_params = [
-            group_leaf(l.astype(jnp.float32) if w else l)
-            for l, w in zip(p_leaves, widen)]
+            group_leaf(leaf.astype(jnp.float32) if w else leaf)
+            for leaf, w in zip(p_leaves, widen)]
         grouped_extras = jax.tree.map(group_leaf, extra_xs)
         # the group reshape shifts every dim by one: shift specs too
         def shift(spec):
@@ -812,7 +812,7 @@ class Zero3StreamContext:
             """Unrolled pass over the g layers inside one gathered group."""
             for j in range(g):
                 layer = p_tree.unflatten(
-                    [l[j] for l in full_group])
+                    [leaf[j] for leaf in full_group])
                 extras_j = jax.tree.map(lambda e: e[j], extras_group)
                 carry, _ = body(carry, (layer,) + tuple(extras_j))
             return carry

@@ -772,12 +772,12 @@ def test_peak_hbm_default_gpt2_within_sanity_band():
     engine = _tiny_engine()
     report = engine.program_audit
     param_bytes = sum(
-        int(np.prod(l.shape)) * l.dtype.itemsize
-        for l in _jax.tree.leaves(engine.params))
+        int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+        for leaf in _jax.tree.leaves(engine.params))
     state_bytes = param_bytes + sum(
-        int(np.prod(l.shape)) * l.dtype.itemsize
-        for l in _jax.tree.leaves(engine.opt_state)
-        if hasattr(l, "shape"))
+        int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+        for leaf in _jax.tree.leaves(engine.opt_state)
+        if hasattr(leaf, "shape"))
     assert report.peak_hbm_bytes >= state_bytes
     assert report.peak_hbm_bytes <= 50 * state_bytes, (
         report.peak_hbm_bytes, state_bytes,

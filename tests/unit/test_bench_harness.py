@@ -207,7 +207,7 @@ def test_sigterm_emits_one_diagnostic_json_line():
     time.sleep(10)  # first probe fails (~5s), bench sleeps before retry
     proc.send_signal(signal.SIGTERM)
     out, _ = proc.communicate(timeout=120)
-    lines = [l for l in out.strip().splitlines() if l.strip()]
+    lines = [ln for ln in out.strip().splitlines() if ln.strip()]
     assert len(lines) == 1, out
     payload = json.loads(lines[0])
     os.unlink(ladder.name)
@@ -244,7 +244,7 @@ def test_wedged_slot_marks_payload(tmp_path):
     out = subprocess.run([sys.executable, "-c", script], cwd=str(REPO),
                          capture_output=True, text=True, timeout=120,
                          env=env)
-    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    lines = [ln for ln in out.stdout.strip().splitlines() if ln.strip()]
     assert len(lines) == 1, out.stdout + out.stderr
     payload = json.loads(lines[0])
     assert payload["wedge_reason"] == "stale TPU claim / wedged transport"
@@ -319,7 +319,7 @@ def test_degraded_retry_on_mosaic_failure(monkeypatch, capsys):
         dispatch.force_xla_kernels(prev_force)
         signal.signal(signal.SIGTERM, prev_term)
         signal.signal(signal.SIGINT, prev_int)
-    out = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    out = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
     assert len(out) == 1, out
     payload = json.loads(out[-1])
     assert payload["value"] == 123.0
@@ -341,7 +341,7 @@ def test_degraded_retry_on_mosaic_failure(monkeypatch, capsys):
         dispatch.force_xla_kernels(prev_force)
         signal.signal(signal.SIGTERM, prev_term)
         signal.signal(signal.SIGINT, prev_int)
-    out = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    out = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
     payload = json.loads(out[-1])
     assert payload["value"] == 0.0
     assert "unrelated" in payload["error"]
@@ -364,7 +364,7 @@ def test_degraded_retry_on_mosaic_failure(monkeypatch, capsys):
         dispatch.force_xla_kernels(prev_force)
         signal.signal(signal.SIGTERM, prev_term)
         signal.signal(signal.SIGINT, prev_int)
-    out = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    out = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
     payload = json.loads(out[-1])
     assert payload["value"] == 0.0
     assert "unavailable" in payload["error"]
@@ -408,7 +408,7 @@ def test_wall_budget_emits_and_exits_zero_before_driver_timeout():
         env=env, cwd=str(REPO), timeout=120)
     elapsed = time.time() - t0
     assert proc.returncode == 0, proc.stdout
-    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
     assert len(lines) == 1, proc.stdout
     payload = json.loads(lines[0])
     assert payload["metric"] == "gpt2_124m_train_tokens_per_sec_1chip"

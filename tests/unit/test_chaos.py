@@ -107,7 +107,6 @@ def test_chaos_off_by_default():
 # determinism: same seed + schedule => bitwise-identical fired log
 # --------------------------------------------------------------------- #
 def _drive(plane):
-    fired = []
     with chaos.installed(plane):
         for step in range(1, 6):
             for point in (chaos.POINT_AIO_PREAD, chaos.POINT_HEARTBEAT,
@@ -591,6 +590,25 @@ def test_legacy_fault_injection_shim_still_works(tmp_path):
         with pytest.raises(InjectedCrash):
             with open(tmp_path / "f.bin", "wb") as f:
                 f.write(b"12345")
+
+
+def test_legacy_fault_injection_shim_names_its_replacement():
+    # the deprecation must point movers at the chaos plane by module
+    # path — a bare "deprecated" is not actionable
+    import importlib
+    import warnings as _warnings
+
+    from deepspeed_tpu.runtime.resilience import fault_injection as fi
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        importlib.reload(fi)  # the warning fires at import time
+    dep = [w for w in caught
+           if issubclass(w.category, DeprecationWarning)]
+    assert len(dep) == 1
+    msg = str(dep[0].message)
+    assert "deepspeed_tpu.runtime.resilience.fault_injection is " \
+        "deprecated" in msg
+    assert "deepspeed_tpu.runtime.resilience.chaos" in msg
 
 
 def test_engine_drains_degradation_records():

@@ -42,7 +42,7 @@ def test_checkpoint_roundtrip_trajectory(tmp_path, stage):
     run_steps(e1, 3)
     e1.save_checkpoint(str(tmp_path), tag="ckpt")
     p_saved = jax.tree.map(np.asarray, e1.params)
-    it = run_steps(e1, 5, seed=3)
+    run_steps(e1, 5, seed=3)
     p_after = jax.tree.map(np.asarray, e1.params)
 
     e2 = make_engine(stage=stage)
@@ -112,7 +112,7 @@ def test_consolidate_to_fp32(tmp_path):
     e.save_checkpoint(str(tmp_path), tag="fp32")
     weights = consolidate_to_fp32(str(tmp_path))
     total = sum(w.size for w in weights.values())
-    expect = sum(l.size for l in jax.tree.leaves(e.params))
+    expect = sum(leaf.size for leaf in jax.tree.leaves(e.params))
     assert total == expect
     assert all(w.dtype == np.float32 for w in weights.values())
 

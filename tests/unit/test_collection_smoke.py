@@ -55,6 +55,18 @@ def test_resilience_package_imports_cleanly():
             # subcommands and bench.py's autotune ladder row
             "deepspeed_tpu.analysis.search_space",
             "deepspeed_tpu.analysis.autotuner",
+            # source-invariant lint (round 22): lazily imported by the
+            # lint-source subcommand; jax-free by design, so nothing
+            # else in the suite would catch a break in it
+            "deepspeed_tpu.analysis.source_lint",
+            "deepspeed_tpu.analysis.source_lint.core",
+            "deepspeed_tpu.analysis.source_lint.manifest",
+            "deepspeed_tpu.analysis.source_lint.runner",
+            "deepspeed_tpu.analysis.source_lint.rules_thread",
+            "deepspeed_tpu.analysis.source_lint.rules_determinism",
+            "deepspeed_tpu.analysis.source_lint.rules_degradation",
+            "deepspeed_tpu.analysis.source_lint.rules_knobs",
+            "deepspeed_tpu.analysis.source_lint.rules_checkpoint",
             # fused collective-matmul kernels: lazily reachable through
             # the streaming context's fcm routing and the bench fcm row
             "deepspeed_tpu.ops.collective_matmul",

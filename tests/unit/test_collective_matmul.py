@@ -253,8 +253,8 @@ def test_fused_matmul_reduce_scatter_with_error_feedback():
     rhs = jax.random.normal(jax.random.PRNGKey(11), (B, N))
     err0 = jnp.zeros((K, N))
 
-    def fused(l, r, e):
-        c, ne = cm.fused_matmul_reduce_scatter(l, r, e[0], "data", 8,
+    def fused(lhs, r, e):
+        c, ne = cm.fused_matmul_reduce_scatter(lhs, r, e[0], "data", 8,
                                                16, True)
         return c[None], ne[None]
 

@@ -325,8 +325,8 @@ def test_save_fp16_model_export_bf16_offload(tmp_path):
                 "zero_optimization": {
                     "stage": 2, "offload_optimizer": {"device": "cpu"}},
                 "steps_per_print": 10 ** 9})
-    assert any(jnp.issubdtype(l.dtype, jnp.bfloat16) or
-               l.dtype == jnp.bfloat16
-               for l in jax.tree.leaves(engine.params)), \
+    assert any(jnp.issubdtype(leaf.dtype, jnp.bfloat16) or
+               leaf.dtype == jnp.bfloat16
+               for leaf in jax.tree.leaves(engine.params)), \
         "offload engine should hold bf16 device params"
     _assert_fp16_export(engine, tmp_path)

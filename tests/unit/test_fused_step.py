@@ -304,7 +304,7 @@ def test_fused_fallback_offload_still_trains():
     assert "offload_optimizer" in engine.fused_step_reason
     losses = [engine.train_batch(iter(data_stream(1, seed=40 + i)))
               for i in range(2)]
-    assert all(np.isfinite(l) for l in losses)
+    assert all(np.isfinite(loss) for loss in losses)
 
 
 # --------------------------------------------------------------------- #

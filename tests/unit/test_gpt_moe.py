@@ -36,7 +36,7 @@ def test_param_count_exact(ep_mesh):
     cfg = _cfg()
     model = GPTMoEModel(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
-    actual = sum(int(np.prod(np.shape(l))) for l in jax.tree.leaves(params))
+    actual = sum(int(np.prod(np.shape(leaf))) for leaf in jax.tree.leaves(params))
     assert actual == cfg.num_params()
 
 

@@ -46,7 +46,7 @@ def test_gpt2_num_params_matches_tree():
     cfg = tiny_gpt2()
     model = GPT2Model(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
-    actual = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params))
+    actual = sum(int(np.prod(leaf.shape)) for leaf in jax.tree.leaves(params))
     assert cfg.num_params() == actual
 
 

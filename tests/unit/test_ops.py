@@ -250,7 +250,7 @@ def test_tp_manual_view_roundtrip():
     layer = DeepSpeedTransformerLayer(cfg)
     single = layer.init_params(jax.random.PRNGKey(0))
     stacked = jax.tree.map(
-        lambda l: jnp.stack([jnp.stack([l, l + 1.0])] * 3), single)
+        lambda leaf: jnp.stack([jnp.stack([leaf, leaf + 1.0])] * 3), single)
     viewed = DeepSpeedTransformerLayer.tp_manual_views(stacked, cfg.heads)
     assert viewed["attn_qkvw"].shape == (3, 2, 32, 4, 3, 8)
     assert viewed["attn_qkvb"].shape == (3, 2, 4, 3, 8)

@@ -158,7 +158,6 @@ class TestPipelineEngine:
         loss_pipe = float(engine.forward(x, y))
 
         # sequential reference: same params, plain layer chain
-        module = engine.pipeline_module
         M = engine.micro_batches
         xm = x.reshape(M, -1, IN_DIM)
         ym = y.reshape(M, -1, OUT_DIM)

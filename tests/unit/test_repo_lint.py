@@ -6,12 +6,14 @@ mask test failures.  This test is the in-suite twin: when ruff is
 installed it runs the real thing; otherwise it falls back to an
 AST-based subset covering the same rule families (F401 unused imports,
 F632 is-literal, E711/E712 None/bool comparisons, E713/E714 membership/
-identity negation, E722 bare except) so the fast lane still fails on a
+identity negation, E722 bare except, E741 ambiguous single-letter
+names, F841 unused locals) so the fast lane still fails on a
 regression instead of silently skipping — the container this repo
 develops in does not ship ruff.
 """
 
 import ast
+import re
 import shutil
 import subprocess
 import sys
@@ -105,15 +107,107 @@ def _bare_excepts(tree):
             for h in node.handlers if h.type is None]
 
 
+_AMBIGUOUS = {"l", "O", "I"}
+
+
+def _ambiguous_names(tree):
+    """E741 subset: `l`/`O`/`I` bound as a variable, parameter, or
+    exception name (including inside comprehensions and f-strings,
+    which the ast sees even where tokenize does not)."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and node.id in _AMBIGUOUS \
+                and isinstance(node.ctx, ast.Store):
+            out.append((node.lineno,
+                        f"E741 ambiguous variable name `{node.id}`"))
+        elif isinstance(node, ast.arg) and node.arg in _AMBIGUOUS:
+            out.append((node.lineno,
+                        f"E741 ambiguous parameter name `{node.arg}`"))
+        elif isinstance(node, ast.ExceptHandler) \
+                and node.name in _AMBIGUOUS:
+            out.append((node.lineno,
+                        f"E741 ambiguous exception name `{node.name}`"))
+    return out
+
+
+def _unused_locals(tree):
+    """F841 subset: a simple `name = ...` statement inside a function
+    whose name is never loaded anywhere in that function.  Conservative
+    on purpose: skips underscore-prefixed names, tuple unpacking,
+    augmented assigns, class bodies, and any function using
+    locals()/exec/eval."""
+    out = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        class_lines = set()
+        escape_hatch = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.ClassDef):
+                for inner in ast.walk(node):
+                    if hasattr(inner, "lineno"):
+                        class_lines.add(inner.lineno)
+            elif isinstance(node, ast.Name) \
+                    and node.id in ("locals", "vars", "exec", "eval"):
+                escape_hatch = True
+        if escape_hatch:
+            continue
+        assigned = {}
+        loaded = set()
+        strings = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.lineno not in class_lines:
+                name = node.targets[0].id
+                if not name.startswith("_"):
+                    assigned.setdefault(name, node.lineno)
+            elif isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load):
+                loaded.add(node.id)
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                loaded.update(node.names)
+            elif isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str):
+                strings.append(node.value)
+        loaded.update(n for n in assigned
+                      if any(n in s for s in strings))
+        out.extend((lineno, f"F841 local `{name}` assigned but unused")
+                   for name, lineno in sorted(assigned.items(),
+                                              key=lambda kv: kv[1])
+                   if name not in loaded)
+    return out
+
+
+_NOQA_RE = re.compile(r"#\s*noqa(?::\s*(?P<codes>[A-Z0-9, ]+))?",
+                      re.IGNORECASE)
+
+
+def _noqa_suppressed(line, code):
+    """Mirror ruff's noqa semantics: bare `# noqa` kills every code on
+    the line, `# noqa: F401,E402` only the listed ones."""
+    m = _NOQA_RE.search(line)
+    if not m:
+        return False
+    codes = m.group("codes")
+    return codes is None or code in re.split(r"[,\s]+", codes.strip())
+
+
 def _fallback_lint():
     findings = []
     for f in _iter_sources():
-        tree = ast.parse(f.read_text(), filename=str(f))
+        text = f.read_text()
+        tree = ast.parse(text, filename=str(f))
+        src_lines = text.splitlines()
         rel = f.relative_to(REPO)
-        hits = _comparison_findings(tree) + _bare_excepts(tree)
+        hits = (_comparison_findings(tree) + _bare_excepts(tree)
+                + _ambiguous_names(tree) + _unused_locals(tree))
         if f.name != F401_EXEMPT:
             hits += _unused_imports(tree)
-        findings.extend(f"{rel}:{lineno}: {msg}" for lineno, msg in hits)
+        findings.extend(
+            f"{rel}:{lineno}: {msg}" for lineno, msg in hits
+            if not _noqa_suppressed(src_lines[lineno - 1],
+                                    msg.split()[0]))
     return findings
 
 
@@ -151,13 +245,17 @@ def test_fallback_linter_detects_each_rule(tmp_path):
         "try:\n"
         "    pass\n"
         "except:\n"
-        "    pass\n")
+        "    pass\n"
+        "def f(l):\n"
+        "    dead = l + 1\n"
+        "    return l\n")
     tree = ast.parse(fixture.read_text())
     codes = {m.split()[0] for _ln, m in
              (_comparison_findings(tree) + _bare_excepts(tree)
-              + _unused_imports(tree))}
+              + _unused_imports(tree) + _ambiguous_names(tree)
+              + _unused_locals(tree))}
     assert {"E711", "E712", "E713", "E714", "F632", "E722",
-            "F401"} <= codes
+            "F401", "E741", "F841"} <= codes
 
 
 def test_lint_scope_matches_pyproject():
@@ -175,7 +273,7 @@ def test_lint_scope_matches_pyproject():
         cfg = tomllib.loads((REPO / "pyproject.toml").read_text())
         codes = set(cfg["tool"]["ruff"]["lint"]["select"])
     assert {"F401", "F632", "E711", "E712", "E713", "E714",
-            "E722"} == codes, (
+            "E722", "E741", "F841"} == codes, (
         "pyproject ruff select drifted from the fallback's rule "
         "families — update tests/unit/test_repo_lint.py to match")
     assert sys.version_info >= (3, 10)

@@ -138,7 +138,7 @@ def test_consolidate_sharded_to_fp32(tmp_path):
     assert all(v.dtype == np.float32 for v in out.values()
                if np.issubdtype(np.asarray(v).dtype, np.floating))
     # consolidated weights equal the engine's own (gathered) params
-    flat = {jax.tree_util.keystr(p): np.asarray(l) for p, l in
+    flat = {jax.tree_util.keystr(p): np.asarray(leaf) for p, leaf in
             jax.tree_util.tree_flatten_with_path(
                 {"module": engine0.params})[0]}
     for k, v in out.items():

@@ -436,7 +436,7 @@ def test_stream_context_low_bandwidth_wiring():
     from deepspeed_tpu.runtime.zero.stage3_streaming import Zero3StreamContext
 
     ds.reset_mesh_context()
-    mesh = ds.initialize_mesh(data=4, expert=2)
+    ds.initialize_mesh(data=4, expert=2)
     ctx = ds.get_mesh_context()
 
     # hpZ: param gathers confined to the inner axis, grads still span all
